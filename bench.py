@@ -1,0 +1,182 @@
+"""Benchmark — flagship training throughput on the local chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Reference baseline: none published in-tree (BASELINE.md — the reference repo
+has no stored numbers). vs_baseline therefore reports MFU / 0.45, progress
+against the north-star ≥45% MFU target from BASELINE.json.
+
+Default workload: BERT-base MLM pretraining step (batch x 512 tokens, bf16
+compute, Adam) — the MXU-dominated flagship. `--model resnet50` benches the
+conv flagship instead.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops():
+    """Per-chip peak bf16 FLOP/s; override with PT_PEAK_FLOPS."""
+    if "PT_PEAK_FLOPS" in os.environ:
+        return float(os.environ["PT_PEAK_FLOPS"])
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    # TPU v5e (v5 lite): 394 TFLOP/s bf16; v5p: 459; v4: 275; v6e: 918
+    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
+        return 394e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    if "v4" in kind:
+        return 275e12
+    return 394e12
+
+
+def _cost_flops(jitted, *args):
+    try:
+        c = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return float(c.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def bench_bert(steps, batch, seq):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        pretrain_loss)
+
+    cfg = BertConfig.base()
+    cfg.dropout = 0.0  # bench the compute path
+    model = BertForPretraining(cfg)
+    variables = model.init(jax.random.key(0))
+    params = variables["params"]
+
+    policy = pt.amp.bf16_policy()
+    opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+    mlm_labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+    nsp_labels = jnp.asarray(rng.randint(0, 2, (batch,), dtype=np.int32))
+    mask = jnp.asarray((rng.rand(batch, seq) < 0.15).astype(np.float32))
+
+    def loss_fn(p, ids, mlm_l, nsp_l, m):
+        mlm_logits, nsp_logits = model.apply({"params": p, "state": {}}, ids)
+        return pretrain_loss(mlm_logits, nsp_logits, mlm_l, nsp_l, m), 0.0
+
+    def train_step(params, opt_state, ids, mlm_l, nsp_l, m):
+        loss, params, opt_state, _ = opt.minimize(
+            loss_fn, params, opt_state, ids, mlm_l, nsp_l, m)
+        return loss, params, opt_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    flops_per_step = _cost_flops(jitted, params, opt_state, ids, mlm_labels,
+                                 nsp_labels, mask)
+    # warmup/compile
+    loss, params, opt_state = jitted(params, opt_state, ids, mlm_labels,
+                                     nsp_labels, mask)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = jitted(params, opt_state, ids, mlm_labels,
+                                         nsp_labels, mask)
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    tokens_per_sec = batch * seq / dt
+    achieved = flops_per_step / dt if flops_per_step else 0.0
+    mfu = achieved / peak_flops()
+    return {
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": float(loss),
+    }
+
+
+def bench_resnet(steps, batch):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.ops import loss as L
+
+    model = resnet50(num_classes=1000)
+    variables = model.init(jax.random.key(0))
+    params, state = variables["params"], variables["state"]
+
+    policy = pt.amp.bf16_policy()
+    opt = pt.amp.decorate(
+        pt.optimizer.Momentum(0.1, 0.9), policy)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 1000, (batch, 1), dtype=np.int32))
+
+    def loss_fn(p, images, labels, state):
+        out, new_state = model.apply({"params": p, "state": state}, images,
+                                     training=True)
+        loss = jnp.mean(L.softmax_with_cross_entropy(out, labels))
+        return loss, new_state
+
+    def train_step(params, opt_state, state, images, labels):
+        loss, params, opt_state, new_state = opt.minimize(
+            loss_fn, params, opt_state, images, labels, state)
+        return loss, params, opt_state, new_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    flops_per_step = _cost_flops(jitted, params, opt_state, state, images,
+                                 labels)
+    loss, params, opt_state, state = jitted(params, opt_state, state, images,
+                                            labels)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state, state = jitted(params, opt_state, state,
+                                                images, labels)
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    achieved = flops_per_step / dt if flops_per_step else 0.0
+    mfu = achieved / peak_flops()
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(batch / dt, 1),
+        "unit": "images/s/chip",
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": float(loss),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="bert", choices=["bert", "resnet50"])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=512)
+    args = ap.parse_args()
+
+    if args.model == "bert":
+        res = bench_bert(args.steps, args.batch or 32, args.seq)
+    else:
+        res = bench_resnet(args.steps, args.batch or 128)
+    res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Automatic mixed precision.
+
+Ref: /root/reference/python/paddle/fluid/contrib/mixed_precision/ —
+decorator.py:216 `decorate(optimizer, ...)` (OptimizerWithMixedPrecision),
+fp16_lists.py (white/black op lists), fp16_utils.py (static + dynamic loss
+scaling).
+
+TPU-first: the native low-precision type is **bfloat16** — same exponent
+range as fp32, so no loss scaling is required (the reference's dynamic loss
+scaler exists because of fp16's narrow range; we keep it for fp16 parity).
+A Policy maps pytrees between storage/compute dtypes; master weights stay
+fp32 in the optimizer, compute runs bf16 through the MXU.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class Policy:
+    """Param storage / compute / output dtypes (≈ fp16 white/black lists at
+    whole-model granularity, the idiomatic XLA formulation)."""
+
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.bfloat16
+    output_dtype: object = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_param(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+    def cast_to_output(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def bf16_policy():
+    return Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+
+
+def fp16_policy():
+    return Policy(jnp.float32, jnp.float16, jnp.float32)
+
+
+class LossScaler:
+    """Dynamic loss scaling (ref: fp16_utils.py update_loss_scaling —
+    init_loss_scaling 2**15, incr_every_n_steps, decr_every_n_nan_or_inf)."""
+
+    def __init__(self, init_scale=2.0 ** 15, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+                 dynamic=True):
+        self.init_scale = init_scale
+        self.incr_every = incr_every_n_steps
+        self.decr_every = decr_every_n_nan_or_inf
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.dynamic = dynamic
+
+    def init(self):
+        return {"scale": jnp.asarray(self.init_scale, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+                "bad_steps": jnp.zeros((), jnp.int32)}
+
+    def scale_loss(self, loss, state):
+        return loss * state["scale"]
+
+    def unscale(self, grads, state):
+        inv = 1.0 / state["scale"]
+        return jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    def check_finite(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        finite = jnp.all(jnp.array(
+            [jnp.all(jnp.isfinite(g)) for g in leaves]))
+        return finite
+
+    def update(self, state, grads_finite):
+        if not self.dynamic:
+            return state
+        good = jnp.where(grads_finite, state["good_steps"] + 1, 0)
+        bad = jnp.where(grads_finite, 0, state["bad_steps"] + 1)
+        scale = state["scale"]
+        scale = jnp.where(good >= self.incr_every, scale * self.incr_ratio,
+                          scale)
+        good = jnp.where(good >= self.incr_every, 0, good)
+        scale = jnp.where(bad >= self.decr_every, scale * self.decr_ratio,
+                          scale)
+        bad = jnp.where(bad >= self.decr_every, 0, bad)
+        scale = jnp.clip(scale, 1.0, 2.0 ** 24)
+        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+
+
+def decorate(optimizer, policy=None, scaler=None):
+    """ref: decorator.py:216 decorate() — wraps an optimizer so minimize()
+    runs forward in compute dtype, keeps fp32 master weights, and (for fp16)
+    applies dynamic loss scaling with skipped-on-overflow updates."""
+    policy = policy or bf16_policy()
+    use_scaler = scaler is not None or policy.compute_dtype == jnp.float16
+    scaler = scaler or LossScaler()
+
+    class MixedPrecisionOptimizer:
+        def __init__(self):
+            self.inner = optimizer
+            self.policy = policy
+            self.scaler = scaler
+
+        def init(self, params):
+            st = {"inner": self.inner.init(params)}
+            if use_scaler:
+                st["scaler"] = self.scaler.init()
+            return st
+
+        def minimize(self, loss_fn, params, state, *args, **kwargs):
+            def cast_loss(p, *a, **kw):
+                pc = self.policy.cast_to_compute(p)
+                # inputs follow the compute dtype (lax convs/dots require
+                # matching dtypes; mirrors the reference's cast-insertion at
+                # fp16 boundaries, fp16_utils.py). Aux (e.g. BN running
+                # stats) is cast back to param dtype for storage.
+                ac = self.policy.cast_to_compute(a)
+                loss, aux = loss_fn(pc, *ac, **kw)
+                loss = loss.astype(jnp.float32)
+                aux = self.policy.cast_to_param(aux)
+                if use_scaler:
+                    loss = self.scaler.scale_loss(loss, state["scaler"])
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(
+                cast_loss, has_aux=True)(params, *args, **kwargs)
+            grads = self.policy.cast_to_param(grads)
+            if use_scaler:
+                grads = self.scaler.unscale(grads, state["scaler"])
+                finite = self.scaler.check_finite(grads)
+                new_params, new_inner = self.inner.apply_gradients(
+                    params, grads, state["inner"])
+                # skip update on overflow (ref: fp16_utils update skipping)
+                new_params = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_params, params)
+                new_inner = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_inner,
+                    state["inner"])
+                new_scaler = self.scaler.update(state["scaler"], finite)
+                loss = loss / state["scaler"]["scale"]
+                return loss, new_params, {"inner": new_inner,
+                                          "scaler": new_scaler}, aux
+            new_params, new_inner = self.inner.apply_gradients(
+                params, grads, state["inner"])
+            return loss, new_params, {"inner": new_inner}, aux
+
+        def apply_gradients(self, params, grads, state):
+            new_params, new_inner = self.inner.apply_gradients(
+                params, grads, state["inner"])
+            new_state = dict(state)
+            new_state["inner"] = new_inner
+            return new_params, new_state
+
+    return MixedPrecisionOptimizer()

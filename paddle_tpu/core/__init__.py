@@ -1,0 +1,19 @@
+"""Core: platform + framework layer.
+
+TPU-native replacement for the reference's `paddle/fluid/platform` and
+`paddle/fluid/framework` C++ core. PJRT (via JAX) owns device contexts,
+allocation, streams, and kernel dispatch — what the reference hand-built
+(device_context.h, allocator_facade.h, operator.cc kernel choice) the XLA
+runtime provides. What remains framework-level lives here:
+
+  dtype.py     canonical dtypes (ref: framework.proto VarType)
+  enforce.py   error-checking macros (ref: platform/enforce.h PADDLE_ENFORCE)
+  flags.py     global config flags (ref: platform/flags.cc)
+  registry.py  op registry keyed by name (ref: framework/op_registry.h)
+  program.py   captured Program IR via jax tracing (ref: framework.proto ProgramDesc)
+  random.py    global seed management
+  ragged.py    ragged/variable-length batching (ref: lod_tensor.h LoD)
+"""
+
+from paddle_tpu.core import dtype, enforce, flags, random
+from paddle_tpu.core.registry import OpRegistry, register_op

@@ -1,0 +1,70 @@
+"""Canonical dtypes.
+
+Ref: /root/reference/paddle/fluid/framework/framework.proto:105 (VarType.Type
+enumerates BOOL/INT16/INT32/INT64/FP16/FP32/FP64/UINT8/INT8) and
+platform/float16.h. On TPU the preferred low-precision type is bfloat16
+(MXU-native); float16 is kept for API parity.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+int8 = jnp.int8
+uint8 = jnp.uint8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "int8": int8,
+    "uint8": uint8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a string/numpy/jax dtype spec to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_TO_DTYPE:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return _STR_TO_DTYPE[key]
+    return jnp.dtype(dtype).type
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def finfo(dtype):
+    return jnp.finfo(dtype)
+
+
+def iinfo(dtype):
+    return jnp.iinfo(dtype)
+
+
+def numpy_dtype(dtype):
+    return np.dtype(jnp.dtype(convert_dtype(dtype)))

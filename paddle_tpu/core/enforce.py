@@ -1,0 +1,63 @@
+"""Error checking — the PADDLE_ENFORCE family, Python-native.
+
+Ref: /root/reference/paddle/fluid/platform/enforce.h:286 (PADDLE_ENFORCE,
+PADDLE_ENFORCE_EQ/NE/GT/GE/LT/LE/NOT_NULL with demangled stack traces).
+Python tracebacks already carry the stack; we add structured error types and
+shape/dtype-specific checks used throughout the op library.
+"""
+
+
+class EnforceError(RuntimeError):
+    """Framework invariant violation (ref: platform::EnforceNotMet)."""
+
+
+def enforce(cond, msg="", *args):
+    if not cond:
+        raise EnforceError(msg % args if args else str(msg))
+
+
+def enforce_eq(a, b, msg=""):
+    if a != b:
+        raise EnforceError(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_ne(a, b, msg=""):
+    if a == b:
+        raise EnforceError(f"Expected {a!r} != {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg=""):
+    if not a > b:
+        raise EnforceError(f"Expected {a!r} > {b!r}. {msg}")
+
+
+def enforce_ge(a, b, msg=""):
+    if not a >= b:
+        raise EnforceError(f"Expected {a!r} >= {b!r}. {msg}")
+
+
+def enforce_lt(a, b, msg=""):
+    if not a < b:
+        raise EnforceError(f"Expected {a!r} < {b!r}. {msg}")
+
+
+def enforce_le(a, b, msg=""):
+    if not a <= b:
+        raise EnforceError(f"Expected {a!r} <= {b!r}. {msg}")
+
+
+def enforce_not_none(x, name="value"):
+    if x is None:
+        raise EnforceError(f"{name} must not be None")
+    return x
+
+
+def enforce_rank(x, rank, name="tensor"):
+    if x.ndim != rank:
+        raise EnforceError(f"{name} must have rank {rank}, got shape {x.shape}")
+    return x
+
+
+def enforce_shape_match(a, b, msg=""):
+    if tuple(a.shape) != tuple(b.shape):
+        raise EnforceError(f"Shape mismatch: {a.shape} vs {b.shape}. {msg}")

@@ -1,0 +1,73 @@
+"""Global framework flags.
+
+Ref: /root/reference/paddle/fluid/platform/flags.cc:33-451 — the reference
+defines ~40 process-level gflags (allocator_strategy, eager_delete_tensor_gb,
+check_nan_inf, cudnn knobs, communicator tuning) exported to Python via
+pybind.cc:1355. Here flags are a plain validated registry; env vars prefixed
+``PT_FLAGS_`` override defaults at import time (mirrors how the reference reads
+FLAGS_* from environment in __bootstrap__).
+
+XLA-level tuning goes through XLA_FLAGS / jax.config — not duplicated here.
+"""
+
+import os
+
+_FLAGS = {}
+_DEFS = {}
+
+
+def define_flag(name, default, help_str=""):
+    _DEFS[name] = (type(default) if default is not None else str, help_str)
+    env = os.environ.get("PT_FLAGS_" + name)
+    if env is not None:
+        ty = _DEFS[name][0]
+        if ty is bool:
+            _FLAGS[name] = env.lower() in ("1", "true", "yes")
+        else:
+            _FLAGS[name] = ty(env)
+    else:
+        _FLAGS[name] = default
+
+
+def get_flag(name):
+    if name not in _FLAGS:
+        raise KeyError(f"Unknown flag: {name}")
+    return _FLAGS[name]
+
+
+def _coerce(ty, v):
+    if v is None or isinstance(v, ty):
+        return v
+    if ty is bool and isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return ty(v)
+
+
+def set_flags(flags_dict):
+    for k, v in flags_dict.items():
+        if k not in _FLAGS:
+            raise KeyError(f"Unknown flag: {k}")
+        _FLAGS[k] = _coerce(_DEFS[k][0], v)
+
+
+def all_flags():
+    return dict(_FLAGS)
+
+
+# --- framework flags (counterparts cited to reference flags.cc) ---
+# ref flags.cc:44 FLAGS_check_nan_inf — validate op outputs for NaN/Inf
+define_flag("check_nan_inf", False, "Check outputs of every op for NaN/Inf.")
+# ref flags.cc:308 allocator_strategy — PJRT owns allocation on TPU; kept for
+# host-staging arena selection
+define_flag("host_pinned_staging", True, "Use pinned host staging buffers.")
+# default compute dtype for AMP-less training
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+# matmul precision on TPU MXU: 'default' | 'high' | 'highest'
+define_flag("matmul_precision", "default", "jax.lax matmul precision.")
+# profiler
+define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
+# data loader
+define_flag("reader_queue_size", 2, "Device prefetch depth for DataLoader.")
+# distributed
+define_flag("dist_heartbeat_interval_s", 10.0, "Heartbeat interval (DCN).")
+define_flag("dist_heartbeat_timeout_s", 300.0, "Peer failure timeout.")

@@ -1,0 +1,86 @@
+"""Captured Program IR.
+
+Ref: /root/reference/paddle/fluid/framework/framework.proto:212 (ProgramDesc →
+BlockDesc → OpDesc/VarDesc) and python/paddle/fluid/framework.py:3459
+(Program). The reference builds programs *op-by-op* through Python API calls,
+serializes them as protobuf, and interprets them with a C++ Executor
+(executor.cc:403 op loop).
+
+TPU-first redesign: a Program is a **traced JAX function** — tracing replaces
+the op-by-op graph builder, a jaxpr replaces BlockDesc, and StableHLO is the
+serialized interchange format (the ProgramDesc equivalent; consumed by the C++
+serving runtime in csrc/). XLA replaces the op-loop interpreter: the whole
+program compiles to one executable, fused and scheduled by the compiler instead
+of by hand (details/*.cc SSA executors).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class Program:
+    """A captured computation: python callable + trace artifacts.
+
+    ``capture`` traces ``fn`` with example args into a ClosedJaxpr (the
+    in-memory IR) and can lower to StableHLO text/bytes for serialization —
+    the counterpart of ProgramDesc serialize/parse (framework.py:3459
+    Program.to_string / parse_from_string).
+    """
+
+    def __init__(self, fn, jaxpr=None, example_args=None, name="program"):
+        self.fn = fn
+        self.jaxpr = jaxpr
+        self.example_args = example_args
+        self.name = name
+        self._compiled = None
+
+    @staticmethod
+    def capture(fn, *example_args, name="program", **example_kwargs):
+        closed = jax.make_jaxpr(lambda *a: fn(*a, **example_kwargs))(*example_args)
+        return Program(fn, jaxpr=closed, example_args=example_args, name=name)
+
+    # --- introspection (OpDesc-level view of the captured graph) ---
+    def ops(self):
+        """List primitive op names in program order (ref: BlockDesc.ops)."""
+        if self.jaxpr is None:
+            raise ValueError("Program not captured; call Program.capture")
+        return [str(eqn.primitive) for eqn in self.jaxpr.jaxpr.eqns]
+
+    def num_ops(self):
+        return len(self.ops())
+
+    def input_avals(self):
+        return [v.aval for v in self.jaxpr.jaxpr.invars]
+
+    def output_avals(self):
+        return [v.aval for v in self.jaxpr.jaxpr.outvars]
+
+    # --- lowering / serialization (ProgramDesc proto equivalent) ---
+    def lower(self, *args, **kwargs):
+        args = args or self.example_args
+        return jax.jit(self.fn).lower(*args, **kwargs)
+
+    def to_stablehlo(self, *args):
+        """StableHLO text — the serialized-IR interchange format."""
+        return self.lower(*args).as_text(dialect="stablehlo")
+
+    def compile(self, *args, donate_argnums=()):
+        if self._compiled is None:
+            self._compiled = jax.jit(self.fn, donate_argnums=donate_argnums)
+        return self._compiled
+
+    def __call__(self, *args, **kwargs):
+        return self.compile()(*args, **kwargs)
+
+
+def flop_estimate(fn, *example_args):
+    """Static FLOP estimate from XLA cost analysis (used by bench/MFU math)."""
+    lowered = jax.jit(fn).lower(*example_args)
+    compiled = lowered.compile()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0

@@ -1,0 +1,125 @@
+"""Ragged / variable-length sequence batching — the LoDTensor equivalent.
+
+Ref: /root/reference/paddle/fluid/framework/lod_tensor.h:52 — the reference
+batches variable-length sequences by concatenating them along dim0 and
+carrying `LoD` (level-of-detail) offset tables; 24 `sequence_ops/` kernels
+consume those offsets (ref: paddle/fluid/operators/sequence_ops/).
+
+TPU-first redesign: XLA wants static shapes, so raggedness is represented as
+  * ``RaggedBatch``: flat values `[total_len, ...]` + int32 `row_lengths`
+    (== LoD level-1 deltas) — host-side container;
+  * on device, either **dense padded + mask** (`to_padded`) for MXU-heavy ops,
+    or **segment-ids** (`segment_ids`) for jax.ops.segment_* reductions.
+Length-bucketing (`bucket_boundaries`) bounds the number of compiled shapes,
+replacing the reference's truly-dynamic LoD at a small padding cost.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RaggedBatch:
+    """Concatenated sequences + per-row lengths (LoD level 1).
+
+    values:      [total_len, ...] flat concatenation of sequences
+    row_lengths: [batch] int32 sequence lengths (sum == total_len)
+    """
+
+    values: jax.Array
+    row_lengths: jax.Array
+
+    def tree_flatten(self):
+        return (self.values, self.row_lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def nrows(self):
+        return self.row_lengths.shape[0]
+
+    def offsets(self):
+        """LoD-style offsets [batch+1] (ref lod_tensor.h LoD vector)."""
+        return jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(self.row_lengths)]
+        ).astype(jnp.int32)
+
+    def segment_ids(self):
+        """[total_len] row index per element — for segment_sum/max pooling."""
+        return jnp.repeat(
+            jnp.arange(self.nrows, dtype=jnp.int32),
+            self.row_lengths,
+            total_repeat_length=self.values.shape[0],
+        )
+
+    def to_padded(self, max_len=None, pad_value=0):
+        """Densify to [batch, max_len, ...] plus a bool mask [batch, max_len].
+
+        Static max_len keeps shapes compile-friendly; defaults to total_len
+        bound (callers training on TPU should pass a bucketed max_len).
+        """
+        max_len = int(max_len) if max_len is not None else int(self.values.shape[0])
+        b = self.nrows
+        offs = self.offsets()[:-1]  # [b]
+        idx = offs[:, None] + jnp.arange(max_len)[None, :]  # [b, max_len]
+        valid = jnp.arange(max_len)[None, :] < self.row_lengths[:, None]
+        idx = jnp.clip(idx, 0, self.values.shape[0] - 1)
+        dense = self.values[idx]
+        if pad_value != 0:
+            shape = valid.shape + (1,) * (dense.ndim - 2)
+            dense = jnp.where(valid.reshape(shape), dense, pad_value)
+        else:
+            shape = valid.shape + (1,) * (dense.ndim - 2)
+            dense = dense * valid.reshape(shape).astype(dense.dtype)
+        return dense, valid
+
+    @staticmethod
+    def from_list(seqs, dtype=None):
+        """Host-side construction from a list of numpy arrays / lists."""
+        arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+        enforce(len(arrs) > 0, "empty ragged batch")
+        values = np.concatenate(arrs, axis=0)
+        lengths = np.array([a.shape[0] for a in arrs], np.int32)
+        return RaggedBatch(jnp.asarray(values), jnp.asarray(lengths))
+
+    @staticmethod
+    def from_padded(dense, lengths):
+        """Inverse of to_padded: gather valid positions to a flat buffer.
+
+        Host-side (concrete lengths) — under jit keep the padded+mask form
+        instead; true raggedness needs a concrete total length.
+        """
+        b, m = dense.shape[:2]
+        lengths = jnp.asarray(lengths, jnp.int32)
+        valid = jnp.arange(m)[None, :] < lengths[:, None]
+        flat = dense.reshape((b * m,) + dense.shape[2:])
+        order = jnp.argsort(~valid.reshape(-1), stable=True)
+        total = int(jnp.sum(lengths))
+        return RaggedBatch(flat[order][:total], lengths)
+
+
+def bucket_boundaries(max_len, num_buckets=8):
+    """Geometric length buckets to bound compiled-shape count (replaces the
+    reference's fully dynamic LoD shapes)."""
+    bounds = []
+    b = max(8, max_len // (2 ** (num_buckets - 1)))
+    while b < max_len:
+        bounds.append(b)
+        b *= 2
+    bounds.append(max_len)
+    return bounds
+
+
+def bucket_for(length, boundaries):
+    for b in boundaries:
+        if length <= b:
+            return b
+    return boundaries[-1]

@@ -1,0 +1,35 @@
+"""Global seed / PRNG key management.
+
+JAX uses explicit functional PRNG keys; the reference uses global generator
+state (paddle/fluid/framework via Place-level generators, python
+fluid.default_startup_program().random_seed). We provide a tiny global
+key-stream for imperative convenience while keeping all library code
+explicit-key underneath.
+"""
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+
+
+def seed(s):
+    """Set the global seed (ref: fluid.Program.random_seed)."""
+    _state.key = jax.random.key(s)
+
+
+def next_key(n=None):
+    """Split the global key-stream; returns one key or a list of n keys."""
+    _ensure()
+    if n is None:
+        _state.key, sub = jax.random.split(_state.key)
+        return sub
+    keys = jax.random.split(_state.key, n + 1)
+    _state.key = keys[0]
+    return list(keys[1:])

@@ -1,0 +1,56 @@
+"""Op registry.
+
+Ref: /root/reference/paddle/fluid/framework/op_registry.h:199
+(REGISTER_OPERATOR) and op_info.h:93 (OpInfoMap singleton). The reference
+needs a registry to map serialized OpDesc names to kernels per
+(place, dtype, layout, library). On TPU, XLA owns kernel selection; the
+registry's remaining job is *serializability*: captured Programs name ops, and
+the loader must resolve names back to callables (see core/program.py and
+io/inference.py). It also powers introspection (`list_ops`) for parity audits
+against the reference's ~480 op surface.
+"""
+
+import functools
+
+
+class OpRegistry:
+    """Name → callable registry with per-op metadata."""
+
+    def __init__(self):
+        self._ops = {}
+
+    def register(self, name, fn=None, **meta):
+        if fn is None:
+            return functools.partial(self.register, name, **meta)
+        if name in self._ops:
+            raise KeyError(f"Op '{name}' already registered")
+        self._ops[name] = (fn, meta)
+        return fn
+
+    def get(self, name):
+        if name not in self._ops:
+            raise KeyError(f"Op '{name}' is not registered")
+        return self._ops[name][0]
+
+    def meta(self, name):
+        return self._ops[name][1]
+
+    def __contains__(self, name):
+        return name in self._ops
+
+    def list_ops(self):
+        return sorted(self._ops)
+
+
+GLOBAL_OP_REGISTRY = OpRegistry()
+
+
+def register_op(name, **meta):
+    """Decorator: register a function as a named framework op.
+
+    Usage::
+
+        @register_op("softmax")
+        def softmax(x, axis=-1): ...
+    """
+    return GLOBAL_OP_REGISTRY.register(name, **meta)

@@ -1,0 +1,11 @@
+"""Data pipeline (ref: python/paddle/fluid/reader.py, dataset.py,
+framework/data_feed.cc)."""
+
+from paddle_tpu.data.loader import DataLoader, batch, shuffle
+from paddle_tpu.data.dataset import (
+    InMemoryDataset,
+    synthetic_ctr,
+    synthetic_images,
+    synthetic_mnist,
+    synthetic_tokens,
+)

@@ -1,0 +1,72 @@
+"""In-memory datasets + synthetic data for tests/benchmarks.
+
+Ref: /root/reference/python/paddle/fluid/dataset.py (InMemoryDataset /
+QueueDataset for PS training over files) and python/paddle/dataset/* builtin
+dataset loaders. Here: a light InMemoryDataset with global-shuffle semantics
+plus synthetic generators used by tests and bench.py (no network egress).
+"""
+
+import numpy as np
+
+
+class InMemoryDataset:
+    """ref: dataset.py InMemoryDataset — load → (global) shuffle → iterate.
+    The reference shuffles via fleet RPC across trainers; here shuffling is
+    host-local per process, and multi-host global shuffle is done by seeding
+    identically and partitioning by rank (ref: data_set.cc global_shuffle)."""
+
+    def __init__(self, samples=None):
+        self._samples = list(samples) if samples is not None else []
+
+    def load(self, samples):
+        self._samples.extend(samples)
+
+    def global_shuffle(self, seed=0, rank=0, world=1):
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(len(self._samples))
+        part = idx[rank::world]
+        self._samples = [self._samples[i] for i in part]
+        return self
+
+    def reader(self):
+        def r():
+            yield from self._samples
+        return r
+
+    def __len__(self):
+        return len(self._samples)
+
+
+def synthetic_images(n, shape=(3, 32, 32), num_classes=10, seed=0):
+    """CIFAR-like synthetic stream (tests/bench; the reference's book tests
+    download CIFAR — zero-egress here)."""
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        yield (rng.rand(*shape).astype(np.float32),
+               rng.randint(num_classes, size=(1,)).astype(np.int64))
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        yield (rng.rand(1, 28, 28).astype(np.float32),
+               rng.randint(10, size=(1,)).astype(np.int64))
+
+
+def synthetic_tokens(n, seq_len=128, vocab=30522, seed=0):
+    """BERT-like token stream."""
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        ids = rng.randint(vocab, size=(seq_len,)).astype(np.int32)
+        yield (ids,)
+
+
+def synthetic_ctr(n, num_sparse=26, num_dense=13, vocab=10000, seed=0):
+    """Criteo-like CTR stream for DeepFM/Wide&Deep (ref: dist_ctr.py
+    fixture)."""
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        dense = rng.rand(num_dense).astype(np.float32)
+        sparse = rng.randint(vocab, size=(num_sparse,)).astype(np.int32)
+        label = rng.randint(2, size=(1,)).astype(np.float32)
+        yield (dense, sparse, label)

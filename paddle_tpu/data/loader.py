@@ -1,0 +1,171 @@
+"""Data loading with device prefetch.
+
+Ref: /root/reference/python/paddle/fluid/reader.py:73 (DataLoader.
+from_generator), :298 GeneratorLoader feeding a C++
+LoDTensorBlockingQueue (pybind.cc:893), and the double-buffer device
+prefetch reader (operators/reader/create_double_buffer_reader_op.cc).
+
+TPU-first: a background thread pulls host batches and `device_put`s them
+ahead of consumption (depth = reader_queue_size flag) — same double-buffer
+overlap, no C++ queue needed since PJRT transfers are async. Under a mesh,
+batches go straight to their data-parallel sharding.
+"""
+
+import collections
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.flags import get_flag
+
+
+class DataLoader:
+    """Iterable loader over a sample generator with batching + prefetch.
+
+    from_generator mirrors the reference API: feed a python generator of
+    numpy samples (tuples), get device-resident batches.
+    """
+
+    def __init__(self, batch_reader, places=None, prefetch=None, mesh=None,
+                 sharding_axis="dp", drop_last=True):
+        self._batch_reader = batch_reader
+        self._prefetch = prefetch or get_flag("reader_queue_size")
+        self._mesh = mesh
+        self._axis = sharding_axis
+
+    @staticmethod
+    def from_generator(generator=None, batch_size=None, shuffle=False,
+                       shuffle_buffer=1024, seed=0, mesh=None, prefetch=None,
+                       drop_last=True):
+        """Build from a per-sample generator fn (ref: reader.py
+        DataLoader.from_generator + set_sample_generator)."""
+        def batch_reader():
+            rng = np.random.RandomState(seed)
+            buf = []
+            pool = []
+            it = generator()
+            for sample in it:
+                if shuffle:
+                    pool.append(sample)
+                    if len(pool) >= shuffle_buffer:
+                        idx = rng.randint(len(pool))
+                        buf.append(pool.pop(idx))
+                else:
+                    buf.append(sample)
+                if len(buf) == batch_size:
+                    yield _collate(buf)
+                    buf = []
+            while pool:
+                idx = rng.randint(len(pool)) if shuffle else 0
+                buf.append(pool.pop(idx))
+                if len(buf) == batch_size:
+                    yield _collate(buf)
+                    buf = []
+            if buf and not drop_last:
+                yield _collate(buf)
+
+        return DataLoader(batch_reader, mesh=mesh, prefetch=prefetch,
+                          drop_last=drop_last)
+
+    @staticmethod
+    def from_batch_generator(generator, mesh=None, prefetch=None):
+        """ref: reader.py set_batch_generator"""
+        return DataLoader(generator, mesh=mesh, prefetch=prefetch)
+
+    def _place(self, batch):
+        if self._mesh is not None:
+            from paddle_tpu.parallel.api import shard_batch
+            return shard_batch(self._mesh, batch, self._axis)
+        return jax.tree_util.tree_map(jax.device_put, batch)
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self._prefetch)
+        stop = object()
+        cancelled = threading.Event()
+        err = []
+
+        def worker():
+            try:
+                for batch in self._batch_reader():
+                    placed = self._place(batch)
+                    # bounded put that notices consumer cancellation, so an
+                    # early `break` in the consumer can't leave this thread
+                    # blocked holding device buffers
+                    while not cancelled.is_set():
+                        try:
+                            q.put(placed, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancelled.is_set():
+                        return
+            except Exception as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                try:
+                    q.put_nowait(stop)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                yield item
+        finally:
+            cancelled.set()
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
+        if err:
+            raise err[0]
+
+
+def _collate(samples):
+    """Stack a list of tuple-samples into batched numpy arrays."""
+    if isinstance(samples[0], (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples])
+                     for i in range(len(samples[0])))
+    if isinstance(samples[0], dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples])
+                for k in samples[0]}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Compose a sample reader into a batch reader (ref:
+    python/paddle/batch.py)."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield _collate(buf)
+                buf = []
+        if buf and not drop_last:
+            yield _collate(buf)
+    return batch_reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    """ref: paddle.reader.shuffle decorator"""
+    def shuffled():
+        rng = np.random.RandomState(seed)
+        pool = []
+        for s in reader():
+            pool.append(s)
+            if len(pool) >= buf_size:
+                rng.shuffle(pool)
+                yield from pool
+                pool = []
+        rng.shuffle(pool)
+        yield from pool
+    return shuffled

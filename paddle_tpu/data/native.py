@@ -1,0 +1,134 @@
+"""ctypes binding for the native data pipeline (csrc/dataio).
+
+Ref: /root/reference/paddle/fluid/framework/data_feed.cc — the reference's
+C++ reader threads feed channels consumed by device workers; pybind exposes
+the queues (pybind.cc:893 LoDTensorBlockingQueue). Here the native library
+exposes a C ABI consumed via ctypes — record files stream through C++ reader
+threads into a bounded ring, off the GIL.
+
+Build: cd csrc && cmake -B build -G Ninja && ninja -C build
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cands = [
+        os.path.join(here, "csrc", "build", "libptdataio.so"),
+        os.environ.get("PT_DATAIO_LIB", ""),
+    ]
+    for c in cands:
+        if c and os.path.exists(c):
+            return c
+    return None
+
+
+def available():
+    return _find_lib() is not None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        path = _find_lib()
+        if path is None:
+            raise RuntimeError(
+                "libptdataio.so not built; run: cd csrc && cmake -B build "
+                "-G Ninja && ninja -C build")
+        lib = ctypes.CDLL(path)
+        lib.ptdio_create.restype = ctypes.c_void_p
+        lib.ptdio_create.argtypes = [ctypes.c_uint64]
+        lib.ptdio_add_file.restype = ctypes.c_int
+        lib.ptdio_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptdio_start.restype = ctypes.c_int
+        lib.ptdio_start.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_uint64]
+        lib.ptdio_next.restype = ctypes.c_int64
+        lib.ptdio_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_uint64]
+        lib.ptdio_destroy.restype = None
+        lib.ptdio_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptdio_write_records.restype = ctypes.c_int
+        lib.ptdio_write_records.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+        _LIB = lib
+    return _LIB
+
+
+def write_record_file(path, records):
+    """Write a list of bytes objects as a record file."""
+    lib = _lib()
+    blob = b"".join(records)
+    lens = (ctypes.c_uint64 * len(records))(*[len(r) for r in records])
+    buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+    rc = lib.ptdio_write_records(path.encode(), buf, lens, len(records))
+    if rc != 0:
+        raise IOError(f"cannot write {path}")
+
+
+class NativeRecordReader:
+    """Iterate records from files via the C++ threaded pipeline.
+
+    ref: MultiSlotDataFeed file→channel flow (data_feed.cc); use
+    `num_threads` readers and a bounded `capacity` ring.
+    """
+
+    def __init__(self, files, num_threads=2, epochs=1, capacity=1024,
+                 shuffle_seed=0, max_record_bytes=1 << 22):
+        lib = _lib()
+        self._lib = lib
+        self._h = lib.ptdio_create(capacity)
+        for f in files:
+            if lib.ptdio_add_file(self._h, f.encode()) != 0:
+                lib.ptdio_destroy(self._h)
+                self._h = None
+                raise IOError(f"cannot open {f}")
+        rc = lib.ptdio_start(self._h, num_threads, epochs, shuffle_seed)
+        if rc != 0:
+            lib.ptdio_destroy(self._h)
+            self._h = None
+            raise RuntimeError("ptdio_start failed (no files?)")
+        self._buf = (ctypes.c_uint8 * max_record_bytes)()
+        self._cap = max_record_bytes
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = self._lib.ptdio_next(self._h, self._buf, self._cap)
+        if n == -2:
+            raise StopIteration
+        if n < 0:
+            raise IOError("native reader error (record too large or bad file)")
+        return bytes(bytearray(self._buf[:n]))
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ptdio_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+def numpy_records(arrays):
+    """Pack a tuple-of-ndarrays sample into one record (npz-free fast path)."""
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, *arrays)
+    return buf.getvalue()
+
+
+def unpack_numpy_record(rec):
+    import io as _io
+    with np.load(_io.BytesIO(rec)) as z:
+        return tuple(z[k] for k in z.files)

@@ -1,0 +1,117 @@
+"""Parameter initializers.
+
+Ref: /root/reference/python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArrayInitializer).
+Each initializer is `fn(key, shape, dtype) -> array` — explicit PRNG keys
+(TPU counter-based RNG, reproducible under pjit).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtype import convert_dtype
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels OIHW: receptive = prod(spatial)
+    receptive = int(np.prod(shape[2:]))
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+def constant(value=0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, convert_dtype(dtype))
+    return init
+
+
+def zeros():
+    return constant(0.0)
+
+
+def ones():
+    return constant(1.0)
+
+
+def uniform(low=-1.0, high=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, convert_dtype(dtype), low, high)
+    return init
+
+
+def normal(loc=0.0, scale=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return loc + scale * jax.random.normal(key, shape, convert_dtype(dtype))
+    return init
+
+
+def truncated_normal(loc=0.0, scale=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        return loc + scale * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, convert_dtype(dtype))
+    return init
+
+
+def xavier(uniform_=True, fan_in=None, fan_out=None):
+    """ref: initializer.py XavierInitializer"""
+    def init(key, shape, dtype=jnp.float32):
+        fi, fo = _fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        fo = fan_out if fan_out is not None else fo
+        if uniform_:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return jax.random.uniform(key, shape, convert_dtype(dtype),
+                                      -limit, limit)
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, convert_dtype(dtype))
+    return init
+
+
+def msra(uniform_=False, fan_in=None):
+    """Kaiming/He (ref: initializer.py MSRAInitializer)."""
+    def init(key, shape, dtype=jnp.float32):
+        fi, _ = _fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        if uniform_:
+            limit = math.sqrt(6.0 / fi)
+            return jax.random.uniform(key, shape, convert_dtype(dtype),
+                                      -limit, limit)
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(key, shape, convert_dtype(dtype))
+    return init
+
+
+def bilinear():
+    """Bilinear upsampling kernel for conv_transpose (ref: initializer.py
+    BilinearInitializer)."""
+    def init(key, shape, dtype=jnp.float32):
+        # shape: [C, C', kh, kw]
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w)))
+        w = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            w[i, i] = filt
+        return jnp.asarray(w, convert_dtype(dtype))
+    return init
+
+
+def numpy_array(arr):
+    def init(key, shape, dtype=jnp.float32):
+        a = jnp.asarray(arr, convert_dtype(dtype))
+        assert tuple(a.shape) == tuple(shape), (a.shape, shape)
+        return a
+    return init

@@ -1,0 +1,13 @@
+"""I/O: checkpointing + inference export (ref: python/paddle/fluid/io.py)."""
+
+from paddle_tpu.io.checkpoint import (
+    CheckpointManager,
+    latest_step,
+    load_persistables,
+    save_persistables,
+)
+from paddle_tpu.io.inference import (
+    Predictor,
+    load_inference_model,
+    save_inference_model,
+)

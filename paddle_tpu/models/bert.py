@@ -1,0 +1,130 @@
+"""BERT — transformer encoder for masked-LM pretraining.
+
+Ref: BASELINE.md flagship "BERT-base pretraining (PaddleNLP Fluid bert/
+recipe)". The reference frames it over fluid.layers (multi_head_attention in
+layers/nn.py + ERNIE-style recipes); here it's a first-class model with
+flash-attention, bf16 policy support, and mesh-shardable params.
+
+Sharding plan (parallel/api.py + models/sharding.py): embeddings and FFN
+weights shard over "tp"; sequence dim over "sp" with ring attention for
+long-context.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import loss as L
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    use_flash: bool = False
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=128)
+
+    @staticmethod
+    def large():
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096)
+
+
+class TransformerLayer(nn.Module):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size, cfg.num_heads,
+                                          dropout=cfg.dropout,
+                                          use_flash=cfg.use_flash)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, mask=None):
+        h = self.attn(x, mask=mask)
+        x = self.ln1(x + self.drop(h))
+        h = self.fc2(A.gelu(self.fc1(x)))
+        x = self.ln2(x + self.drop(h))
+        return x
+
+
+class BertEncoder(nn.Module):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.pos_emb = nn.Embedding(cfg.max_position, cfg.hidden_size)
+        self.seg_emb = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.emb_ln = nn.LayerNorm(cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.layers = [TransformerLayer(cfg) for _ in range(cfg.num_layers)]
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        b, t = input_ids.shape
+        pos = jnp.arange(t)[None, :]
+        x = self.tok_emb(input_ids) + self.pos_emb(pos)
+        if token_type_ids is not None:
+            x = x + self.seg_emb(token_type_ids)
+        x = self.drop(self.emb_ln(x))
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :]  # [B,1,1,T]
+        for layer in self.layers:
+            x = layer(x, mask=mask)
+        return x
+
+
+class BertForPretraining(nn.Module):
+    """MLM + NSP heads (ref: the Fluid BERT recipe's create_model)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.encoder = BertEncoder(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                       act="gelu")
+        self.mlm_ln = nn.LayerNorm(cfg.hidden_size)
+        self.param("mlm_bias", (cfg.vocab_size,), I.zeros())
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.encoder(input_ids, token_type_ids, attention_mask)
+        mlm_h = self.mlm_ln(self.mlm_transform(h))
+        # weight tying with token embedding (standard BERT)
+        emb = self.encoder.tok_emb.p("weight")
+        mlm_logits = mlm_h @ emb.T + self.p("mlm_bias")
+        pooled = self.pooler(h[:, 0])
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                  mlm_mask):
+    """Masked-LM + NSP loss. mlm_mask: 1.0 at masked positions."""
+    mlm = L.softmax_with_cross_entropy(mlm_logits, mlm_labels[..., None])
+    mlm = jnp.sum(mlm[..., 0] * mlm_mask) / jnp.maximum(jnp.sum(mlm_mask), 1)
+    nsp = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,
+                                                nsp_labels[..., None]))
+    return mlm + nsp

@@ -1,0 +1,115 @@
+"""CTR models: DeepFM and Wide&Deep — the sparse-embedding flagship path.
+
+Ref: BASELINE.md "DeepFM / Wide&Deep CTR (sparse embedding + pserver
+distributed path)" and the reference's CTR fixture
+(/root/reference/python/paddle/fluid/tests/unittests/dist_ctr.py — embedding
++ fc over sparse slots trained against pservers).
+
+TPU-first: embedding tables shard over the "ep" mesh axis via
+parallel/embedding.py (the pserver-shard successor) or run dense on one
+chip; the model code is identical either way.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import loss as L
+
+
+@dataclasses.dataclass
+class CTRConfig:
+    num_sparse_fields: int = 26
+    num_dense_fields: int = 13
+    vocab_size: int = 10000       # per-field hash size
+    embed_dim: int = 16
+    hidden: tuple = (400, 400, 400)
+
+    @staticmethod
+    def tiny():
+        return CTRConfig(num_sparse_fields=4, num_dense_fields=3,
+                         vocab_size=100, embed_dim=8, hidden=(32, 16))
+
+
+class DeepFM(nn.Module):
+    """FM (1st+2nd order) + DNN over shared embeddings."""
+
+    def __init__(self, cfg: CTRConfig):
+        super().__init__()
+        self.cfg = cfg
+        # one shared table across fields; ids offset per field by caller or
+        # hashed into one space (reference dist_ctr uses per-slot tables;
+        # single offset table shards better on TPU)
+        self.embed = nn.Embedding(cfg.vocab_size * cfg.num_sparse_fields,
+                                  cfg.embed_dim,
+                                  weight_init=I.normal(0, 0.01))
+        self.fm_linear = nn.Embedding(cfg.vocab_size * cfg.num_sparse_fields,
+                                      1, weight_init=I.zeros())
+        self.dense_linear = nn.Linear(cfg.num_dense_fields, 1)
+        dnn_in = cfg.num_sparse_fields * cfg.embed_dim + cfg.num_dense_fields
+        layers = []
+        for h in cfg.hidden:
+            layers.append(nn.Linear(dnn_in, h, act="relu"))
+            dnn_in = h
+        self.dnn = nn.Sequential(layers)
+        self.dnn_out = nn.Linear(dnn_in, 1)
+
+    def _offset_ids(self, sparse_ids):
+        offsets = jnp.arange(self.cfg.num_sparse_fields) * self.cfg.vocab_size
+        return sparse_ids + offsets[None, :]
+
+    def forward(self, dense, sparse_ids):
+        """dense [B, D_dense]; sparse_ids [B, F] per-field ids."""
+        ids = self._offset_ids(sparse_ids)
+        emb = self.embed(ids)                      # [B, F, K]
+        # FM first order
+        first = jnp.sum(self.fm_linear(ids), axis=(1, 2), keepdims=False)
+        first = first[:, None] + self.dense_linear(dense)
+        # FM second order: 0.5 * ((sum v)^2 - sum v^2)
+        sum_v = jnp.sum(emb, axis=1)
+        sum_sq = jnp.sum(jnp.square(emb), axis=1)
+        second = 0.5 * jnp.sum(jnp.square(sum_v) - sum_sq, axis=1,
+                               keepdims=True)
+        # DNN
+        flat = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=1)
+        deep = self.dnn_out(self.dnn(flat))
+        return first + second + deep               # logits [B, 1]
+
+
+class WideAndDeep(nn.Module):
+    """ref: wide_deep CTR pattern (linear wide part + DNN deep part)."""
+
+    def __init__(self, cfg: CTRConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wide = nn.Embedding(cfg.vocab_size * cfg.num_sparse_fields, 1,
+                                 weight_init=I.zeros())
+        self.wide_dense = nn.Linear(cfg.num_dense_fields, 1)
+        self.embed = nn.Embedding(cfg.vocab_size * cfg.num_sparse_fields,
+                                  cfg.embed_dim,
+                                  weight_init=I.normal(0, 0.01))
+        dnn_in = cfg.num_sparse_fields * cfg.embed_dim + cfg.num_dense_fields
+        layers = []
+        for h in cfg.hidden:
+            layers.append(nn.Linear(dnn_in, h, act="relu"))
+            dnn_in = h
+        self.dnn = nn.Sequential(layers)
+        self.dnn_out = nn.Linear(dnn_in, 1)
+
+    def forward(self, dense, sparse_ids):
+        offsets = jnp.arange(self.cfg.num_sparse_fields) * self.cfg.vocab_size
+        ids = sparse_ids + offsets[None, :]
+        wide = jnp.sum(self.wide(ids), axis=(1, 2))[:, None] \
+            + self.wide_dense(dense)
+        emb = self.embed(ids).reshape(ids.shape[0], -1)
+        deep = self.dnn_out(self.dnn(jnp.concatenate([emb, dense], 1)))
+        return wide + deep
+
+
+def ctr_loss(logits, labels):
+    """Sigmoid CE (ref: dist_ctr.py uses cross_entropy over softmax; modern
+    CTR uses logistic loss)."""
+    return jnp.mean(L.sigmoid_cross_entropy_with_logits(logits, labels))

@@ -1,0 +1,46 @@
+"""MNIST models — the minimal end-to-end fixtures.
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py
+(softmax_regression, multilayer_perceptron, convolutional_neural_network —
+the reference's e2e smoke models) and nets.py simple_img_conv_pool.
+"""
+
+from paddle_tpu import nn
+from paddle_tpu.ops import nn as F
+
+
+class SoftmaxRegression(nn.Module):
+    def __init__(self, num_classes=10, in_dim=784):
+        super().__init__()
+        self.fc = nn.Linear(in_dim, num_classes)
+
+    def forward(self, x):
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class MLP(nn.Module):
+    """ref: multilayer_perceptron in test_recognize_digits.py"""
+
+    def __init__(self, num_classes=10, in_dim=784):
+        super().__init__()
+        self.fc1 = nn.Linear(in_dim, 128, act="relu")
+        self.fc2 = nn.Linear(128, 64, act="relu")
+        self.fc3 = nn.Linear(64, num_classes)
+
+    def forward(self, x):
+        return self.fc3(self.fc2(self.fc1(x.reshape(x.shape[0], -1))))
+
+
+class ConvNet(nn.Module):
+    """ref: convolutional_neural_network / simple_img_conv_pool"""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 20, 5, act="relu")
+        self.conv2 = nn.Conv2D(20, 50, 5, act="relu")
+        self.fc = nn.Linear(50 * 4 * 4, num_classes)
+
+    def forward(self, x):
+        x = F.pool2d(self.conv1(x), 2, "max", 2)
+        x = F.pool2d(self.conv2(x), 2, "max", 2)
+        return self.fc(x.reshape(x.shape[0], -1))

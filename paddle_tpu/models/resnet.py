@@ -1,0 +1,114 @@
+"""ResNet family (ResNet-18/34/50/101/152).
+
+Ref: the reference ships ResNet as a *model recipe* over fluid.layers
+(/root/reference/python/paddle/fluid/tests/unittests/dist_se_resnext.py and
+tests/book image_classification — conv_bn_layer + bottleneck patterns).
+BASELINE.md flagship: ResNet-50 ImageNet throughput.
+
+TPU-first: NCHW inputs accepted but compute can run bf16 via amp.Policy;
+XLA's layout assignment handles the HWCN internals. BN state functional.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import nn as F
+
+
+class ConvBN(nn.Module):
+    def __init__(self, cin, cout, k, stride=1, act="relu", groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups, bias=False,
+                              weight_init=I.msra())
+        self.bn = nn.BatchNorm(cout, act=act)
+
+    def forward(self, x):
+        return self.bn(self.conv(x))
+
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = ConvBN(cin, cout, 3, stride)
+        self.conv2 = ConvBN(cout, cout, 3, act=None)
+        self.short = None
+        if stride != 1 or cin != cout:
+            self.short = ConvBN(cin, cout, 1, stride, act=None)
+
+    def forward(self, x):
+        out = self.conv2(self.conv1(x))
+        sc = self.short(x) if self.short is not None else x
+        return jnp.maximum(out + sc, 0)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = ConvBN(cin, width, 1)
+        self.conv2 = ConvBN(width, width, 3, stride)
+        self.conv3 = ConvBN(width, cout, 1, act=None)
+        self.short = None
+        if stride != 1 or cin != cout:
+            self.short = ConvBN(cin, cout, 1, stride, act=None)
+
+    def forward(self, x):
+        out = self.conv3(self.conv2(self.conv1(x)))
+        sc = self.short(x) if self.short is not None else x
+        return jnp.maximum(out + sc, 0)
+
+
+_CONFIGS = {
+    18: (BasicBlock, [2, 2, 2, 2]),
+    34: (BasicBlock, [3, 4, 6, 3]),
+    50: (Bottleneck, [3, 4, 6, 3]),
+    101: (Bottleneck, [3, 4, 23, 3]),
+    152: (Bottleneck, [3, 8, 36, 3]),
+}
+
+
+class ResNet(nn.Module):
+    def __init__(self, depth=50, num_classes=1000, small_input=False):
+        super().__init__()
+        block, layers = _CONFIGS[depth]
+        self.small_input = small_input
+        if small_input:  # CIFAR-style stem (ref: tests/book resnet_cifar10)
+            self.stem = ConvBN(3, 64, 3)
+        else:
+            self.stem = ConvBN(3, 64, 7, stride=2)
+        stages = []
+        cin = 64
+        for i, n in enumerate(layers):
+            width = 64 * (2 ** i)
+            blocks = []
+            for j in range(n):
+                stride = 2 if (j == 0 and i > 0) else 1
+                blocks.append(block(cin, width, stride))
+                cin = width * block.expansion
+            stages.append(nn.Sequential(blocks))
+        self.stages = stages  # becomes ModuleList
+        self.fc = nn.Linear(cin, num_classes,
+                            weight_init=I.uniform(-0.01, 0.01))
+
+    def forward(self, x):
+        x = self.stem(x)
+        if not self.small_input:
+            x = F.pool2d(x, 3, "max", 2, padding=1)
+        for stage in self.stages:
+            x = stage(x)
+        x = F.pool2d(x, pool_type="avg", global_pooling=True)
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(50, num_classes, **kw)
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(18, num_classes, **kw)

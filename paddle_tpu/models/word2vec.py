@@ -1,0 +1,57 @@
+"""word2vec (skip-gram with negative sampling / NCE).
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_word2vec.py and
+unittests/dist_word2vec.py — the reference's book model uses a small
+N-gram LM with shared embeddings; dist variant trains embeddings against
+pservers. Here: N-gram LM forward + NCE loss path (ops/loss.py nce_loss).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import loss as L
+
+
+class Word2Vec(nn.Module):
+    """N-gram LM: concat(context embeddings) → fc → softmax over vocab
+    (ref: test_word2vec.py network)."""
+
+    def __init__(self, vocab_size=2048, embed_dim=32, context=4,
+                 hidden=256):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.embed = nn.Embedding(vocab_size, embed_dim,
+                                  weight_init=I.uniform(-0.5 / embed_dim,
+                                                        0.5 / embed_dim))
+        self.fc1 = nn.Linear(context * embed_dim, hidden, act="sigmoid")
+        self.fc2 = nn.Linear(hidden, vocab_size)
+
+    def forward(self, context_ids):
+        """context_ids [B, C] -> logits [B, V]."""
+        e = self.embed(context_ids)
+        flat = e.reshape(e.shape[0], -1)
+        return self.fc2(self.fc1(flat))
+
+
+def lm_loss(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))
+
+
+class SkipGramNCE(nn.Module):
+    """Skip-gram trained with NCE (ref: nce usage in fluid layers)."""
+
+    def __init__(self, vocab_size=2048, embed_dim=64, num_neg=16):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.num_neg = num_neg
+        self.embed = nn.Embedding(vocab_size, embed_dim,
+                                  weight_init=I.uniform(-0.05, 0.05))
+        self.param("nce_weight", (vocab_size, embed_dim), I.normal(0, 0.01))
+        self.param("nce_bias", (vocab_size,), I.zeros())
+
+    def forward(self, center_ids, target_ids):
+        h = self.embed(center_ids)  # [B, D]
+        return L.nce_loss(self.rng("nce"), h, target_ids,
+                          self.p("nce_weight"), self.p("nce_bias"),
+                          self.vocab_size, self.num_neg)

@@ -1,0 +1,28 @@
+"""Layer/Module API — dygraph parity, functional core.
+
+Ref: /root/reference/python/paddle/fluid/dygraph/ (layers.py Layer,
+nn.py modules). See nn/module.py for the programming model.
+"""
+
+from paddle_tpu.nn.module import Module, ModuleList, Sequential
+from paddle_tpu.nn.layers import (
+    BatchNorm,
+    BilinearTensorProduct,
+    Conv2D,
+    Conv2DTranspose,
+    Dropout,
+    Embedding,
+    GRU,
+    GroupNorm,
+    LSTM,
+    LayerNorm,
+    Linear,
+    MultiHeadAttention,
+    Pool2D,
+    PRelu,
+    RMSNorm,
+    SpectralNorm,
+    SyncBatchNorm,
+)
+
+Layer = Module  # reference naming alias (dygraph.Layer)

@@ -1,0 +1,415 @@
+"""Standard layers — counterpart of the reference's dygraph nn modules and
+static `fluid.layers` builders.
+
+Ref: /root/reference/python/paddle/fluid/dygraph/nn.py:35-2930 (Conv2D,
+Pool2D, FC, BatchNorm, Embedding, GRUUnit, LayerNorm, NCE, PRelu,
+BilinearTensorProduct, Conv2DTranspose, SequenceConv, GroupNorm,
+SpectralNorm, TreeConv) and python/paddle/fluid/layers/nn.py.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu.nn.module import Module
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import nn as F
+from paddle_tpu.ops import rnn as R
+
+
+def _act(name, x):
+    if name is None:
+        return x
+    return getattr(A, name)(x)
+
+
+class Linear(Module):
+    """ref: dygraph/nn.py FC / Linear."""
+
+    def __init__(self, in_features, out_features, bias=True, act=None,
+                 weight_init=None, bias_init=None, dtype=jnp.float32):
+        super().__init__()
+        self.act = act
+        self.has_bias = bias
+        self.param("weight", (in_features, out_features),
+                   weight_init or I.xavier(), dtype)
+        if bias:
+            self.param("bias", (out_features,), bias_init or I.zeros(), dtype)
+
+    def forward(self, x):
+        out = x @ self.p("weight")
+        if self.has_bias:
+            out = out + self.p("bias")
+        return _act(self.act, out)
+
+
+class Conv2D(Module):
+    """ref: dygraph/nn.py Conv2D — weight OIHW."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias=True, act=None,
+                 weight_init=None, dtype=jnp.float32):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.act = act
+        self.has_bias = bias
+        self.param("weight", (out_channels, in_channels // groups) + k,
+                   weight_init or I.msra(), dtype)
+        if bias:
+            self.param("bias", (out_channels,), I.zeros(), dtype)
+
+    def forward(self, x):
+        out = F.conv2d(x, self.p("weight"),
+                       self.p("bias") if self.has_bias else None,
+                       self.stride, self.padding, self.dilation, self.groups)
+        return _act(self.act, out)
+
+
+class Conv2DTranspose(Module):
+    """ref: dygraph/nn.py Conv2DTranspose — weight [in, out/groups, kh, kw]."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1, bias=True,
+                 act=None, weight_init=None, dtype=jnp.float32):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.output_padding = output_padding
+        self.act = act
+        self.has_bias = bias
+        self.param("weight", (in_channels, out_channels // groups) + k,
+                   weight_init or I.xavier(), dtype)
+        if bias:
+            self.param("bias", (out_channels,), I.zeros(), dtype)
+
+    def forward(self, x):
+        out = F.conv2d_transpose(
+            x, self.p("weight"), self.p("bias") if self.has_bias else None,
+            self.stride, self.padding, self.output_padding, self.dilation,
+            self.groups)
+        return _act(self.act, out)
+
+
+class BatchNorm(Module):
+    """ref: dygraph/nn.py BatchNorm + operators/batch_norm_op.cc. Running
+    stats live in the 'state' collection, updated functionally."""
+
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5, act=None,
+                 data_format="NCHW", dtype=jnp.float32):
+        super().__init__()
+        self.momentum, self.epsilon, self.act = momentum, epsilon, act
+        self.data_format = data_format
+        self.param("scale", (num_channels,), I.ones(), dtype)
+        self.param("bias", (num_channels,), I.zeros(), dtype)
+        self.state("mean", (num_channels,), I.zeros(), jnp.float32)
+        self.state("variance", (num_channels,), I.ones(), jnp.float32)
+
+    def forward(self, x):
+        out, new_mean, new_var = F.batch_norm(
+            x, self.p("scale"), self.p("bias"), self.s("mean"),
+            self.s("variance"), self.epsilon, self.momentum,
+            training=self.training, data_format=self.data_format)
+        if self.training:
+            self.update_state("mean", new_mean)
+            self.update_state("variance", new_var)
+        return _act(self.act, out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BN (ref: operators/sync_batch_norm_op.cu + BuildStrategy
+    sync_batch_norm pass). Stats are all-reduced over the data-parallel mesh
+    axis when running under shard_map/pjit."""
+
+    def __init__(self, num_channels, momentum=0.9, epsilon=1e-5, act=None,
+                 axis_name="dp", dtype=jnp.float32):
+        super().__init__(num_channels, momentum, epsilon, act, dtype=dtype)
+        self.axis_name = axis_name
+
+    def forward(self, x):
+        import jax
+        if self.training:
+            try:
+                red = (0, 2, 3)
+                m = jnp.mean(x, axis=red)
+                m2 = jnp.mean(jnp.square(x), axis=red)
+                m = jax.lax.pmean(m, self.axis_name)
+                m2 = jax.lax.pmean(m2, self.axis_name)
+                v = m2 - jnp.square(m)
+            except NameError:  # not under a mapped axis — local BN
+                return super().forward(x)
+            inv = jax.lax.rsqrt(v + self.epsilon)
+            shape = (1, -1, 1, 1)
+            out = (x - m.reshape(shape)) * (inv * self.p("scale")).reshape(shape) \
+                + self.p("bias").reshape(shape)
+            n = x.size // x.shape[1]
+            unbiased = v * n / max(n - 1, 1)
+            self.update_state("mean", self.momentum * self.s("mean")
+                              + (1 - self.momentum) * m)
+            self.update_state("variance", self.momentum * self.s("variance")
+                              + (1 - self.momentum) * unbiased)
+            return _act(self.act, out)
+        return super().forward(x)
+
+
+class LayerNorm(Module):
+    """ref: dygraph/nn.py LayerNorm."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, scale=True, shift=True,
+                 dtype=jnp.float32):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.has_scale, self.has_shift = scale, shift
+        n = 1
+        for d in self.shape:
+            n *= d
+        if scale:
+            self.param("scale", (n,), I.ones(), dtype)
+        if shift:
+            self.param("bias", (n,), I.zeros(), dtype)
+
+    def forward(self, x):
+        begin = x.ndim - len(self.shape)
+        return F.layer_norm(
+            x, self.p("scale") if self.has_scale else None,
+            self.p("bias") if self.has_shift else None,
+            begin_norm_axis=begin, epsilon=self.epsilon)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim, epsilon=1e-6, dtype=jnp.float32):
+        super().__init__()
+        self.epsilon = epsilon
+        self.param("scale", (dim,), I.ones(), dtype)
+
+    def forward(self, x):
+        return F.rms_norm(x, self.p("scale"), self.epsilon)
+
+
+class GroupNorm(Module):
+    """ref: dygraph/nn.py GroupNorm."""
+
+    def __init__(self, channels, groups=32, epsilon=1e-5, dtype=jnp.float32):
+        super().__init__()
+        self.groups, self.epsilon = groups, epsilon
+        self.param("scale", (channels,), I.ones(), dtype)
+        self.param("bias", (channels,), I.zeros(), dtype)
+
+    def forward(self, x):
+        return F.group_norm(x, self.p("scale"), self.p("bias"), self.groups,
+                            self.epsilon)
+
+
+class Embedding(Module):
+    """ref: dygraph/nn.py Embedding + operators/lookup_table_op.cc."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 weight_init=None, dtype=jnp.float32):
+        super().__init__()
+        self.padding_idx = padding_idx
+        self.param("weight", (num_embeddings, embedding_dim),
+                   weight_init or I.normal(0.0, 0.02), dtype)
+
+    def forward(self, ids):
+        return F.lookup_table(ids, self.p("weight"), self.padding_idx)
+
+
+class Dropout(Module):
+    """ref: operators/dropout_op.cc; PRNG key from apply(rngs=...)."""
+
+    def __init__(self, rate=0.5, mode="upscale_in_train"):
+        super().__init__()
+        self.rate, self.mode = rate, mode
+
+    def forward(self, x):
+        if not self.training or self.rate == 0.0:
+            return F.dropout(x, None, self.rate, training=False,
+                             mode=self.mode)
+        return F.dropout(x, self.rng("dropout"), self.rate, training=True,
+                         mode=self.mode)
+
+
+class Pool2D(Module):
+    """ref: dygraph/nn.py Pool2D."""
+
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=None,
+                 pool_padding=0, global_pooling=False):
+        super().__init__()
+        self.args = (pool_size, pool_type, pool_stride, pool_padding,
+                     global_pooling)
+
+    def forward(self, x):
+        ps, pt, st, pd, gp = self.args
+        return F.pool2d(x, ps, pt, st, pd, global_pooling=gp)
+
+
+class PRelu(Module):
+    """ref: dygraph/nn.py PRelu."""
+
+    def __init__(self, mode="all", channels=None, dtype=jnp.float32):
+        super().__init__()
+        shape = (1,) if mode == "all" else (channels,)
+        self.mode = mode
+        self.param("alpha", shape, I.constant(0.25), dtype)
+
+    def forward(self, x):
+        a = self.p("alpha")
+        if self.mode == "channel":
+            a = a.reshape(1, -1, *([1] * (x.ndim - 2)))
+        return jnp.where(x >= 0, x, a * x)
+
+
+class BilinearTensorProduct(Module):
+    """ref: dygraph/nn.py BilinearTensorProduct."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.param("weight", (out_features, in1_features, in2_features),
+                   I.xavier(), dtype)
+        self.param("bias", (out_features,), I.zeros(), dtype)
+
+    def forward(self, x, y):
+        out = jnp.einsum("bi,oij,bj->bo", x, self.p("weight"), y)
+        return out + self.p("bias")
+
+
+class SpectralNorm(Module):
+    """Spectral normalization of a weight (ref: operators/spectral_norm_op.cc).
+    Power-iteration vectors are mutable state."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = 1
+        for i, d in enumerate(weight_shape):
+            if i != dim:
+                w *= d
+        self.h, self.w = h, w
+        self.state("u", (h,), I.normal(0, 1), jnp.float32)
+        self.state("v", (w,), I.normal(0, 1), jnp.float32)
+
+    def forward(self, weight):
+        wmat = jnp.moveaxis(weight, self.dim, 0).reshape(self.h, self.w)
+        u, v = self.s("u"), self.s("v")
+        for _ in range(self.power_iters):
+            v = wmat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = wmat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        sigma = u @ wmat @ v
+        if self.training:
+            self.update_state("u", u)
+            self.update_state("v", v)
+        return weight / sigma
+
+
+class LSTM(Module):
+    """Multi-layer LSTM (ref: operators/cudnn_lstm_op.cu capabilities)."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 bidirectional=False, dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size, self.num_layers = hidden_size, num_layers
+        self.bidirectional = bidirectional
+        ndir = 2 if bidirectional else 1
+        for layer in range(num_layers):
+            isz = input_size if layer == 0 else hidden_size * ndir
+            for d in range(ndir):
+                sfx = f"l{layer}d{d}"
+                self.param(f"w_ih_{sfx}", (isz, 4 * hidden_size), I.xavier(), dtype)
+                self.param(f"w_hh_{sfx}", (hidden_size, 4 * hidden_size),
+                           I.xavier(), dtype)
+                self.param(f"b_{sfx}", (4 * hidden_size,), I.zeros(), dtype)
+
+    def forward(self, x, lengths=None):
+        b = x.shape[0]
+        h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+        c0 = jnp.zeros((b, self.hidden_size), x.dtype)
+        out = x
+        last_h, last_c = [], []
+        for layer in range(self.num_layers):
+            if self.bidirectional:
+                sf, sb = f"l{layer}d0", f"l{layer}d1"
+                of, (hf, cf) = R.lstm(out, h0, c0, self.p(f"w_ih_{sf}"),
+                                      self.p(f"w_hh_{sf}"), self.p(f"b_{sf}"),
+                                      lengths=lengths)
+                ob, (hb, cb) = R.lstm(out, h0, c0, self.p(f"w_ih_{sb}"),
+                                      self.p(f"w_hh_{sb}"), self.p(f"b_{sb}"),
+                                      lengths=lengths, reverse=True)
+                out = jnp.concatenate([of, ob], -1)
+                last_h += [hf, hb]
+                last_c += [cf, cb]
+            else:
+                s = f"l{layer}d0"
+                out, (h, c) = R.lstm(out, h0, c0, self.p(f"w_ih_{s}"),
+                                     self.p(f"w_hh_{s}"), self.p(f"b_{s}"),
+                                     lengths=lengths)
+                last_h.append(h)
+                last_c.append(c)
+        return out, (jnp.stack(last_h), jnp.stack(last_c))
+
+
+class GRU(Module):
+    """ref: dygraph/nn.py GRUUnit generalized to multi-step."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size, self.num_layers = hidden_size, num_layers
+        for layer in range(num_layers):
+            isz = input_size if layer == 0 else hidden_size
+            self.param(f"w_ih_l{layer}", (isz, 3 * hidden_size), I.xavier(), dtype)
+            self.param(f"w_hh_l{layer}", (hidden_size, 3 * hidden_size),
+                       I.xavier(), dtype)
+            self.param(f"b_ih_l{layer}", (3 * hidden_size,), I.zeros(), dtype)
+            self.param(f"b_hh_l{layer}", (3 * hidden_size,), I.zeros(), dtype)
+
+    def forward(self, x, lengths=None):
+        b = x.shape[0]
+        h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+        out = x
+        last = []
+        for layer in range(self.num_layers):
+            out, h = R.gru(out, h0, self.p(f"w_ih_l{layer}"),
+                           self.p(f"w_hh_l{layer}"), self.p(f"b_ih_l{layer}"),
+                           self.p(f"b_hh_l{layer}"), lengths=lengths)
+            last.append(h)
+        return out, jnp.stack(last)
+
+
+class MultiHeadAttention(Module):
+    """Fused MHA layer (ref: ir/multihead_matmul_fuse_pass.h semantics)."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, bias=True,
+                 use_flash=False, dtype=jnp.float32):
+        super().__init__()
+        self.num_heads, self.dropout_rate = num_heads, dropout
+        self.use_flash = use_flash
+        self.has_bias = bias
+        for n in ("q", "k", "v", "o"):
+            self.param(f"w{n}", (embed_dim, embed_dim), I.xavier(), dtype)
+            if bias:
+                self.param(f"b{n}", (embed_dim,), I.zeros(), dtype)
+
+    def forward(self, x, kv=None, mask=None, causal=False):
+        from paddle_tpu.ops.attention import multihead_attention
+        key = self.rng("dropout") if (self.training and self.dropout_rate > 0) \
+            else None
+        return multihead_attention(
+            x, self.p("wq"), self.p("wk"), self.p("wv"), self.p("wo"),
+            self.p("bq") if self.has_bias else None,
+            self.p("bk") if self.has_bias else None,
+            self.p("bv") if self.has_bias else None,
+            self.p("bo") if self.has_bias else None,
+            num_heads=self.num_heads, mask=mask, causal=causal, kv=kv,
+            dropout_rate=self.dropout_rate if self.training else 0.0,
+            dropout_key=key, use_flash=self.use_flash)

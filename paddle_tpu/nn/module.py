@@ -1,0 +1,264 @@
+"""Layer/Module system — the dygraph `Layer` equivalent, functional-core.
+
+Ref: /root/reference/python/paddle/fluid/dygraph/layers.py:32 (`Layer` holds
+parameters + sublayers, tracks them by attribute assignment) and dygraph/nn.py
+(Conv2D, BatchNorm, Embedding, FC...).
+
+TPU-first redesign: layers are *specs*, parameters are *pytrees*. A Layer
+declares parameters (shape + initializer) at construction; `init(key)` builds
+the parameter pytree by walking the layer tree; `apply(variables, *args)` is a
+pure function of (params, inputs) → outputs, so the whole model jits/pjits and
+shards as data. Mutable collections (BN running stats) live in a separate
+"state" tree threaded functionally, replacing in-place variable mutation in
+the reference's Scope.
+
+variables = {"params": {...}, "state": {...}}
+"""
+
+import dataclasses
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu.core.enforce import EnforceError
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    shape: tuple
+    init: typing.Callable
+    dtype: typing.Any = jnp.float32
+
+
+@dataclasses.dataclass
+class StateSpec:
+    shape: tuple
+    init: typing.Callable
+    dtype: typing.Any = jnp.float32
+
+
+class Module:
+    """Base layer. Subclasses declare params/state/sublayers in __init__ via
+    plain attribute assignment; `forward(params, *args, **kwargs)` computes.
+
+    Context passed through `apply`: training flag and PRNG keys for
+    stochastic layers (dropout), mirroring the reference's global
+    `with fluid.dygraph.guard()` train/eval state but explicit.
+    """
+
+    def __init__(self):
+        object.__setattr__(self, "_params", {})   # name -> ParamSpec
+        object.__setattr__(self, "_state", {})    # name -> StateSpec
+        object.__setattr__(self, "_children", {})  # name -> Module
+
+    # --- declaration ---
+    def param(self, name, shape, init=None, dtype=jnp.float32):
+        self._params[name] = ParamSpec(tuple(shape), init or I.xavier(), dtype)
+        return name
+
+    def state(self, name, shape, init=None, dtype=jnp.float32):
+        self._state[name] = StateSpec(tuple(shape), init or I.zeros(), dtype)
+        return name
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Module):
+            self._children[name] = value
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Module) for v in value):
+            self._children[name] = ModuleList(value)
+            object.__setattr__(self, name, self._children[name])
+            return
+        object.__setattr__(self, name, value)
+
+    # --- initialization ---
+    def init(self, key, dtype=None):
+        """Build {'params': ..., 'state': ...} pytree for this subtree."""
+        params, state = {}, {}
+        n_own = len(self._params) + len(self._state)
+        keys = list(jax.random.split(key, max(n_own + len(self._children), 1)))
+        ki = 0
+        for name, spec in self._params.items():
+            params[name] = spec.init(keys[ki], spec.shape,
+                                     dtype or spec.dtype)
+            ki += 1
+        for name, spec in self._state.items():
+            state[name] = spec.init(keys[ki], spec.shape, spec.dtype)
+            ki += 1
+        for name, child in self._children.items():
+            sub = child.init(keys[ki], dtype=dtype)
+            ki += 1
+            if sub["params"]:
+                params[name] = sub["params"]
+            if sub["state"]:
+                state[name] = sub["state"]
+        return {"params": params, "state": state}
+
+    # --- application ---
+    def apply(self, variables, *args, training=False, rngs=None, **kwargs):
+        """Run forward purely. Returns output, or (output, new_state) when the
+        module carries mutable state and training=True."""
+        ctx = Context(training=training, rngs=rngs or {})
+        with _bind(self, variables, ctx):
+            out = self.forward(*args, **kwargs)
+        if ctx.state_updates and training:
+            new_state = _merge_state(variables.get("state", {}),
+                                     ctx.state_updates)
+            return out, new_state
+        return out
+
+    def __call__(self, *args, **kwargs):
+        """Inside a parent's forward(): run with the bound sub-variables."""
+        ctx = _CURRENT.ctx
+        if ctx is None:
+            raise EnforceError(
+                "Module must be called via .apply(variables, ...) or inside a "
+                "parent module's forward()")
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # --- bound accessors (valid inside forward) ---
+    def p(self, name):
+        """Fetch own parameter value."""
+        scope = _CURRENT.scopes[id(self)]
+        return scope["params"][name]
+
+    def s(self, name):
+        """Fetch own state value (latest update if already written)."""
+        scope = _CURRENT.scopes[id(self)]
+        upd = _CURRENT.ctx.state_updates
+        path = scope["path"] + (name,)
+        if path in upd:
+            return upd[path]
+        return scope["state"][name]
+
+    def update_state(self, name, value):
+        scope = _CURRENT.scopes[id(self)]
+        _CURRENT.ctx.state_updates[scope["path"] + (name,)] = value
+
+    @property
+    def training(self):
+        return _CURRENT.ctx.training
+
+    def rng(self, name="dropout"):
+        ctx = _CURRENT.ctx
+        if name not in ctx.rngs:
+            raise EnforceError(
+                f"Missing PRNG key '{name}': pass rngs={{'{name}': key}} to apply()")
+        key, sub = jax.random.split(ctx.rngs[name])
+        ctx.rngs[name] = key
+        return sub
+
+    # --- introspection ---
+    def named_children(self):
+        return dict(self._children)
+
+    def param_specs(self):
+        out = dict(self._params)
+        for cname, child in self._children.items():
+            for pname, spec in child.param_specs().items():
+                out[f"{cname}.{pname}"] = spec
+        return out
+
+
+class Context:
+    def __init__(self, training, rngs):
+        self.training = training
+        self.rngs = dict(rngs)
+        self.state_updates = {}  # path tuple -> value
+
+
+class _Current(object):
+    def __init__(self):
+        self.ctx = None
+        self.scopes = {}
+
+
+_CURRENT = _Current()
+
+
+class _bind:
+    """Context manager: walk the module tree, binding each module's slice of
+    the variables pytree so nested __call__ works without passing dicts."""
+
+    def __init__(self, root, variables, ctx):
+        self.root = root
+        self.variables = variables
+        self.ctx = ctx
+
+    def __enter__(self):
+        self.prev_ctx = _CURRENT.ctx
+        self.prev_scopes = _CURRENT.scopes
+        _CURRENT.ctx = self.ctx
+        _CURRENT.scopes = {}
+        self._walk(self.root, self.variables.get("params", {}),
+                   self.variables.get("state", {}), ())
+        return self
+
+    def _walk(self, mod, params, state, path):
+        _CURRENT.scopes[id(mod)] = {
+            "params": params, "state": state, "path": path}
+        for name, child in mod._children.items():
+            self._walk(child,
+                       params.get(name, {}) if isinstance(params, dict) else {},
+                       state.get(name, {}) if isinstance(state, dict) else {},
+                       path + (name,))
+
+    def __exit__(self, *exc):
+        _CURRENT.ctx = self.prev_ctx
+        _CURRENT.scopes = self.prev_scopes
+        return False
+
+
+def _merge_state(state, updates):
+    state = jax.tree_util.tree_map(lambda x: x, state)  # shallow-ish copy
+
+    def set_path(d, path, value):
+        d = dict(d)
+        if len(path) == 1:
+            d[path[0]] = value
+        else:
+            d[path[0]] = set_path(d.get(path[0], {}), path[1:], value)
+        return d
+
+    for path, value in updates.items():
+        state = set_path(state, path, value)
+    return state
+
+
+class ModuleList(Module):
+    """Ordered container (ref: dygraph LayerList)."""
+
+    def __init__(self, modules=()):
+        super().__init__()
+        self._items = []
+        for m in modules:
+            self.append(m)
+
+    def append(self, m):
+        idx = len(self._items)
+        self._items.append(m)
+        self._children[str(idx)] = m
+        return self
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def forward(self, x, *args, **kwargs):
+        for m in self._items:
+            x = m(x, *args, **kwargs)
+        return x
+
+
+class Sequential(ModuleList):
+    """ref: dygraph Sequential"""
+    pass

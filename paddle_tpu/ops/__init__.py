@@ -1,0 +1,44 @@
+"""Operator library.
+
+TPU-native counterpart of /root/reference/paddle/fluid/operators (~480 op
+families). Every op is a pure function over jax arrays, registered by name in
+the global op registry (core/registry.py) so captured programs remain
+serializable/introspectable like the reference's OpDesc graph.
+
+Submodules:
+  math          matmul/elementwise/reductions   (ref: operators/*, math/blas.h)
+  activations   ~30 activations                 (ref: operators/activation_op.cc)
+  tensor_ops    shape/index/creation ops        (ref: concat/split/gather/...)
+  nn            conv/pool/norm/dropout/embed    (ref: conv_op.cc, batch_norm_op.cc ...)
+  loss          loss functions                  (ref: cross_entropy_op.cc ...)
+  sequence      ragged sequence ops             (ref: operators/sequence_ops/)
+  control_flow  while/cond/scan/switch          (ref: operators/controlflow/)
+  rnn           lstm/gru cells + scans          (ref: lstm_op.cc, gru_op.cc)
+  metrics_ops   accuracy/auc/precision_recall   (ref: operators/metrics/)
+  attention     fused attention                 (ref: ir multihead_matmul fuse)
+  pallas        hand-written TPU kernels        (ref: hand-written CUDA kernels)
+"""
+
+from paddle_tpu.ops import (
+    activations,
+    attention,
+    control_flow,
+    loss,
+    math,
+    metrics_ops,
+    nn,
+    rnn,
+    sequence,
+    tensor_ops,
+)
+from paddle_tpu.ops.activations import *  # noqa: F401,F403
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.tensor_ops import *  # noqa: F401,F403
+from paddle_tpu.ops.nn import *  # noqa: F401,F403
+from paddle_tpu.ops.loss import *  # noqa: F401,F403
+from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY
+
+
+def list_ops():
+    """All registered op names (parity audit vs reference's op surface)."""
+    return GLOBAL_OP_REGISTRY.list_ops()

@@ -1,0 +1,151 @@
+"""Activation ops.
+
+Ref: /root/reference/paddle/fluid/operators/activation_op.cc — the reference
+registers ~30 activation kernels with hand-written CUDA grads. Here each is a
+jnp expression; XLA fuses them into adjacent matmuls/convs (replacing the
+reference's fused_ops/fused_elemwise_activation and ir fusion passes), and
+jax.grad derives the backward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("relu")
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@register_op("relu6")
+def relu6(x, threshold=6.0):
+    return jnp.clip(x, 0, threshold)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, alpha=0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("prelu")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("elu")
+def elu(x, alpha=1.0):
+    safe = jnp.where(x > 0, 0.0, x)
+    return jnp.where(x > 0, x, alpha * (jnp.exp(safe) - 1.0))
+
+
+@register_op("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * elu(x, alpha)
+
+
+@register_op("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_op("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_op("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(x, slope=0.2, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register_op("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register_op("tanh_shrink")
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+@register_op("hard_shrink")
+def hard_shrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("softshrink")
+def softshrink(x, lambda_=0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - lambda_, 0.0)
+
+
+@register_op("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@register_op("softsign")
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+@register_op("swish")
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@register_op("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register_op("hard_swish")
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0):
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+@register_op("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("brelu")
+def brelu(x, t_min=0.0, t_max=24.0):
+    return jnp.clip(x, t_min, t_max)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@register_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op("softmax")
+def softmax(x, axis=-1):
+    """ref: operators/softmax_op.cc (+softmax_cudnn); XLA fuses the
+    max-subtract/exp/normalize chain on the VPU."""
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("maxout")
+def maxout(x, groups, axis=1):
+    """ref: operators/maxout_op.cc"""
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)

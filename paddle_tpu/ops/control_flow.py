@@ -1,0 +1,74 @@
+"""Control-flow ops — structured, compiler-friendly.
+
+Ref: /root/reference/paddle/fluid/operators/controlflow/ (while_op.cc,
+conditional_block_op.cc) and operators/recurrent_op.cc — the reference runs
+sub-blocks through a nested Executor with step-scopes.
+
+TPU-first: control flow must stay inside the compiled program, so these are
+thin wrappers over `lax.while_loop` / `lax.cond` / `lax.scan` / `lax.switch`
+operating on pytree carries (the step-scope equivalent). No Python-level
+interpretation at run time.
+"""
+
+import jax
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("while_loop")
+def while_loop(cond, body, loop_vars):
+    """ref: operators/controlflow/while_op.cc"""
+    return lax.while_loop(cond, body, loop_vars)
+
+
+@register_op("cond")
+def cond(pred, true_fn, false_fn, *operands):
+    """ref: operators/controlflow/conditional_block_op.cc"""
+    return lax.cond(pred, true_fn, false_fn, *operands)
+
+
+@register_op("case")
+def case(pred_fn_pairs, default=None):
+    """ref: layers/control_flow.py case() — first true predicate wins."""
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is not None:
+        fns = fns + [default]
+
+    def step(i, carry):
+        return carry
+
+    # build nested conds from the back
+    def make(i):
+        if i == len(preds):
+            if default is None:
+                return fns[-1]
+            return default
+        return lambda: lax.cond(preds[i], fns[i], make(i + 1))
+
+    return make(0)()
+
+
+@register_op("switch_case")
+def switch_case(branch_index, branch_fns, *operands):
+    """ref: layers/control_flow.py switch_case()"""
+    return lax.switch(branch_index, branch_fns, *operands)
+
+
+@register_op("scan")
+def scan(f, init, xs, length=None, reverse=False, unroll=1):
+    """The static-RNN primitive (ref: operators/recurrent_op.cc — the
+    reference's RecurrentOp runs a sub-block per step with step-scopes; scan
+    compiles the whole loop into one XLA While with stacked outputs)."""
+    return lax.scan(f, init, xs, length=length, reverse=reverse, unroll=unroll)
+
+
+@register_op("fori_loop")
+def fori_loop(lower, upper, body, init):
+    return lax.fori_loop(lower, upper, body, init)
+
+
+@register_op("stop_gradient")
+def stop_gradient(x):
+    return lax.stop_gradient(x)

@@ -1,0 +1,262 @@
+"""Loss ops.
+
+Ref: /root/reference/paddle/fluid/operators/ — cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+bce_loss / log_loss_op.cc, smooth_l1_loss_op.cc, huber_loss_op.cc,
+hinge_loss_op.cc, rank_loss_op.cc, margin_rank_loss_op.cc, bpr_loss_op.cc,
+kldiv_loss_op.cc, nce_op.cc, sampled_softmax (sample_logits_op.cc),
+warpctc_op.cc, mse via square+mean.
+
+All are jnp expressions; softmax_with_cross_entropy uses the numerically
+stable logsumexp form (the reference fuses softmax+CE for the same reason).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddle_tpu.core.registry import register_op
+
+
+def _squeeze_label(label):
+    if label.ndim > 1 and label.shape[-1] == 1:
+        return jnp.squeeze(label, -1)
+    return label
+
+
+@register_op("cross_entropy")
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """ref: operators/cross_entropy_op.cc — input is *probabilities*."""
+    if soft_label:
+        return -jnp.sum(label * jnp.log(jnp.maximum(input, 1e-20)),
+                        axis=-1, keepdims=True)
+    label = _squeeze_label(label)
+    picked = jnp.take_along_axis(
+        input, jnp.maximum(label, 0)[..., None], axis=-1)[..., 0]
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    loss = jnp.where(label == ignore_index, 0.0, loss)
+    return loss[..., None]
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    """ref: operators/softmax_with_cross_entropy_op.cc — fused stable form."""
+    logz = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
+    log_probs = logits - logz
+    if soft_label:
+        loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
+    else:
+        lbl = _squeeze_label(label)
+        picked = jnp.take_along_axis(
+            log_probs, jnp.maximum(lbl, 0)[..., None], axis=axis)[..., 0]
+        loss = jnp.where(lbl == ignore_index, 0.0, -picked)[..., None]
+    if return_softmax:
+        return loss, jnp.exp(log_probs)
+    return loss
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False):
+    """ref: operators/sigmoid_cross_entropy_with_logits_op.cc"""
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    valid = (label != ignore_index)
+    loss = jnp.where(valid, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return loss
+
+
+@register_op("bce_loss")
+def bce_loss(input, label):
+    return -(label * jnp.log(jnp.maximum(input, 1e-12))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, 1e-12)))
+
+
+@register_op("log_loss")
+def log_loss(input, label, epsilon=1e-4):
+    """ref: operators/log_loss_op.cc"""
+    return -(label * jnp.log(input + epsilon)
+             + (1 - label) * jnp.log(1 - input + epsilon))
+
+
+@register_op("mse_loss")
+def mse_loss(input, label):
+    return jnp.square(input - label)
+
+
+@register_op("square_error_cost")
+def square_error_cost(input, label):
+    """ref: layers/nn.py square_error_cost"""
+    return jnp.square(input - label)
+
+
+@register_op("l1_loss")
+def l1_loss(input, label):
+    return jnp.abs(input - label)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(x, y, sigma=1.0):
+    """ref: operators/smooth_l1_loss_op.cc — per-sample sum over features."""
+    sigma2 = sigma * sigma
+    diff = x - y
+    absd = jnp.abs(diff)
+    loss = jnp.where(absd < 1.0 / sigma2,
+                     0.5 * sigma2 * jnp.square(diff),
+                     absd - 0.5 / sigma2)
+    return jnp.sum(loss, axis=tuple(range(1, x.ndim)), keepdims=True) \
+        if x.ndim > 1 else loss
+
+
+@register_op("huber_loss")
+def huber_loss(input, label, delta=1.0):
+    """ref: operators/huber_loss_op.cc"""
+    d = jnp.abs(label - input)
+    return jnp.where(d <= delta, 0.5 * jnp.square(d),
+                     delta * (d - 0.5 * delta))
+
+
+@register_op("hinge_loss")
+def hinge_loss(logits, label):
+    """ref: operators/hinge_loss_op.cc — label in {0,1}."""
+    y = 2.0 * label - 1.0
+    return jnp.maximum(0.0, 1.0 - y * logits)
+
+
+@register_op("rank_loss")
+def rank_loss(label, left, right):
+    """ref: operators/rank_loss_op.cc"""
+    d = left - right
+    return jnp.maximum(d, 0.0) - d * label + jnp.log1p(jnp.exp(-jnp.abs(d)))
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(label, left, right, margin=0.1):
+    """ref: operators/margin_rank_loss_op.cc"""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+@register_op("bpr_loss")
+def bpr_loss(input, label):
+    """ref: operators/bpr_loss_op.cc — Bayesian personalized ranking over
+    softmax inputs."""
+    lbl = _squeeze_label(label)
+    pos = jnp.take_along_axis(input, lbl[..., None], axis=-1)
+    diff = pos - input
+    n = input.shape[-1]
+    loss = -jnp.sum(jnp.log(jax.nn.sigmoid(diff)), axis=-1, keepdims=True) / (n - 1)
+    return loss
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(x, target, reduction="mean"):
+    """ref: operators/kldiv_loss_op.cc — x is log-probabilities."""
+    loss = target * (jnp.log(jnp.maximum(target, 1e-20)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return loss
+
+
+@register_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """ref: python layers npair_loss"""
+    sim = anchor @ positive.T
+    lbl = labels.reshape(-1)
+    targets = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+    targets = targets / jnp.sum(targets, axis=1, keepdims=True)
+    logz = jax.scipy.special.logsumexp(sim, axis=1, keepdims=True)
+    ce = jnp.mean(-jnp.sum(targets * (sim - logz), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), 1))) / 2
+    return ce + reg
+
+
+@register_op("cos_sim")
+def cos_sim(x, y, epsilon=1e-12):
+    """ref: operators/cos_sim_op.cc"""
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True) + epsilon)
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True) + epsilon)
+    return jnp.sum(x * y, -1, keepdims=True) / (xn * yn)
+
+
+@register_op("ctc_loss")
+def ctc_loss(logits, logit_lengths, labels, label_lengths, blank=0):
+    """CTC (ref: operators/warpctc_op.cc — wraps warp-ctc). TPU-native:
+    optax's pure-XLA CTC. logits [B, T, C]; labels [B, L] padded with
+    `blank`."""
+    b, t, c = logits.shape
+    logit_pad = (jnp.arange(t)[None, :] >= logit_lengths[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(labels.shape[1])[None, :]
+                 >= label_lengths[:, None]).astype(jnp.float32)
+    return optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank)
+
+
+@register_op("nce_loss")
+def nce_loss(key, input, label, weight, bias, num_total_classes,
+             num_neg_samples=10):
+    """NCE with uniform negative sampling (ref: operators/nce_op.cc).
+
+    input [B, D]; label [B]; weight [C, D]; bias [C]."""
+    b = input.shape[0]
+    label = _squeeze_label(label)
+    neg = jax.random.randint(key, (b, num_neg_samples), 0, num_total_classes)
+    pos_w = weight[label]                      # [B, D]
+    pos_logit = jnp.sum(input * pos_w, -1) + bias[label]
+    neg_w = weight[neg]                        # [B, K, D]
+    neg_logit = jnp.einsum("bd,bkd->bk", input, neg_w) + bias[neg]
+    # NCE: log Q corrections with uniform q = 1/C
+    log_q = -jnp.log(float(num_total_classes))
+    pos_loss = -jax.nn.log_sigmoid(pos_logit - log_q)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-(neg_logit - log_q)), -1)
+    return (pos_loss + neg_loss)[:, None]
+
+
+@register_op("sampled_softmax_with_cross_entropy")
+def sampled_softmax_with_cross_entropy(key, logits_weight, logits_bias, input,
+                                       label, num_samples,
+                                       num_total_classes):
+    """ref: operators/sample_logits_op.cc path."""
+    b = input.shape[0]
+    label = _squeeze_label(label)
+    neg = jax.random.randint(key, (num_samples,), 0, num_total_classes)
+    classes = jnp.concatenate([label, neg])          # [B+S]
+    w = logits_weight[classes]                       # [B+S, D]
+    logit = input @ w.T + logits_bias[classes]       # [B, B+S]
+    target = jnp.arange(b)
+    logz = jax.scipy.special.logsumexp(logit, -1)
+    picked = jnp.take_along_axis(logit, target[:, None], 1)[:, 0]
+    return (logz - picked)[:, None]
+
+
+@register_op("center_loss")
+def center_loss(features, label, centers, alpha=0.5):
+    """ref: operators/center_loss_op.cc — returns (loss, new_centers)."""
+    label = _squeeze_label(label)
+    c = centers[label]
+    loss = 0.5 * jnp.sum(jnp.square(features - c), axis=-1, keepdims=True)
+    diff = c - features
+    counts = jnp.zeros((centers.shape[0],), features.dtype).at[label].add(1.0)
+    upd = jnp.zeros_like(centers).at[label].add(diff)
+    new_centers = centers - alpha * upd / (counts[:, None] + 1.0)
+    return loss, new_centers
+
+
+@register_op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):
+    label = _squeeze_label(label).astype(input.dtype)
+    if label.ndim < input.ndim:
+        label = jax.nn.one_hot(label.astype(jnp.int32), input.shape[-1],
+                               dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label, reduce_dims)
+    union = jnp.sum(input, reduce_dims) + jnp.sum(label, reduce_dims)
+    return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))

@@ -1,0 +1,292 @@
+"""Dense math ops.
+
+Ref: /root/reference/paddle/fluid/operators/ (matmul_op.cc, mul_op.cc,
+elementwise/*, reduce_ops/*, cum_op, clip_op …) and operators/math/blas.h —
+the reference wraps cuBLAS/MKL per device; here every op lowers through XLA
+onto the MXU/VPU, with precision controlled by the `matmul_precision` flag.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.flags import get_flag
+from paddle_tpu.core.registry import register_op
+
+
+def _precision():
+    p = get_flag("matmul_precision")
+    return {"default": lax.Precision.DEFAULT,
+            "high": lax.Precision.HIGH,
+            "highest": lax.Precision.HIGHEST}[p]
+
+
+@register_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0):
+    """Batched matmul (ref: operators/matmul_op.cc; MXU-bound on TPU)."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, precision=_precision())
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+@register_op("mul")
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """The reference's `mul` op: flatten x to 2-D at x_num_col_dims, y at
+    y_num_col_dims, then matmul (ref: operators/mul_op.cc)."""
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1))
+    y2 = y.reshape((int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1))
+    out = jnp.matmul(x2, y2, precision=_precision())
+    return out.reshape(xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+@register_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+# --- elementwise binary (ref: operators/elementwise/elementwise_*_op.cc) ---
+# The reference's axis-broadcast semantics ("elementwise_add(x, y, axis=1)")
+# align y's dims starting at `axis` of x; numpy broadcasting subsumes this
+# when axis==-1. We keep the axis argument for parity.
+
+def _ew_broadcast(x, y, axis):
+    if axis == -1 or y.ndim == x.ndim:
+        return x, y
+    pad = x.ndim - axis - y.ndim
+    return x, y.reshape(y.shape + (1,) * pad)
+
+
+@register_op("elementwise_add")
+def elementwise_add(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return x + y
+
+
+@register_op("elementwise_sub")
+def elementwise_sub(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return x - y
+
+
+@register_op("elementwise_mul")
+def elementwise_mul(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return x * y
+
+
+@register_op("elementwise_div")
+def elementwise_div(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return x / y
+
+
+@register_op("elementwise_max")
+def elementwise_max(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return jnp.maximum(x, y)
+
+
+@register_op("elementwise_min")
+def elementwise_min(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return jnp.minimum(x, y)
+
+
+@register_op("elementwise_pow")
+def elementwise_pow(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return jnp.power(x, y)
+
+
+@register_op("elementwise_mod")
+def elementwise_mod(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return jnp.mod(x, y)
+
+
+@register_op("elementwise_floordiv")
+def elementwise_floordiv(x, y, axis=-1):
+    x, y = _ew_broadcast(x, y, axis)
+    return jnp.floor_divide(x, y)
+
+
+# --- unary math (ref: operators/activation_op.cc math subset) ---
+for _name, _fn in [
+    ("exp", jnp.exp), ("log", jnp.log), ("log2", jnp.log2),
+    ("log10", jnp.log10), ("log1p", jnp.log1p), ("sqrt", jnp.sqrt),
+    ("rsqrt", lax.rsqrt), ("abs", jnp.abs), ("ceil", jnp.ceil),
+    ("floor", jnp.floor), ("round", jnp.round), ("sign", jnp.sign),
+    ("square", jnp.square), ("reciprocal", jnp.reciprocal),
+    ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+    ("asin", jnp.arcsin), ("acos", jnp.arccos), ("atan", jnp.arctan),
+    ("sinh", jnp.sinh), ("cosh", jnp.cosh), ("erf", jax.scipy.special.erf),
+]:
+    globals()[_name] = register_op(_name)(_fn)
+
+
+@register_op("pow")
+def pow(x, factor=1.0):
+    return jnp.power(x, factor)
+
+
+@register_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """ref: operators/scale_op.cc"""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("clip")
+def clip(x, min, max):
+    return jnp.clip(x, min, max)
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > max_norm, x * (max_norm / jnp.maximum(norm, 1e-12)), x)
+
+
+# --- reductions (ref: operators/reduce_ops/) ---
+@register_op("reduce_sum")
+def reduce_sum(x, dim=None, keep_dim=False):
+    return jnp.sum(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_mean")
+def reduce_mean(x, dim=None, keep_dim=False):
+    return jnp.mean(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_max")
+def reduce_max(x, dim=None, keep_dim=False):
+    return jnp.max(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_min")
+def reduce_min(x, dim=None, keep_dim=False):
+    return jnp.min(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_prod")
+def reduce_prod(x, dim=None, keep_dim=False):
+    return jnp.prod(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_all")
+def reduce_all(x, dim=None, keep_dim=False):
+    return jnp.all(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("reduce_any")
+def reduce_any(x, dim=None, keep_dim=False):
+    return jnp.any(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("logsumexp")
+def logsumexp(x, dim=None, keep_dim=False):
+    return jax.scipy.special.logsumexp(x, axis=dim, keepdims=keep_dim)
+
+
+@register_op("mean")
+def mean(x):
+    return jnp.mean(x)
+
+
+@register_op("sum")
+def sum(xs):
+    """Sum a list of tensors (ref: operators/sum_op.cc — grad accumulation)."""
+    if isinstance(xs, (list, tuple)):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return jnp.sum(xs)
+
+
+@register_op("cumsum")
+def cumsum(x, axis=None, exclusive=False, reverse=False):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@register_op("cumprod")
+def cumprod(x, axis=0):
+    return jnp.cumprod(x, axis)
+
+
+@register_op("norm")
+def norm(x, p=2, axis=-1, epsilon=1e-10):
+    """l2_normalize-style (ref: operators/norm_op.cc)."""
+    if p == 2:
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+    else:
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True)
+                      + epsilon, 1.0 / p)
+    return x / n
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(x, dim=None, keep_dim=False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=dim, keepdims=keep_dim))
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("addmm")
+def addmm(input, x, y, alpha=1.0, beta=1.0):
+    return beta * input + alpha * jnp.matmul(x, y, precision=_precision())
+
+
+@register_op("isfinite")
+def isfinite(x):
+    return jnp.all(jnp.isfinite(x))
+
+
+@register_op("isnan")
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register_op("isinf")
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register_op("increment")
+def increment(x, value=1.0):
+    return x + value
+
+
+@register_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)

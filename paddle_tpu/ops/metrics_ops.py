@@ -1,0 +1,64 @@
+"""Metric ops.
+
+Ref: /root/reference/paddle/fluid/operators/metrics/ — accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("accuracy")
+def accuracy(input, label, k=1):
+    """ref: operators/metrics/accuracy_op.cc — top-k accuracy from logits or
+    probabilities [B, C] against labels [B] or [B,1]."""
+    if label.ndim > 1:
+        label = jnp.squeeze(label, -1)
+    topk = jnp.argsort(-input, axis=-1)[:, :k]
+    correct = jnp.any(topk == label[:, None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+@register_op("auc")
+def auc(predict, label, num_thresholds=4096):
+    """Streaming-free AUC over a batch (ref: operators/metrics/auc_op.cc uses
+    stat buckets; here one-shot bucketed trapezoid)."""
+    if label.ndim > 1:
+        label = jnp.squeeze(label, -1)
+    pos_score = predict[:, 1] if predict.ndim > 1 else predict
+    bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32),
+                      0, num_thresholds - 1)
+    lbl = label.astype(jnp.float32)
+    pos_hist = jnp.zeros(num_thresholds).at[bucket].add(lbl)
+    neg_hist = jnp.zeros(num_thresholds).at[bucket].add(1.0 - lbl)
+    # integrate from the highest threshold down
+    pos_c = jnp.cumsum(pos_hist[::-1])
+    neg_c = jnp.cumsum(neg_hist[::-1])
+    tot_pos = pos_c[-1]
+    tot_neg = neg_c[-1]
+    pos_prev = jnp.concatenate([jnp.zeros(1), pos_c[:-1]])
+    neg_prev = jnp.concatenate([jnp.zeros(1), neg_c[:-1]])
+    area = jnp.sum((neg_c - neg_prev) * (pos_c + pos_prev) / 2.0)
+    return area / jnp.maximum(tot_pos * tot_neg, 1e-12)
+
+
+@register_op("precision_recall")
+def precision_recall(pred_label, label, num_classes):
+    """ref: operators/metrics/precision_recall_op.cc — returns per-class
+    (precision, recall, f1) macro arrays."""
+    if label.ndim > 1:
+        label = jnp.squeeze(label, -1)
+    if pred_label.ndim > 1:
+        pred_label = jnp.squeeze(pred_label, -1)
+    tp = jnp.zeros(num_classes)
+    fp = jnp.zeros(num_classes)
+    fn = jnp.zeros(num_classes)
+    correct = pred_label == label
+    tp = tp.at[pred_label].add(correct.astype(jnp.float32))
+    fp = fp.at[pred_label].add((~correct).astype(jnp.float32))
+    fn = fn.at[label].add((~correct).astype(jnp.float32))
+    precision = tp / jnp.maximum(tp + fp, 1e-12)
+    recall = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1

@@ -1,0 +1,441 @@
+"""Neural-network primitive ops (functional).
+
+Ref: /root/reference/paddle/fluid/operators/ — conv_op.cc/conv_cudnn_op.cu,
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc,
+instance_norm_op.cc, dropout_op.cc, lookup_table_op.cc, interpolate_op.cc,
+lrn_op.cc, pixel_shuffle_op.cc, grid_sampler_op.cc — and the Python wrappers
+in python/paddle/fluid/layers/nn.py.
+
+TPU-first notes:
+  * Convs lower to XLA `conv_general_dilated` → MXU. Internally we compute in
+    NCHW-or-NHWC as given; on TPU, XLA's layout assignment picks the fast
+    layout, so no hand-written im2col (ref operators/math/im2col.cc) is needed.
+  * Norm ops are fused elementwise chains; XLA fuses them into neighbors.
+    A Pallas fused layer_norm lives in ops/pallas/ for the bandwidth-bound
+    large-model case.
+  * Dropout takes an explicit PRNG key (TPU counter-based RNG).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.enforce import enforce, enforce_eq
+from paddle_tpu.core.registry import register_op
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# ---------------------------------------------------------------- conv / fc
+@register_op("fc")
+def fc(x, weight, bias=None, num_flatten_dims=1, act=None):
+    """ref: layers/nn.py fc() + operators/mul_op.cc + elementwise_add.
+
+    x: [..., in]; weight: [in, out]; flattens leading dims at
+    num_flatten_dims like the reference."""
+    lead_shape = x.shape[:num_flatten_dims]
+    x2 = x.reshape((-1, int(jnp.prod(jnp.array(x.shape[num_flatten_dims:])))))
+    out = x2 @ weight
+    if bias is not None:
+        out = out + bias
+    if act is not None:
+        from paddle_tpu.ops import activations
+        out = getattr(activations, act)(out)
+    return out.reshape(lead_shape + (weight.shape[-1],))
+
+
+def _conv_dn(data_format, ndim):
+    if ndim == 4:
+        return (data_format, "OIHW" if data_format == "NCHW" else "HWIO",
+                data_format)
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register_op("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """2-D convolution (ref: operators/conv_op.cc, conv_cudnn_op.cu).
+
+    weight: [out_c, in_c/groups, kh, kw] (OIHW, reference layout)."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()  # 'SAME' | 'VALID'
+    else:
+        p = _pair(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    _conv_dn(data_format, 4))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NCHW"):
+    """ref: operators/conv_op.cc depthwise path + math/depthwise_conv.cu."""
+    c = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    return conv2d(x, weight, bias, stride, padding, dilation, groups=c,
+                  data_format=data_format)
+
+
+@register_op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    """ref: operators/conv_op.cc 3-D path."""
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    d = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        pad = [(pi, pi) for pi in p]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(x, weight, s, pad, rhs_dilation=d,
+                                   dimension_numbers=dn,
+                                   feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    """ref: operators/conv_transpose_op.cc. weight: [in_c, out_c/groups, kh, kw]."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    p = _pair(padding) if not isinstance(padding, str) else padding
+    op = _pair(output_padding)
+    # transpose conv = lhs-dilated conv with flipped kernel
+    kh, kw = weight.shape[2], weight.shape[3]
+    if isinstance(p, str):
+        pad = p.upper()
+    else:
+        pad = [
+            (dilation[0] * (kh - 1) - p[0], dilation[0] * (kh - 1) - p[0] + op[0]),
+            (dilation[1] * (kw - 1) - p[1], dilation[1] * (kw - 1) - p[1] + op[1]),
+        ]
+    w = jnp.flip(weight, axis=(2, 3))
+    w = jnp.swapaxes(w, 0, 1)  # [out_c/groups, in_c, kh, kw] -> OIHW w.r.t. output
+    if groups > 1:
+        # regroup: weight is [in_c, out_c/g, kh, kw]; build [out_c, in_c/g, ...]
+        in_c = weight.shape[0]
+        ocg = weight.shape[1]
+        wg = weight.reshape(groups, in_c // groups, ocg, kh, kw)
+        wg = jnp.flip(wg, axis=(3, 4))
+        wg = jnp.swapaxes(wg, 1, 2)  # [g, ocg, icg, kh, kw]
+        w = wg.reshape(groups * ocg, in_c // groups, kh, kw)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dn(data_format, 4))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+# ---------------------------------------------------------------- pooling
+def _pool(x, pool_size, stride, padding, data_format, init, op, norm=None):
+    pool_size, stride = _pair(pool_size), _pair(stride)
+    if data_format == "NCHW":
+        window = (1, 1) + pool_size
+        strides = (1, 1) + stride
+    else:
+        window = (1,) + pool_size + (1,)
+        strides = (1,) + stride + (1,)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding)
+        if data_format == "NCHW":
+            pad = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+        else:
+            pad = [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)]
+    out = lax.reduce_window(x, init, op, window, strides, pad)
+    if norm is not None:
+        out = norm(out, window, strides, pad, x.shape)
+    return out
+
+
+@register_op("pool2d")
+def pool2d(x, pool_size=2, pool_type="max", stride=None, padding=0,
+           global_pooling=False, exclusive=True, data_format="NCHW"):
+    """ref: operators/pool_op.cc. exclusive avg excludes padding from count."""
+    if global_pooling:
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    stride = stride if stride is not None else pool_size
+    if pool_type == "max":
+        return _pool(x, pool_size, stride, padding, data_format,
+                     -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                     else jnp.iinfo(x.dtype).min, lax.max)
+    # avg pool
+    def _norm(out, window, strides, pad, in_shape):
+        # exclusive avg divides by the unpadded window size; applies to any
+        # padding mode that can introduce padding (integer pads or SAME)
+        if exclusive and pad != "VALID":
+            ones = jnp.ones(in_shape, x.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+            return out / jnp.maximum(counts, 1.0)
+        k = 1
+        for w in window:
+            k *= w
+        return out / k
+    return _pool(x, pool_size, stride, padding, data_format, 0.0, lax.add,
+                 _norm)
+
+
+@register_op("adaptive_pool2d")
+def adaptive_pool2d(x, output_size, pool_type="avg", data_format="NCHW"):
+    """ref: operators/pool_op.cc adaptive path."""
+    oh, ow = _pair(output_size)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        enforce(h % oh == 0 and w % ow == 0,
+                "adaptive_pool2d requires divisible sizes on TPU (static shapes)")
+        x5 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        red = (3, 5)
+    else:
+        n, h, w, c = x.shape
+        x5 = x.reshape(n, oh, h // oh, ow, w // ow, c)
+        red = (2, 4)
+    if pool_type == "max":
+        return jnp.max(x5, axis=red)
+    return jnp.mean(x5, axis=red)
+
+
+# ---------------------------------------------------------------- norms
+@register_op("batch_norm")
+def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
+               training=False, data_format="NCHW"):
+    """ref: operators/batch_norm_op.cc.
+
+    Returns (out, new_mean, new_variance). In eval mode new stats == inputs.
+    """
+    axis = 1 if data_format == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    if training:
+        m = jnp.mean(x, axis=red)
+        v = jnp.var(x, axis=red)
+        n = x.size // x.shape[axis]
+        unbiased = v * n / max(n - 1, 1)
+        new_mean = momentum * mean + (1 - momentum) * m
+        new_var = momentum * variance + (1 - momentum) * unbiased
+    else:
+        m, v = mean, variance
+        new_mean, new_var = mean, variance
+    inv = lax.rsqrt(v + epsilon)
+    out = (x - m.reshape(shape)) * (inv * scale).reshape(shape) + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+@register_op("layer_norm")
+def layer_norm(x, scale=None, bias=None, begin_norm_axis=1, epsilon=1e-5):
+    """ref: operators/layer_norm_op.cc — normalize over dims
+    [begin_norm_axis:]; scale/bias are flat over those dims."""
+    red = tuple(range(begin_norm_axis, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    out = (x - m) * lax.rsqrt(v + epsilon)
+    tail = x.shape[begin_norm_axis:]
+    if scale is not None:
+        out = out * scale.reshape(tail)
+    if bias is not None:
+        out = out + bias.reshape(tail)
+    return out
+
+
+@register_op("rms_norm")
+def rms_norm(x, scale=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (modern LLM norm; not in reference — TPU-era addition)."""
+    v = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = x * lax.rsqrt(v + epsilon).astype(x.dtype)
+    if scale is not None:
+        out = out * scale
+    return out
+
+
+@register_op("group_norm")
+def group_norm(x, scale=None, bias=None, groups=32, epsilon=1e-5,
+               data_format="NCHW"):
+    """ref: operators/group_norm_op.cc"""
+    enforce_eq(data_format, "NCHW", "group_norm supports NCHW")
+    n, c, h, w = x.shape
+    xg = x.reshape(n, groups, c // groups, h, w)
+    m = jnp.mean(xg, axis=(2, 3, 4), keepdims=True)
+    v = jnp.var(xg, axis=(2, 3, 4), keepdims=True)
+    out = ((xg - m) * lax.rsqrt(v + epsilon)).reshape(n, c, h, w)
+    if scale is not None:
+        out = out * scale.reshape(1, c, 1, 1)
+    if bias is not None:
+        out = out + bias.reshape(1, c, 1, 1)
+    return out
+
+
+@register_op("instance_norm")
+def instance_norm(x, scale=None, bias=None, epsilon=1e-5):
+    """ref: operators/instance_norm_op.cc"""
+    m = jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+    v = jnp.var(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+    out = (x - m) * lax.rsqrt(v + epsilon)
+    c = x.shape[1]
+    shp = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(shp)
+    if bias is not None:
+        out = out + bias.reshape(shp)
+    return out
+
+
+@register_op("l2_normalize")
+def l2_normalize(x, axis=-1, epsilon=1e-12):
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+
+
+@register_op("lrn")
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    """Local response norm over channels, NCHW (ref: operators/lrn_op.cc)."""
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + padded[:, i:i + x.shape[1]]
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+# ---------------------------------------------------------------- dropout / embedding
+@register_op("dropout")
+def dropout(x, key, rate=0.5, training=True, mode="upscale_in_train"):
+    """ref: operators/dropout_op.cc — two modes like the reference:
+    'upscale_in_train' (inverted dropout) and 'downgrade_in_infer'."""
+    if not training or rate == 0.0:
+        if mode == "downgrade_in_infer" and not training:
+            return x * (1.0 - rate)
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+@register_op("lookup_table")
+def lookup_table(ids, table, padding_idx=None):
+    """Embedding lookup (ref: operators/lookup_table_op.cc). The reference's
+    SelectedRows sparse-grad path is replaced by XLA gather + (in DP) sharded
+    tables — see parallel/embedding.py."""
+    ids = jnp.squeeze(ids, -1) if ids.ndim > 1 and ids.shape[-1] == 1 else ids
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+embedding = lookup_table
+
+
+# ---------------------------------------------------------------- resize / shuffle
+@register_op("interpolate")
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """ref: operators/interpolate_op.cc (nearest/bilinear)."""
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = _pair(size)
+    if mode == "nearest":
+        ri = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        ci = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        if data_format == "NCHW":
+            return x[:, :, ri][:, :, :, ci]
+        return x[:, ri][:, :, ci]
+    # bilinear
+    if align_corners and oh > 1 and ow > 1:
+        ys = jnp.linspace(0.0, h - 1, oh)
+        xs = jnp.linspace(0.0, w - 1, ow)
+    else:
+        ys = (jnp.arange(oh) + 0.5) * (h / oh) - 0.5
+        xs = (jnp.arange(ow) + 0.5) * (w / ow) - 0.5
+        ys = jnp.clip(ys, 0, h - 1)
+        xs = jnp.clip(xs, 0, w - 1)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0).astype(x.dtype)
+    wx = (xs - x0).astype(x.dtype)
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    a = x[:, :, y0][:, :, :, x0]
+    b = x[:, :, y0][:, :, :, x1]
+    cc = x[:, :, y1][:, :, :, x0]
+    d = x[:, :, y1][:, :, :, x1]
+    wy_ = wy[None, None, :, None]
+    wx_ = wx[None, None, None, :]
+    out = (a * (1 - wy_) * (1 - wx_) + b * (1 - wy_) * wx_
+           + cc * wy_ * (1 - wx_) + d * wy_ * wx_)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor):
+    """ref: operators/pixel_shuffle_op.cc"""
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("affine_channel")
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    """ref: operators/affine_channel_op.cc"""
+    shp = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+    return x * scale.reshape(shp) + bias.reshape(shp)
+
+
+@register_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col as an op (ref: operators/unfold_op.cc / math/im2col.cc) —
+    included for parity; on TPU prefer conv directly."""
+    kh, kw = _pair(kernel_sizes)
+    s, d, p = _pair(strides), _pair(dilations), _pair(paddings)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                x[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                  j * d[1]: j * d[1] + ow * s[1]: s[1]])
+    out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)

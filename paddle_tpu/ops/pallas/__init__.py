@@ -1,0 +1,19 @@
+"""Hand-written Pallas TPU kernels.
+
+The counterpart of the reference's hand-written CUDA kernels
+(/root/reference/paddle/fluid/operators/*.cu, operators/math/*.cu,
+operators/jit/ x86 codegen): where XLA's automatic fusion isn't enough, we
+drop to Pallas for explicit VMEM tiling and MXU scheduling.
+
+Kernels gate on TPU availability and fall back to pure-XLA reference
+implementations elsewhere (CPU tests run the fallback).
+"""
+
+import jax
+
+
+def on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

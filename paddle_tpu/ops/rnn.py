@@ -1,0 +1,168 @@
+"""Recurrent ops: LSTM/GRU cells and scanned multi-step RNNs.
+
+Ref: /root/reference/paddle/fluid/operators/ — lstm_op.cc, gru_op.cc,
+operators/math/lstm_compute.cc, gru_compute.cc, and the cudnn_lstm_op.cu
+fused path; Python DynamicRNN (layers/control_flow.py) handled variable
+length via LoD.
+
+TPU-first: one `lax.scan` over time compiles the whole unrolled recurrence
+into a single XLA While loop; gates are computed as one fused [4H] / [3H]
+matmul per step (MXU-sized), and variable length is handled by a mask that
+freezes the state past each row's length — replacing LoD reordering
+(math/sequence2batch.cc) with static-shape compute.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("lstm_cell")
+def lstm_cell(x, h, c, w_ih, w_hh, b=None, forget_bias=0.0):
+    """One LSTM step. x:[B,I], h/c:[B,H], w_ih:[I,4H], w_hh:[H,4H], b:[4H].
+    Gate order i,f,g,o (ref: operators/math/lstm_compute gate layout)."""
+    gates = x @ w_ih + h @ w_hh
+    if b is not None:
+        gates = gates + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    new_c = f * c + i * g
+    new_h = o * jnp.tanh(new_c)
+    return new_h, new_c
+
+
+@register_op("gru_cell")
+def gru_cell(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+    """One GRU step. x:[B,I], h:[B,H], w_ih:[I,3H], w_hh:[H,3H].
+    Gate order r,z,n (ref: operators/math/gru_compute.cc)."""
+    gi = x @ w_ih
+    gh = h @ w_hh
+    if b_ih is not None:
+        gi = gi + b_ih
+    if b_hh is not None:
+        gh = gh + b_hh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (1.0 - z) * n + z * h
+
+
+def _masked_scan(cell_step, xs, init, lengths, reverse=False):
+    """Scan `cell_step` over time with per-row length masking. xs: [T,B,...]."""
+    t = xs.shape[0]
+    steps = jnp.arange(t)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+        steps = jnp.flip(steps, 0)
+
+    def step(carry, inp):
+        x_t, t_idx = inp
+        new_carry = cell_step(carry, x_t)
+        if lengths is not None:
+            mask = (t_idx < lengths)[:, None]
+            new_carry = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(mask, n, o), new_carry, carry)
+        out = new_carry[0] if isinstance(new_carry, tuple) else new_carry
+        return new_carry, out
+
+    final, outs = lax.scan(step, init, (xs, steps))
+    if reverse:
+        outs = jnp.flip(outs, 0)
+    return final, outs
+
+
+@register_op("lstm")
+def lstm(x, h0, c0, w_ih, w_hh, b=None, lengths=None, reverse=False,
+         time_major=False):
+    """Multi-step LSTM (ref: operators/lstm_op.cc / cudnn_lstm_op.cu).
+
+    x: [B,T,I] (or [T,B,I] when time_major). Returns (out [B,T,H], (h, c)).
+    """
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, x_t):
+        h, c = carry
+        return lstm_cell(x_t, h, c, w_ih, w_hh, b)
+
+    (h, c), outs = _masked_scan(step, x, (h0, c0), lengths, reverse)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, (h, c)
+
+
+@register_op("gru")
+def gru(x, h0, w_ih, w_hh, b_ih=None, b_hh=None, lengths=None, reverse=False,
+        time_major=False):
+    """Multi-step GRU (ref: operators/gru_op.cc)."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+
+    def step(h, x_t):
+        return gru_cell(x_t, h, w_ih, w_hh, b_ih, b_hh)
+
+    h, outs = _masked_scan(step, x, h0, lengths, reverse)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, h
+
+
+@register_op("bidirectional_lstm")
+def bidirectional_lstm(x, h0, c0, params_fwd, params_bwd, lengths=None):
+    """Concatenated fwd+bwd LSTM outputs (ref: bidirectional cudnn_lstm)."""
+    out_f, (hf, cf) = lstm(x, h0, c0, *params_fwd, lengths=lengths)
+    out_b, (hb, cb) = lstm(x, h0, c0, *params_bwd, lengths=lengths,
+                           reverse=True)
+    return jnp.concatenate([out_f, out_b], -1), ((hf, hb), (cf, cb))
+
+
+@register_op("beam_search_decode")
+def beam_search_decode(log_probs_fn, init_state, bos_id, eos_id, beam_size,
+                       max_len, batch_size, vocab_size):
+    """Static-shape beam search (ref: operators/beam_search_op.cc,
+    beam_search_decode_op.cc, math/beam_search.cc).
+
+    log_probs_fn(tokens [B*K], state) -> (log_probs [B*K, V], new_state).
+    Returns (sequences [B, K, max_len], scores [B, K]).
+    """
+    k = beam_size
+    neg_inf = -1e9
+
+    tokens0 = jnp.full((batch_size * k,), bos_id, jnp.int32)
+    # only beam 0 active at t=0 so duplicates don't fill the beam
+    scores0 = jnp.tile(jnp.concatenate(
+        [jnp.zeros((1,)), jnp.full((k - 1,), neg_inf)]), (batch_size,))
+    seqs0 = jnp.full((batch_size, k, max_len), eos_id, jnp.int32)
+    done0 = jnp.zeros((batch_size * k,), bool)
+
+    def step(carry, t):
+        tokens, scores, seqs, done, state = carry
+        logp, state = log_probs_fn(tokens, state)
+        logp = jnp.where(done[:, None],
+                         jnp.full_like(logp, neg_inf).at[:, eos_id].set(0.0),
+                         logp)
+        cand = scores[:, None] + logp           # [B*K, V]
+        cand = cand.reshape(batch_size, k * vocab_size)
+        top_scores, top_idx = lax.top_k(cand, k)   # [B, K]
+        beam_idx = top_idx // vocab_size           # which parent beam
+        tok_idx = (top_idx % vocab_size).astype(jnp.int32)
+        flat_parent = (jnp.arange(batch_size)[:, None] * k + beam_idx).reshape(-1)
+        seqs = seqs.reshape(batch_size * k, max_len)[flat_parent]
+        seqs = seqs.reshape(batch_size, k, max_len)
+        seqs = seqs.at[:, :, t].set(tok_idx)
+        tokens = tok_idx.reshape(-1)
+        done = done[flat_parent] | (tokens == eos_id)
+        state = jax.tree_util.tree_map(lambda s: s[flat_parent], state)
+        return (tokens, top_scores.reshape(-1), seqs, done, state), None
+
+    carry = (tokens0, scores0, seqs0, done0, init_state)
+    (tokens, scores, seqs, done, _), _ = lax.scan(
+        step, carry, jnp.arange(max_len))
+    return seqs, scores.reshape(batch_size, k)

@@ -1,0 +1,150 @@
+"""Sequence ops over ragged batches.
+
+Ref: /root/reference/paddle/fluid/operators/sequence_ops/ (24 ops:
+sequence_pool, sequence_softmax, sequence_expand, sequence_pad/unpad,
+sequence_concat, sequence_reverse, sequence_mask, sequence_slice,
+sequence_first/last_step …) — all keyed off LoDTensor offsets.
+
+TPU-first: sequences are `RaggedBatch` (flat values + row_lengths); pooling
+uses `jax.ops.segment_*` (static-size, XLA-scatter based), and the
+dense/padded conversions live on RaggedBatch itself. `sequence_mask` is the
+bridge to MXU-friendly padded compute.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.ragged import RaggedBatch
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("sequence_mask")
+def sequence_mask(lengths, maxlen=None, dtype=jnp.float32):
+    """ref: operators/sequence_ops/sequence_mask_op.cc"""
+    maxlen = int(maxlen) if maxlen is not None else int(jnp.max(lengths))
+    return (jnp.arange(maxlen)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool")
+def sequence_pool(rb: RaggedBatch, pool_type="sum"):
+    """ref: sequence_pool_op.cc — per-sequence {sum,mean,max,min,sqrt,first,last}."""
+    seg = rb.segment_ids()
+    n = rb.nrows
+    v = rb.values
+    if pool_type == "sum":
+        return jax.ops.segment_sum(v, seg, n)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(v, seg, n)
+        cnt = jnp.maximum(rb.row_lengths, 1).astype(v.dtype)
+        return s / cnt.reshape((-1,) + (1,) * (v.ndim - 1))
+    if pool_type == "sqrt":
+        s = jax.ops.segment_sum(v, seg, n)
+        cnt = jnp.maximum(rb.row_lengths, 1).astype(v.dtype)
+        return s / jnp.sqrt(cnt).reshape((-1,) + (1,) * (v.ndim - 1))
+    if pool_type == "max":
+        return jax.ops.segment_max(v, seg, n)
+    if pool_type == "min":
+        return jax.ops.segment_min(v, seg, n)
+    offs = rb.offsets()
+    if pool_type == "first":
+        return v[offs[:-1]]
+    if pool_type == "last":
+        return v[jnp.maximum(offs[1:] - 1, 0)]
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(rb: RaggedBatch):
+    """ref: sequence_softmax_op.cc — softmax within each sequence (1-D values)."""
+    seg = rb.segment_ids()
+    n = rb.nrows
+    m = jax.ops.segment_max(rb.values, seg, n)
+    e = jnp.exp(rb.values - m[seg])
+    z = jax.ops.segment_sum(e, seg, n)
+    return RaggedBatch(e / z[seg], rb.row_lengths)
+
+
+@register_op("sequence_expand")
+def sequence_expand(x, rb_y: RaggedBatch):
+    """ref: sequence_expand_op.cc — repeat row i of x y.row_lengths[i] times."""
+    reps = rb_y.row_lengths
+    idx = jnp.repeat(jnp.arange(x.shape[0]), reps,
+                     total_repeat_length=int(rb_y.values.shape[0]))
+    return RaggedBatch(x[idx], reps)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(rb: RaggedBatch):
+    """ref: sequence_reverse_op.cc — reverse each sequence in place."""
+    offs = rb.offsets()
+    seg = rb.segment_ids()
+    pos = jnp.arange(rb.values.shape[0])
+    local = pos - offs[seg]
+    rev_idx = offs[seg] + (rb.row_lengths[seg] - 1 - local)
+    return RaggedBatch(rb.values[rev_idx], rb.row_lengths)
+
+
+@register_op("sequence_pad")
+def sequence_pad(rb: RaggedBatch, pad_value=0.0, maxlen=None):
+    """ref: sequence_pad_op.cc — returns (padded, lengths)."""
+    dense, _ = rb.to_padded(maxlen, pad_value)
+    return dense, rb.row_lengths
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(x, lengths):
+    """ref: sequence_unpad_op.cc"""
+    return RaggedBatch.from_padded(x, lengths)
+
+
+@register_op("sequence_concat")
+def sequence_concat(rbs):
+    """ref: sequence_concat_op.cc — concat sequence-wise (row i = concat of
+    row i across inputs)."""
+    n = rbs[0].nrows
+    parts = []
+    for i in range(n):
+        for rb in rbs:
+            offs = rb.offsets()
+            parts.append(rb.values[int(offs[i]):int(offs[i + 1])])
+    values = jnp.concatenate(parts, 0)
+    lengths = rbs[0].row_lengths
+    for rb in rbs[1:]:
+        lengths = lengths + rb.row_lengths
+    return RaggedBatch(values, lengths)
+
+
+@register_op("sequence_first_step")
+def sequence_first_step(rb: RaggedBatch):
+    return sequence_pool(rb, "first")
+
+
+@register_op("sequence_last_step")
+def sequence_last_step(rb: RaggedBatch):
+    return sequence_pool(rb, "last")
+
+
+@register_op("sequence_slice")
+def sequence_slice(rb: RaggedBatch, offset, length):
+    """ref: sequence_slice_op.cc — take [offset, offset+length) of each seq."""
+    offs = rb.offsets()[:-1]
+    starts = offs + offset
+    max_l = int(jnp.max(length)) if hasattr(length, "shape") else int(length)
+    idx = starts[:, None] + jnp.arange(max_l)[None, :]
+    idx = jnp.clip(idx, 0, rb.values.shape[0] - 1)
+    vals = rb.values[idx.reshape(-1)]
+    lengths = jnp.broadcast_to(jnp.asarray(length), (rb.nrows,)).astype(jnp.int32)
+    valid = (jnp.arange(max_l)[None, :] < lengths[:, None]).reshape(-1)
+    order = jnp.argsort(~valid, stable=True)
+    return RaggedBatch(vals[order], lengths)
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(x, win_size, pad_value=0):
+    """ref: sequence_enumerate_op.cc — sliding windows over 1-D ids."""
+    n = x.shape[0]
+    idx = jnp.arange(n)[:, None] + jnp.arange(win_size)[None, :]
+    valid = idx < n
+    idx = jnp.clip(idx, 0, n - 1)
+    out = jnp.where(valid, x[idx], pad_value)
+    return out

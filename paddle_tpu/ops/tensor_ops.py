@@ -1,0 +1,439 @@
+"""Tensor manipulation ops.
+
+Ref: /root/reference/paddle/fluid/operators/ — concat_op.cc, split_op.cc,
+stack_op.cc, squeeze_op.cc, transpose_op.cc, slice_op.cc, gather_op.cc,
+scatter_op.cc, expand_op.cc, top_k_op.cc/.cu, argsort_op.cc, one_hot_op.cc,
+fill_constant_op.cc, uniform_random_op.cc, gaussian_random_op.cc, range_op.cc,
+where_op, shard_index_op.cc, unique_op.cc …
+
+All static-shape, XLA-friendly. Ops whose reference semantics are dynamic
+(masked_select, unique) return padded results + validity counts, keeping
+jit-compatibility on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtype import convert_dtype
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("cast")
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+@register_op("concat")
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_op("split")
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    idx = list(jnp.cumsum(jnp.array(num_or_sections))[:-1])
+    return jnp.split(x, [int(i) for i in idx], axis=axis)
+
+
+@register_op("stack")
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("unstack")
+def unstack(x, axis=0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+
+
+@register_op("squeeze")
+def squeeze(x, axes=None):
+    return jnp.squeeze(x, axis=tuple(axes) if axes else None)
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, axes):
+    if isinstance(axes, int):
+        axes = [axes]
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_op("reshape")
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register_op("flatten")
+def flatten(x, axis=1):
+    """ref: operators/flatten_op.cc — flatten to 2-D at `axis`."""
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return x.reshape(lead, -1)
+
+
+@register_op("transpose")
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+@register_op("slice")
+def slice(x, axes, starts, ends):
+    """ref: operators/slice_op.cc"""
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = jnp.s_[s:e:st]
+    return x[tuple(idx)]
+
+
+@register_op("gather")
+def gather(x, index, axis=0):
+    """ref: operators/gather_op.cc"""
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    """ref: operators/gather_nd_op.cc — index [..., k] selects x[idx] over
+    leading k dims."""
+    k = index.shape[-1]
+    flat_index = tuple(jnp.moveaxis(index, -1, 0))
+    return x[flat_index]
+
+
+@register_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    """ref: operators/scatter_op.cc — rows of x at `index` set/add to updates."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    flat_index = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[flat_index].add(updates)
+
+
+@register_op("expand")
+def expand(x, expand_times):
+    """ref: operators/expand_op.cc — tile semantics."""
+    return jnp.tile(x, expand_times)
+
+
+@register_op("expand_as")
+def expand_as(x, target):
+    reps = [t // s for t, s in zip(target.shape, x.shape)]
+    return jnp.tile(x, reps)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("tile")
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+@register_op("reverse")
+def reverse(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    for a in axis:
+        x = jnp.flip(x, a)
+    return x
+
+
+@register_op("roll")
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis)
+
+
+@register_op("top_k")
+def top_k(x, k):
+    """ref: operators/top_k_op.cc/.cu — returns (values, indices)."""
+    return lax.top_k(x, k)
+
+
+@register_op("argsort")
+def argsort(x, axis=-1, descending=False):
+    """ref: operators/argsort_op.cc — returns (sorted, indices)."""
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    sorted_x = jnp.take_along_axis(x, idx, axis=axis)
+    return sorted_x, idx
+
+
+@register_op("sort")
+def sort(x, axis=-1, descending=False):
+    s = jnp.sort(x, axis=axis)
+    return jnp.flip(s, axis) if descending else s
+
+
+@register_op("argmax")
+def argmax(x, axis=-1):
+    return jnp.argmax(x, axis=axis)
+
+
+@register_op("argmin")
+def argmin(x, axis=-1):
+    return jnp.argmin(x, axis=axis)
+
+
+@register_op("one_hot")
+def one_hot(x, depth, dtype=jnp.float32):
+    """ref: operators/one_hot_op.cc"""
+    x = jnp.squeeze(x, -1) if x.ndim > 1 and x.shape[-1] == 1 else x
+    return jax.nn.one_hot(x, depth, dtype=convert_dtype(dtype))
+
+
+@register_op("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        # dynamic nonzero is not jit-able; return mask-based indices padded
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+@register_op("masked_select")
+def masked_select(x, mask, size=None):
+    """Static-shape masked select: returns (values[size], count). Padded with
+    zeros — TPU redesign of the reference's dynamic-shape masked select."""
+    flat_x = x.reshape(-1)
+    flat_m = mask.reshape(-1)
+    size = size if size is not None else flat_x.shape[0]
+    order = jnp.argsort(~flat_m, stable=True)
+    vals = jnp.where(flat_m[order], flat_x[order], 0)[:size]
+    return vals, jnp.sum(flat_m.astype(jnp.int32))
+
+
+@register_op("unique_with_counts")
+def unique_with_counts(x, size=None):
+    """Static-shape unique (ref: operators/unique_op.cc): returns
+    (unique[size], counts[size], num_unique). Padded beyond num_unique."""
+    size = size if size is not None else x.shape[0]
+    u, cnt = jnp.unique_counts(x, size=size, fill_value=0)
+    num = jnp.sum(cnt > 0)
+    return u, cnt, num
+
+
+@register_op("shard_index")
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    """ref: operators/shard_index_op.cc — remap global ids to per-shard local
+    ids (used by sharded embedding / model-parallel fc)."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@register_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    """ref: operators/index_sample — per-row gather."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+# --- creation ops ---
+@register_op("fill_constant")
+def fill_constant(shape, dtype, value):
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(input, shape, dtype, value):
+    shape = (input.shape[0],) + tuple(shape[1:])
+    return jnp.full(shape, value, dtype=convert_dtype(dtype))
+
+
+@register_op("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("arange")
+def arange(start, end=None, step=1, dtype=jnp.int64):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+@register_op("linspace")
+def linspace(start, stop, num, dtype=jnp.float32):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+@register_op("eye")
+def eye(num_rows, num_columns=None, dtype=jnp.float32):
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+@register_op("diag")
+def diag(x):
+    return jnp.diag(x)
+
+
+@register_op("uniform_random")
+def uniform_random(key, shape, dtype=jnp.float32, min=-1.0, max=1.0):
+    """ref: operators/uniform_random_op.cc — explicit PRNG key (TPU-native:
+    counter-based PRNG, reproducible under jit/pjit)."""
+    return jax.random.uniform(key, shape, convert_dtype(dtype), min, max)
+
+
+@register_op("gaussian_random")
+def gaussian_random(key, shape, dtype=jnp.float32, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, shape, convert_dtype(dtype))
+
+
+@register_op("randint")
+def randint(key, low, high, shape, dtype=jnp.int32):
+    return jax.random.randint(key, shape, low, high, convert_dtype(dtype))
+
+
+@register_op("randperm")
+def randperm(key, n, dtype=jnp.int32):
+    return jax.random.permutation(key, n).astype(convert_dtype(dtype))
+
+
+@register_op("multinomial")
+def multinomial(key, probs, num_samples, replacement=True):
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, shape=probs.shape[:-1] + (num_samples,))
+    # without replacement: Gumbel-top-k trick
+    g = jax.random.gumbel(key, logits.shape)
+    _, idx = lax.top_k(logits + g, num_samples)
+    return idx
+
+
+@register_op("shape")
+def shape(x):
+    return jnp.array(x.shape, dtype=jnp.int32)
+
+
+@register_op("size")
+def size(x):
+    return jnp.array(x.size, dtype=jnp.int64)
+
+
+# --- comparison / logical (ref: operators/controlflow/compare_op.cc, logical_op.cc)
+@register_op("equal")
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@register_op("not_equal")
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@register_op("less_than")
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@register_op("less_equal")
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@register_op("greater_than")
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@register_op("greater_equal")
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@register_op("logical_and")
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@register_op("logical_or")
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@register_op("logical_xor")
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@register_op("logical_not")
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register_op("allclose")
+def allclose(x, y, rtol=1e-5, atol=1e-8):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol)
+
+
+@register_op("pad")
+def pad(x, paddings, pad_value=0.0):
+    """ref: operators/pad_op.cc — paddings is [(lo, hi), ...] per dim or flat
+    [lo0, hi0, lo1, hi1, ...]."""
+    if paddings and not isinstance(paddings[0], (tuple, list)):
+        paddings = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(len(paddings) // 2)]
+    return jnp.pad(x, paddings, constant_values=pad_value)
+
+
+@register_op("pad2d")
+def pad2d(x, paddings, mode="constant", pad_value=0.0, data_format="NCHW"):
+    """ref: operators/pad2d_op.cc — pad H/W dims of NCHW/NHWC input."""
+    t, b, l, r = paddings
+    if data_format == "NCHW":
+        pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        pads = [(0, 0), (t, b), (l, r), (0, 0)]
+    mode_map = {"constant": "constant", "reflect": "reflect", "edge": "edge"}
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=pad_value)
+    return jnp.pad(x, pads, mode=mode_map[mode])
+
+
+@register_op("meshgrid")
+def meshgrid(*xs):
+    return jnp.meshgrid(*xs, indexing="ij")
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, idx, axis):
+    return jnp.take_along_axis(x, idx, axis=axis)
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, idx, values, axis):
+    return jnp.put_along_axis(x, idx, values, axis=axis, inplace=False)
+
+
+@register_op("numel")
+def numel(x):
+    return jnp.array(x.size, jnp.int64)
+
+
+@register_op("rank")
+def rank(x):
+    return jnp.array(x.ndim, jnp.int32)

@@ -1,0 +1,37 @@
+"""Optimizer package.
+
+Ref: /root/reference/python/paddle/fluid/optimizer.py (3.7k LoC, SGD through
+Lamb + wrappers) and paddle/fluid/operators/optimizers/ (42 files).
+"""
+
+from paddle_tpu.optimizer.optimizers import (
+    Adadelta,
+    Adagrad,
+    Adam,
+    AdamW,
+    Adamax,
+    DecayedAdagrad,
+    Dpsgd,
+    Ftrl,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    Optimizer,
+    RMSProp,
+    SGD,
+)
+from paddle_tpu.optimizer.wrappers import (
+    DGCMomentum,
+    ExponentialMovingAverage,
+    Lookahead,
+    ModelAverage,
+    RecomputeOptimizer,
+)
+from paddle_tpu.optimizer import clip, lr_scheduler, regularizer
+from paddle_tpu.optimizer.clip import (
+    ClipByGlobalNorm,
+    ClipByNorm,
+    ClipByValue,
+    global_norm,
+)
+from paddle_tpu.optimizer.regularizer import L1Decay, L2Decay

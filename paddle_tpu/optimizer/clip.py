@@ -1,0 +1,57 @@
+"""Gradient clipping.
+
+Ref: /root/reference/python/paddle/fluid/clip.py — GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm (525 LoC). Each clip is a pure
+pytree→pytree transform applied before the optimizer update.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipByValue:
+    """ref: clip.py GradientClipByValue"""
+
+    def __init__(self, min, max=None):
+        if max is None:
+            min, max = -abs(min), abs(min)
+        self.min, self.max = min, max
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipByNorm:
+    """Per-tensor L2 clip (ref: clip.py GradientClipByNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipByGlobalNorm:
+    """Global-norm clip (ref: clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = jnp.sqrt(
+            jnp.sum(jnp.array([jnp.sum(jnp.square(g.astype(jnp.float32)))
+                               for g in leaves])))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype),
+                                      grads)
+
+
+def global_norm(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(jnp.sum(jnp.array(
+        [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves])))

@@ -1,0 +1,107 @@
+"""Learning-rate schedules.
+
+Ref: /root/reference/python/paddle/fluid/layers/learning_rate_scheduler.py
+(noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup) — the
+reference builds these as graph ops over a global step variable; here each is
+a pure function `step -> lr` traced into the update step.
+"""
+
+import jax.numpy as jnp
+
+
+def constant(lr):
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    def sched(step):
+        s = jnp.maximum(step.astype(jnp.float32), 1.0)
+        return learning_rate * d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * warmup_steps ** -1.5)
+    return sched
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def sched(step):
+        e = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * jnp.power(decay_rate, e)
+    return sched
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def sched(step):
+        e = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * jnp.exp(-decay_rate * e)
+    return sched
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    def sched(step):
+        e = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate / (1.0 + decay_rate * e)
+    return sched
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    def sched(step):
+        s = step.astype(jnp.float32)
+        if cycle:
+            mult = jnp.maximum(1.0, jnp.ceil(s / decay_steps))
+            ds = decay_steps * mult
+        else:
+            ds = decay_steps
+            s = jnp.minimum(s, decay_steps)
+        return (learning_rate - end_learning_rate) * \
+            jnp.power(1.0 - s / ds, power) + end_learning_rate
+    return sched
+
+
+def piecewise_decay(boundaries, values):
+    def sched(step):
+        s = step.astype(jnp.float32)
+        lr = jnp.asarray(values[0], jnp.float32)
+        for b, v in zip(boundaries, values[1:]):
+            lr = jnp.where(s >= b, v, lr)
+        return lr
+    return sched
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    def sched(step):
+        ep = jnp.floor(step.astype(jnp.float32) / step_each_epoch)
+        return learning_rate * 0.5 * (jnp.cos(ep * jnp.pi / epochs) + 1.0)
+    return sched
+
+
+def cosine_decay_steps(learning_rate, total_steps, min_lr=0.0):
+    """Continuous cosine over steps (modern variant)."""
+    def sched(step):
+        t = jnp.minimum(step.astype(jnp.float32) / total_steps, 1.0)
+        return min_lr + (learning_rate - min_lr) * 0.5 * (1 + jnp.cos(jnp.pi * t))
+    return sched
+
+
+def linear_lr_warmup(base_sched, warmup_steps, start_lr, end_lr):
+    base = base_sched if callable(base_sched) else constant(base_sched)
+
+    def sched(step):
+        s = step.astype(jnp.float32)
+        warm = start_lr + (end_lr - start_lr) * s / max(warmup_steps, 1)
+        return jnp.where(s < warmup_steps, warm, base(step))
+    return sched
+
+
+def make_schedule(lr):
+    """Normalize float | callable to a schedule fn."""
+    return lr if callable(lr) else constant(lr)

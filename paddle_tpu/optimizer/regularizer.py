@@ -1,0 +1,28 @@
+"""Weight regularization.
+
+Ref: /root/reference/python/paddle/fluid/regularizer.py — L1DecayRegularizer,
+L2DecayRegularizer (276 LoC). Applied as a gradient transform
+(grad += coeff * sign(w) or coeff * w) before the optimizer update, matching
+the reference's append_regularization_ops.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class L2Decay:
+    def __init__(self, coeff):
+        self.coeff = coeff
+
+    def __call__(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * p, grads, params)
+
+
+class L1Decay:
+    def __init__(self, coeff):
+        self.coeff = coeff
+
+    def __call__(self, grads, params):
+        return jax.tree_util.tree_map(
+            lambda g, p: g + self.coeff * jnp.sign(p), grads, params)

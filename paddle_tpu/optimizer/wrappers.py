@@ -1,0 +1,157 @@
+"""Meta-optimizers and averaging wrappers.
+
+Ref: /root/reference/python/paddle/fluid/optimizer.py — ModelAverage:2449,
+EMA (ExponentialMovingAverage):2751, RecomputeOptimizer:3278,
+LookaheadOptimizer:3571, DGCMomentumOptimizer:870.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizers import Momentum, Optimizer
+
+
+class ExponentialMovingAverage:
+    """ref: optimizer.py:2751 — shadow = decay*shadow + (1-decay)*param with
+    optional thres_steps debiasing."""
+
+    def __init__(self, decay=0.999):
+        self.decay = decay
+
+    def init(self, params):
+        return {"shadow": jax.tree_util.tree_map(jnp.copy, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def update(self, ema_state, params):
+        step = ema_state["step"] + 1
+        d = jnp.minimum(self.decay, (1.0 + step) / (10.0 + step))
+        shadow = jax.tree_util.tree_map(
+            lambda s, p: d * s + (1 - d) * p, ema_state["shadow"], params)
+        return {"shadow": shadow, "step": step}
+
+    def apply(self, ema_state):
+        """Returns averaged params for eval (ref: EMA.apply context)."""
+        return ema_state["shadow"]
+
+
+class ModelAverage:
+    """ref: optimizer.py:2449 — running accumulation of params over a window;
+    apply() yields sum/num for eval."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000):
+        self.max_window = max_average_window
+
+    def init(self, params):
+        return {"sum": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "num": jnp.zeros((), jnp.float32)}
+
+    def update(self, st, params):
+        num = st["num"] + 1
+        s = jax.tree_util.tree_map(lambda a, p: a + p, st["sum"], params)
+        # restart window when exceeding max (simplified restart policy)
+        reset = num > self.max_window
+        num = jnp.where(reset, 1.0, num)
+        s = jax.tree_util.tree_map(
+            lambda a, p: jnp.where(reset, p, a), s, params)
+        return {"sum": s, "num": num}
+
+    def apply(self, st):
+        return jax.tree_util.tree_map(lambda a: a / st["num"], st["sum"])
+
+
+class Lookahead:
+    """ref: optimizer.py LookaheadOptimizer:3571 — slow/fast weights."""
+
+    def __init__(self, inner: Optimizer, alpha=0.5, k=5):
+        self.inner = inner
+        self.alpha, self.k = alpha, k
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "slow": jax.tree_util.tree_map(jnp.copy, params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state):
+        params, inner_state = self.inner.apply_gradients(
+            params, grads, state["inner"])
+        step = state["step"] + 1
+        sync = (step % self.k) == 0
+        slow = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(sync, s + self.alpha * (f - s), s),
+            state["slow"], params)
+        params = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(sync, s, f), slow, params)
+        return params, {"inner": inner_state, "slow": slow, "step": step}
+
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, *args, **kwargs)
+        params, state = self.apply_gradients(params, grads, state)
+        return loss, params, state, aux
+
+
+class RecomputeOptimizer:
+    """Activation recomputation (ref: optimizer.py:3278 +
+    backward.py:576 _append_backward_ops_with_checkpoints_).
+
+    TPU-native: wraps segments of the loss function in `jax.checkpoint`
+    (rematerialization) — XLA re-runs the forward inside backward instead of
+    storing activations, the same FLOPs-for-HBM trade the reference's
+    checkpoint segmentation does.
+    """
+
+    def __init__(self, inner: Optimizer, policy=None):
+        self.inner = inner
+        self.policy = policy  # jax.checkpoint_policies.* or None
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def apply_gradients(self, params, grads, state):
+        return self.inner.apply_gradients(params, grads, state)
+
+    def wrap(self, fn):
+        if self.policy is not None:
+            return jax.checkpoint(fn, policy=self.policy)
+        return jax.checkpoint(fn)
+
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        ck = self.wrap(loss_fn)
+        (loss, aux), grads = jax.value_and_grad(ck, has_aux=True)(
+            params, *args, **kwargs)
+        params, state = self.apply_gradients(params, grads, state)
+        return loss, params, state, aux
+
+
+class DGCMomentum(Momentum):
+    """Deep-gradient-compression momentum (ref: optimizer.py:870
+    DGCMomentumOptimizer + operators/dgc_op.cc + sparse_all_reduce).
+
+    Single-process semantics: top-k sparsify the gradient with local
+    accumulation of the residual (momentum correction per DGC paper); the
+    distributed compressed-allreduce lives in parallel/dgc.py.
+    """
+
+    def __init__(self, learning_rate=0.01, momentum=0.9,
+                 rampup_begin_step=0, sparsity=0.999, **kw):
+        super().__init__(learning_rate, momentum, **kw)
+        self.begin = rampup_begin_step
+        self.sparsity = sparsity
+
+    def slots(self, p):
+        s = super().slots(p)
+        s["residual"] = jnp.zeros_like(p)
+        return s
+
+    def _update_leaf(self, g, p, s, lr, step):
+        from paddle_tpu.parallel.dgc import topk_sparsify
+        g = g.astype(p.dtype)
+        acc = s["residual"] + g
+        use_dgc = step >= self.begin
+        sparse_g, residual = topk_sparsify(acc, self.sparsity)
+        g_eff = jnp.where(use_dgc, sparse_g, g)
+        new_res = jnp.where(use_dgc, residual, s["residual"])
+        new_p, ms = super()._update_leaf(g_eff, p,
+                                        {"velocity": s["velocity"]}, lr, step)
+        return new_p, {"velocity": ms["velocity"], "residual": new_res}

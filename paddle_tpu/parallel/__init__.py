@@ -1,0 +1,39 @@
+"""Distribution: mesh/sharding, collectives, DP/FSDP/TP/PP/SP, compressed
+gradients, sharded embeddings, multi-host launch.
+
+Ref map (reference → here):
+  ParallelExecutor + multi_devices_graph_pass  → api.DataParallel (pjit/GSPMD)
+  operators/collective/ c_* ops                → collective.* (lax collectives)
+  nccl_helper.h rings + gen_nccl_id            → mesh.make_mesh + jax.distributed
+  DGC sparse allreduce                         → dgc.sparse_all_reduce
+  pserver / distributed_lookup_table           → embedding.ShardedEmbedding
+  PipelineTrainer/SectionWorker                → pipeline.make_pipeline_fn
+  distributed launch.py                        → launch.py
+  LocalSGD (transpiler/collective.py)          → api.local_sgd_sync
+  (new) ring attention / Ulysses SP            → ring_attention.py
+"""
+
+from paddle_tpu.parallel import (
+    api,
+    collective,
+    dgc,
+    embedding,
+    launch,
+    mesh,
+    pipeline,
+    ring_attention,
+)
+from paddle_tpu.parallel.mesh import (
+    DP, EP, FSDP, PP, SP, TP,
+    data_parallel_mesh,
+    make_mesh,
+    named_sharding,
+    replicated,
+)
+from paddle_tpu.parallel.api import (
+    DataParallel,
+    fsdp_sharding,
+    local_sgd_sync,
+    replicate,
+    shard_batch,
+)

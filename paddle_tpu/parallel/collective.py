@@ -1,0 +1,76 @@
+"""Collective communication ops.
+
+Ref: /root/reference/paddle/fluid/operators/collective/ — c_allreduce_{sum,
+max,min,prod} (c_allreduce_op.h), c_allgather, c_reducescatter, c_broadcast,
+c_sync_*_stream, c_comm_init / c_gen_nccl_id — NCCL-ring kernels bootstrapped
+over gRPC.
+
+TPU-first: these are jax.lax collectives (psum/pmean/all_gather/ppermute/
+psum_scatter) valid inside shard_map/pjit over a Mesh axis. XLA schedules
+them onto ICI neighbors (no rings to build, no unique-id bootstrap — the JAX
+distributed runtime's coordination service replaces gen_nccl_id). The
+reference's stream-sync ops (c_sync_calc_stream) have no equivalent: XLA's
+dataflow ordering subsumes them.
+"""
+
+import jax
+from jax import lax
+
+
+def all_reduce(x, axis_name, op="sum"):
+    """ref: operators/collective/c_allreduce_op.h"""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        return jax.numpy.prod(
+            lax.all_gather(x, axis_name, axis=0, tiled=False), axis=0)
+    raise ValueError(op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """ref: operators/collective/c_allgather_op.h"""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    """ref: operators/collective/c_reducescatter_op.h"""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def broadcast(x, axis_name, root=0):
+    """ref: operators/collective/c_broadcast_op.h — everyone takes root's
+    value."""
+    idx = lax.axis_index(axis_name)
+    masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    """Ring shift primitive (used by ring attention / pipeline)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_shift(x, axis_name, shift=1):
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    """Ulysses-style resharding primitive."""
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.axis_size(axis_name)

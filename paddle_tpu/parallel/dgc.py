@@ -1,0 +1,60 @@
+"""Deep gradient compression — top-k sparsified gradients.
+
+Ref: /root/reference/paddle/fluid/operators/dgc_op.cc (top-k select +
+momentum correction) and framework/details/sparse_all_reduce_op_handle.cc
+(RunImplEncoded — NCCL allgather of encoded (idx, val) pairs).
+
+TPU-first: no sparse NCCL allreduce exists on TPU either; we mirror the
+reference's *allgather-of-sparse* design with XLA: top-k select per shard
+(lax.top_k on |g|), allgather the (indices, values) pairs over the mesh axis,
+scatter-add into a dense buffer. Residuals accumulate locally (momentum
+correction in optimizer/wrappers.py DGCMomentum).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_sparsify(g, sparsity):
+    """Keep the top-(1-sparsity) fraction of |g|; returns (sparse_g,
+    residual). sparse_g + residual == g."""
+    flat = g.reshape(-1)
+    k = max(1, int(flat.shape[0] * (1.0 - sparsity)))
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    mask = jnp.zeros_like(flat, dtype=bool).at[idx].set(True)
+    sparse = jnp.where(mask, flat, 0).reshape(g.shape)
+    return sparse, g - sparse
+
+
+def topk_encode(g, k):
+    """Encode g as (indices[k], values[k]) of largest-|.| entries."""
+    flat = g.reshape(-1)
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    return idx, flat[idx]
+
+
+def topk_decode(idx, vals, shape, dtype):
+    n = 1
+    for d in shape:
+        n *= d
+    return jnp.zeros((n,), dtype).at[idx].add(vals).reshape(shape)
+
+
+def sparse_all_reduce(g, axis_name, sparsity=0.999):
+    """Compressed allreduce inside shard_map (ref:
+    sparse_all_reduce_op_handle.cc RunImplEncoded): encode local top-k,
+    allgather pairs, decode+sum. Returns (reduced_dense, local_residual).
+
+    Bandwidth: 2k*(4+4) bytes vs 4n dense — ~250x reduction at 0.1% density,
+    same as the reference's DGC premise (arXiv:1712.01887).
+    """
+    k = max(1, int(g.size * (1.0 - sparsity)))
+    idx, vals = topk_encode(g, k)
+    mask = jnp.zeros((g.size,), bool).at[idx].set(True)
+    residual = jnp.where(mask, 0, g.reshape(-1)).reshape(g.shape)
+    all_idx = lax.all_gather(idx, axis_name)      # [n, k]
+    all_vals = lax.all_gather(vals, axis_name)    # [n, k]
+    dense = jnp.zeros((g.size,), g.dtype).at[all_idx.reshape(-1)].add(
+        all_vals.reshape(-1))
+    return dense.reshape(g.shape), residual

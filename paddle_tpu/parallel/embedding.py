@@ -1,0 +1,65 @@
+"""Sharded embedding tables — the parameter-server successor.
+
+Ref: the reference's large-sparse story: remote embedding lookups against
+parameter servers (/root/reference/paddle/fluid/operators/distributed_ops/
+distributed_lookup_table_op.cc, transpiler param slicing
+distribute_transpiler.py:137-173) and PSLib sparse tables
+(framework/fleet/fleet_wrapper.h:76 PullSparseVarsSync).
+
+TPU-first: the table shards across a mesh axis ("ep" — mirrors pserver
+blocks); lookup = shard_index remap (ref: operators/shard_index_op.cc) +
+local gather + psum over the axis. Gradients flow through the same path
+reversed (scatter-add locally, psum implicit in autodiff of psum). No RPC,
+no separate server processes: ICI is the fabric. Host-offload tiers for
+beyond-HBM tables are a planned extension (orbax/jax host offload).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sharded_embedding_lookup(ids, local_table, axis_name, vocab_size):
+    """Inside shard_map: local_table [V/N, D] shard of the global table; ids
+    are global [B, T] or [B]. Returns dense embeddings, psum-combined."""
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    # ceil division so trailing ids still land in the last shard (matches
+    # shard_index, ref operators/shard_index_op.cc)
+    shard_size = -(-vocab_size // n)
+    local = ids - me * shard_size
+    in_shard = (local >= 0) & (local < shard_size)
+    safe = jnp.clip(local, 0, shard_size - 1)
+    out = jnp.take(local_table, safe, axis=0)
+    out = out * in_shard[..., None].astype(out.dtype)
+    return lax.psum(out, axis_name)
+
+
+class ShardedEmbedding:
+    """Table + optimizer-state sharding plan over the "ep" axis.
+
+    API mirrors the reference's distributed lookup-table flow:
+      init_table(key)      -> per-shard table param (use with shard_map/pjit)
+      lookup(ids, table)   -> embeddings (inside shard_map)
+    """
+
+    def __init__(self, vocab_size, dim, axis_name="ep", init_scale=0.01):
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.axis_name = axis_name
+        self.init_scale = init_scale
+
+    def global_shape(self):
+        return (self.vocab_size, self.dim)
+
+    def init_table(self, key):
+        return self.init_scale * jax.random.normal(
+            key, (self.vocab_size, self.dim))
+
+    def partition_spec(self):
+        from jax.sharding import PartitionSpec as P
+        return P(self.axis_name, None)
+
+    def lookup(self, ids, local_table):
+        return sharded_embedding_lookup(ids, local_table, self.axis_name,
+                                        self.vocab_size)

@@ -1,0 +1,92 @@
+"""Multi-host launcher + distributed runtime init.
+
+Ref: /root/reference/python/paddle/distributed/launch.py (multi-proc-per-node
+launcher exporting PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS, :78-81,159) and the gen_nccl_id gRPC bootstrap
+(operators/distributed_ops/gen_nccl_id_op.cc).
+
+TPU-first: `jax.distributed.initialize` + the JAX coordination service
+replace both — one call wires every host into the global mesh over DCN; no
+id broadcast, no per-trainer endpoint lists. The CLI here mirrors the
+reference's `python -m paddle.distributed.launch` surface for multi-process
+CPU/GPU simulation and multi-host TPU pods.
+
+Usage:
+  python -m paddle_tpu.parallel.launch --nproc 4 train.py  (local sim)
+  # on TPU pods the platform sets the env; just call init_distributed().
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+import jax
+
+from paddle_tpu.core import flags
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize the multi-host runtime (replaces gen_nccl_id bootstrap).
+    No-ops on single-process."""
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("PT_COORDINATOR")
+    if coordinator_address is None:
+        return False  # single process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes or env.get("PT_NUM_PROCESSES", 1)),
+        process_id=int(process_id or env.get("PT_PROCESS_ID", 0)))
+    return True
+
+
+def launch_local(nproc, script, script_args=(), base_port=12355,
+                 env_extra=None):
+    """Spawn nproc local processes wired into one JAX distributed job
+    (ref: launch.py _start_procs). Used by multi-host simulation tests."""
+    procs = []
+    for rank in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "PT_COORDINATOR": f"127.0.0.1:{base_port}",
+            "PT_NUM_PROCESSES": str(nproc),
+            "PT_PROCESS_ID": str(rank),
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        })
+        env.update(env_extra or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, script, *script_args], env=env))
+    return procs
+
+
+def wait_all(procs, timeout=600):
+    """Wait for all ranks; raise if any failed (ref: launch.py watch loop —
+    terminates the job when any proc dies)."""
+    codes = []
+    try:
+        for p in procs:
+            codes.append(p.wait(timeout=timeout))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(c != 0 for c in codes):
+        raise RuntimeError(f"distributed job failed, exit codes: {codes}")
+    return codes
+
+
+def main():
+    ap = argparse.ArgumentParser(description="paddle_tpu distributed launcher")
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--port", type=int, default=12355)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    procs = launch_local(args.nproc, args.script, args.script_args, args.port)
+    wait_all(procs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Pipeline parallelism — microbatched stage execution on a mesh axis.
+
+Ref: /root/reference/paddle/fluid/framework/pipeline_trainer.cc +
+section_worker.cc:141 (program cut at `cut_list` into sections; Scopes flow
+through blocking queues between section threads) and the Python splitter
+PipelineOptimizer (/root/reference/python/paddle/fluid/optimizer.py:2985).
+
+TPU-first redesign: no threads or queues — a GPipe-style schedule expressed
+as a `lax.scan` over microbatches inside `shard_map` over the "pp" axis.
+Each device holds one stage's params; activations hop stage→stage via
+`ppermute` (ICI neighbor transfer). The scan pipelines naturally: while
+device s processes microbatch m, device s-1 processes m+1 — XLA overlaps
+the ppermute with compute. Bubble fraction = (S-1)/(M+S-1), as GPipe.
+
+The reference's SectionWorker sync_steps model-replica averaging is subsumed
+by the optimizer running sharded over "pp" (each stage updates its own
+params; no cross-replica drift exists).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.parallel.mesh import PP
+
+
+def pipeline_forward(stage_fn, params, x, axis_name=PP, num_microbatches=None):
+    """Run a stage-sharded forward inside shard_map.
+
+    stage_fn(stage_params, h) -> h  — same signature every stage.
+    params: stage-stacked pytree (leading dim = n_stages, sharded over pp).
+    x: [M, mb, ...] microbatched input; only stage 0 consumes it.
+    Returns final-stage outputs stacked [M, mb, ...].
+
+    This is the inner per-device function; wrap with `shard_map` via
+    `make_pipeline_fn`.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    m = x.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # strip the stage dim (shard_map gives each device its own slice of size 1)
+    my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    total_ticks = m + n - 1
+    h_shape = jax.eval_shape(lambda p, a: stage_fn(p, a), my_params,
+                             jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+
+    def tick(carry, t):
+        inflight, outputs = carry
+        # stage 0 injects microbatch t (if any); others use what arrived
+        feed = lax.dynamic_index_in_dim(x, jnp.minimum(t, m - 1), 0,
+                                        keepdims=False)
+        h_in = jnp.where(me == 0, feed, inflight)
+        h_out = stage_fn(my_params, h_in)
+        # last stage records output for microbatch (t - (n-1))
+        out_idx = t - (n - 1)
+        valid = (out_idx >= 0) & (out_idx < m)
+        outputs = lax.cond(
+            valid & (me == n - 1),
+            lambda o: lax.dynamic_update_index_in_dim(o, h_out,
+                                                      jnp.maximum(out_idx, 0),
+                                                      0),
+            lambda o: o, outputs)
+        inflight = lax.ppermute(h_out, axis_name, perm)
+        return (inflight, outputs), None
+
+    inflight0 = jnp.zeros(h_shape.shape, h_shape.dtype)
+    outputs0 = jnp.zeros((m,) + h_shape.shape, h_shape.dtype)
+    (_, outputs), _ = lax.scan(tick, (inflight0, outputs0),
+                               jnp.arange(total_ticks))
+    # only the last stage holds real outputs (others zeros) — psum
+    # replicates the result across the pp axis
+    return lax.psum(outputs, axis_name)
+
+
+def make_pipeline_fn(mesh, stage_fn, axis_name=PP):
+    """Wrap pipeline_forward in shard_map over the pp axis.
+
+    Returns fn(stacked_params, microbatches) -> outputs where stacked_params
+    leaves have leading dim n_stages (sharded over pp) and microbatches is
+    [M, mb, ...] (replicated input; stage 0 reads it).
+    """
+    def inner(params, x):
+        return pipeline_forward(stage_fn, params, x, axis_name)
+
+    pspec = P(axis_name)
+    return shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: pspec, None,
+                                         is_leaf=lambda _: True) or pspec,
+                  P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+
+
+def stack_stage_params(per_stage_params):
+    """[{params of stage i}] -> stacked pytree with leading stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, 0), *per_stage_params)
+
+
+def split_microbatches(batch, num_microbatches):
+    """[B, ...] -> [M, B/M, ...] (ref: PipelineOptimizer microbatching)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                            + x.shape[1:]), batch)

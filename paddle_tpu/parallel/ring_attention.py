@@ -1,0 +1,85 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Ref: absent in the reference (2019-era; its sequence story was LoDTensor
+batching, /root/reference/paddle/fluid/framework/lod_tensor.h). Required by
+BASELINE north star for long-context parity. Design per the ring-attention
+pattern: Q stays put, sharded KV blocks rotate around the mesh axis via
+ppermute, each step accumulating online-softmax partial results, so a
+sequence of length T runs on N chips with T/N local memory and compute
+overlapped with neighbor transfers on ICI.
+
+Used inside shard_map with sequences sharded over axis `sp`:
+  q, k, v: [B, H, T/N, D] per device.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Online-softmax attention with KV ring rotation. Per-device shapes
+    [B, H, Tlocal, D]; sequence globally sharded over `axis_name`."""
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(q.shape[-1])
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, tl, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    def blockwise(carry, kv_blk, blk_owner):
+        m, l, acc = carry
+        kb, vb = kv_blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            q_pos = my * tl + jnp.arange(tl)
+            k_pos = blk_owner * tl + jnp.arange(tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, -1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                       vb.astype(jnp.float32))
+        return m_new, l, acc
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, state):
+        m, l, acc, kb, vb = state
+        owner = (my - i) % n  # block i arrived from device (my - i)
+        m, l, acc = blockwise((m, l, acc), (kb, vb), owner)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, acc, kb, vb
+
+    # derive carries from qf so they inherit q's varying-axes type under
+    # shard_map (scan requires carry-in/out type equality)
+    m0 = jnp.full_like(qf[..., :1], NEG_INF)
+    l0 = jnp.zeros_like(qf[..., :1])
+    acc0 = jnp.zeros_like(qf)
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, attention_fn=None, causal=False):
+    """Ulysses/DeepSpeed-style sequence parallelism: all_to_all reshards
+    [B, H, T/N, D] → [B, H/N, T, D] so each device holds full sequences for a
+    head subset, runs normal attention, then reshards back. Complements ring
+    attention: better for many-heads models, one collective pair per layer.
+    """
+    n = lax.axis_size(axis_name)
+    if attention_fn is None:
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        attention_fn = lambda q_, k_, v_: scaled_dot_product_attention(
+            q_, k_, v_, causal=causal)
+    # [B, H, Tl, D] -> heads scattered, seq gathered: [B, H/N, T, D]
+    reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=1,
+                                       concat_axis=2, tiled=True)
+    qh, kh, vh = reshard(q), reshard(k), reshard(v)
+    out = attention_fn(qh, kh, vh)
+    # back: heads gathered, seq scattered
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)

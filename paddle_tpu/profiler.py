@@ -1,0 +1,85 @@
+"""Profiling — trace collection + op-level annotation.
+
+Ref: /root/reference/paddle/fluid/platform/profiler.h:81 (RAII RecordEvent
+around every op run), :166 EnableProfiler/DisableProfiler with sorted event
+tables, CUPTI DeviceTracer → chrome-trace (device_tracer.cc, tools/
+timeline.py), and the Python context manager
+python/paddle/fluid/profiler.py.
+
+TPU-first: jax.profiler (XPlane) replaces CUPTI — traces open in
+TensorBoard/Perfetto; `record_event` maps to TraceAnnotation so framework-
+level scopes show up inside device traces; a light host-side EventRecorder
+keeps the reference's sorted-table text report.
+"""
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+from paddle_tpu.core.flags import get_flag
+
+
+@contextlib.contextmanager
+def profiler(output_dir=None):
+    """ref: fluid.profiler.profiler context manager — wraps a region,
+    writes a TensorBoard/Perfetto trace."""
+    out = output_dir or get_flag("profiler_dir")
+    jax.profiler.start_trace(out)
+    try:
+        yield out
+    finally:
+        jax.profiler.stop_trace()
+
+
+def record_event(name):
+    """RAII op annotation (ref: platform/profiler.h:81 RecordEvent).
+    Shows up as a named range in the XPlane trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_fn(name):
+    def deco(fn):
+        def wrapped(*a, **kw):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*a, **kw)
+        return wrapped
+    return deco
+
+
+class EventRecorder:
+    """Host-side timing table (ref: profiler.cc event tables printed by
+    DisableProfiler). Times python-visible spans (incl. dispatch+block)."""
+
+    def __init__(self):
+        self._events = defaultdict(list)
+
+    @contextlib.contextmanager
+    def record(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._events[name].append(time.perf_counter() - t0)
+
+    def summary(self, sort_by="total"):
+        rows = []
+        for name, times in self._events.items():
+            rows.append({
+                "name": name, "calls": len(times),
+                "total_s": sum(times),
+                "avg_ms": 1e3 * sum(times) / len(times),
+                "min_ms": 1e3 * min(times), "max_ms": 1e3 * max(times),
+            })
+        rows.sort(key=lambda r: -r["total_s"])
+        return rows
+
+    def report(self):
+        lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(ms)':>12}"
+                 f"{'Min(ms)':>12}{'Max(ms)':>12}"]
+        for r in self.summary():
+            lines.append(f"{r['name']:<40}{r['calls']:>8}{r['total_s']:>12.4f}"
+                         f"{r['avg_ms']:>12.3f}{r['min_ms']:>12.3f}"
+                         f"{r['max_ms']:>12.3f}")
+        return "\n".join(lines)

@@ -1,0 +1,15 @@
+"""Static-graph compatibility layer: Program/Executor surface.
+
+Ref: /root/reference/python/paddle/fluid/framework.py (Program :3459,
+default_main_program guards :4503) and executor.py (Executor.run :672 with
+feed/fetch). The reference builds graphs op-by-op into ProgramDesc; here a
+"static program" is a traced python function, and Executor.run matches the
+feed/fetch calling convention on top of jax.jit.
+"""
+
+from paddle_tpu.static.program import (
+    Executor,
+    StaticProgram,
+    program_from_fn,
+)
+from paddle_tpu.core.program import Program, flop_estimate

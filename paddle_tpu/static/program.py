@@ -1,0 +1,60 @@
+"""Program/Executor compatibility API.
+
+Ref: /root/reference/python/paddle/fluid/executor.py:672 Executor.run(
+program, feed={name: array}, fetch_list=[names]) — the reference injects
+feed/fetch ops into block 0 (executor.py:233,271) and interprets; here the
+program is a function of named inputs, jitted once per shape signature
+(the program-cache equivalent of executor.py:355 _get_program_cache).
+"""
+
+import jax
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.program import Program
+
+
+class StaticProgram:
+    """A named-input program: fn(**feeds) -> {name: output}."""
+
+    def __init__(self, fn, input_names, output_names, name="main"):
+        self.fn = fn
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.name = name
+
+    def capture(self, example_feed):
+        args = [example_feed[n] for n in self.input_names]
+        return Program.capture(lambda *a: self.fn(**dict(
+            zip(self.input_names, a))), *args, name=self.name)
+
+
+def program_from_fn(fn, input_names, output_names, name="main"):
+    return StaticProgram(fn, input_names, output_names, name)
+
+
+class Executor:
+    """ref: executor.py Executor — jit-compiled program cache keyed by
+    (program, shapes/dtypes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program: StaticProgram, feed=None, fetch_list=None):
+        feed = feed or {}
+        enforce(set(program.input_names) <= set(feed),
+                "missing feeds: %s",
+                set(program.input_names) - set(feed))
+        key = (id(program),
+               tuple((n, tuple(jax.numpy.shape(feed[n])),
+                      str(jax.numpy.asarray(feed[n]).dtype))
+                     for n in program.input_names))
+        if key not in self._cache:
+            self._cache[key] = jax.jit(
+                lambda *a: program.fn(**dict(zip(program.input_names, a))))
+        outs = self._cache[key](*[feed[n] for n in program.input_names])
+        if fetch_list is None:
+            return outs
+        if isinstance(outs, dict):
+            return [outs[n] for n in fetch_list]
+        return outs

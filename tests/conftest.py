@@ -1,0 +1,32 @@
+"""Test config: force an 8-device virtual CPU platform so multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4: the reference's
+single-vs-multi-device equivalence tests, parallel_executor_test_base.py,
+re-done as 1-vs-8-virtual-chip mesh tests).
+
+Note: the session's sitecustomize pre-imports jax with the axon/TPU platform,
+so env vars alone are too late — we must override via jax.config before the
+backend initializes (safe as long as nothing called jax.devices() yet).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", jax.devices()
+assert len(jax.devices()) == 8
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,59 @@
+"""Per-op golden-test harness — the OpTest pattern.
+
+Ref: /root/reference/python/paddle/fluid/tests/unittests/op_test.py:135 —
+the reference's backbone: run each op against a numpy reference
+(check_output_with_place :732) and check analytic grads against finite
+differences (get_numeric_gradient :46, check_grad_with_place :922).
+
+Here: `check_output` compares an op against a numpy fn; `check_grad`
+compares jax.grad against central finite differences.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_output(op_fn, np_fn, args, atol=1e-5, rtol=1e-5):
+    out = op_fn(*[jnp.asarray(a) for a in args])
+    ref = np_fn(*[np.asarray(a) for a in args])
+    if not isinstance(out, (tuple, list)):
+        out, ref = [out], [ref]
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(o), r, atol=atol, rtol=rtol)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-valued f at x (ref:
+    op_test.py:46 get_numeric_gradient)."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = float(f(jnp.asarray(x)))
+        flat[i] = old - eps
+        fm = float(f(jnp.asarray(x)))
+        flat[i] = old
+        gflat[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(op_fn, args, arg_idx=0, atol=5e-3, rtol=5e-3, reduce="sum"):
+    """Compare jax.grad of sum(op(args)) wrt args[arg_idx] against numeric
+    gradient (ref: op_test.py:922 check_grad_with_place)."""
+    args = [jnp.asarray(np.asarray(a, np.float64)) for a in args]
+
+    def scalar_f(x):
+        a = list(args)
+        a[arg_idx] = x
+        out = op_fn(*a)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return jnp.sum(out) if reduce == "sum" else jnp.mean(out)
+
+    analytic = np.asarray(jax.grad(scalar_f)(args[arg_idx]))
+    numeric = numeric_grad(scalar_f, args[arg_idx])
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

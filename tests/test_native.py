@@ -1,0 +1,100 @@
+"""Native (C++) component tests: dataio pipeline + predictor artifact path.
+
+Ref: the reference's C++-side tests (data_feed tests, inference/tests).
+Skipped when csrc/build is absent (build: cd csrc && cmake -B build -G Ninja
+&& ninja -C build).
+"""
+
+import os
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.data import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="csrc not built")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestNativeDataIO:
+    def test_roundtrip(self, tmp_path):
+        recs = [b"hello", b"", b"world" * 100]
+        f = str(tmp_path / "a.rec")
+        native.write_record_file(f, recs)
+        reader = native.NativeRecordReader([f], num_threads=1)
+        out = list(reader)
+        assert sorted(out) == sorted(recs)
+
+    def test_multifile_multithread(self, tmp_path):
+        files = []
+        expected = []
+        for i in range(4):
+            recs = [bytes([i]) * (j + 1) for j in range(50)]
+            expected += recs
+            f = str(tmp_path / f"f{i}.rec")
+            native.write_record_file(f, recs)
+            files.append(f)
+        reader = native.NativeRecordReader(files, num_threads=4)
+        out = list(reader)
+        assert sorted(out) == sorted(expected)
+
+    def test_epochs(self, tmp_path):
+        f = str(tmp_path / "e.rec")
+        native.write_record_file(f, [b"x", b"y"])
+        reader = native.NativeRecordReader([f], num_threads=1, epochs=3)
+        assert len(list(reader)) == 6
+
+    def test_missing_file_raises(self):
+        with pytest.raises(IOError):
+            native.NativeRecordReader(["/nonexistent/file.rec"])
+
+    def test_numpy_record_roundtrip(self, tmp_path):
+        sample = (np.arange(6, dtype=np.float32).reshape(2, 3),
+                  np.array([1], np.int64))
+        rec = native.numpy_records(sample)
+        f = str(tmp_path / "n.rec")
+        native.write_record_file(f, [rec])
+        out = list(native.NativeRecordReader([f], num_threads=1))
+        a, b = native.unpack_numpy_record(out[0])
+        np.testing.assert_allclose(a, sample[0])
+        assert int(b[0]) == 1
+
+
+class TestPredictorArtifact:
+    def test_predictor_validates_artifact(self, tmp_path):
+        """pt_predictor loads the exported artifact and exits 2 without a
+        plugin (full execution needs libtpu/PJRT plugin on the host)."""
+        binary = os.path.join(REPO, "csrc", "build", "pt_predictor")
+        if not os.path.exists(binary):
+            pytest.skip("pt_predictor not built")
+        import paddle_tpu as pt
+        from paddle_tpu import models
+
+        m = models.MLP(num_classes=3, in_dim=4)
+        v = m.init(jax.random.key(0))
+        path = str(tmp_path / "export")
+        pt.io.save_inference_model(
+            path, lambda p, x: m.apply({"params": p, "state": {}}, x),
+            (jnp.ones((2, 4)),), v["params"])
+        proc = subprocess.run([binary, "--model_dir", path],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2, proc.stderr
+        assert "6 params" in proc.stderr
+
+    def test_predictor_rejects_bad_artifact(self, tmp_path):
+        binary = os.path.join(REPO, "csrc", "build", "pt_predictor")
+        if not os.path.exists(binary):
+            pytest.skip("pt_predictor not built")
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / "model.stablehlo").write_text("module {}")
+        (bad / "params.bin").write_bytes(b"XXXX" + b"\x01\x00\x00\x00" * 2)
+        proc = subprocess.run([binary, "--model_dir", str(bad)],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "magic" in proc.stderr

@@ -1,0 +1,114 @@
+"""Layer/Module system tests (ref: unittests/test_imperative_*.py —
+test_imperative_basic.py, test_imperative_mnist.py patterns)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_linear_init_and_apply():
+    m = nn.Linear(4, 3)
+    v = m.init(jax.random.key(0))
+    assert v["params"]["weight"].shape == (4, 3)
+    out = m.apply(v, jnp.ones((2, 4)))
+    assert out.shape == (2, 3)
+
+
+def test_nested_module_param_tree():
+    class MLP(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8, act="relu")
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    m = MLP()
+    v = m.init(jax.random.key(0))
+    assert set(v["params"]) == {"fc1", "fc2"}
+    out = m.apply(v, jnp.ones((3, 4)))
+    assert out.shape == (3, 2)
+
+
+def test_module_list_sequential():
+    m = nn.Sequential([nn.Linear(4, 4, act="relu") for _ in range(3)])
+    v = m.init(jax.random.key(0))
+    out = m.apply(v, jnp.ones((2, 4)))
+    assert out.shape == (2, 4)
+    assert set(v["params"]) == {"0", "1", "2"}
+
+
+def test_batchnorm_state_updates():
+    m = nn.BatchNorm(3)
+    v = m.init(jax.random.key(0))
+    x = jnp.asarray(np.random.RandomState(0).rand(8, 3, 4, 4)
+                    .astype(np.float32)) + 5.0
+    out, new_state = m.apply(v, x, training=True)
+    # running mean moved toward batch mean (which is ~5.5)
+    assert float(new_state["mean"].mean()) > 0.1
+    # eval mode: no state returned
+    out2 = m.apply(v, x, training=False)
+    assert out2.shape == x.shape
+
+
+def test_dropout_requires_rng_only_in_train():
+    m = nn.Dropout(0.5)
+    v = m.init(jax.random.key(0))
+    x = jnp.ones((10, 10))
+    out = m.apply(v, x)  # eval: no rng needed
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    out = m.apply(v, x, training=True, rngs={"dropout": jax.random.key(1)})
+    assert float(jnp.mean((out == 0).astype(jnp.float32))) > 0.2
+
+
+def test_jit_apply_and_grad():
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(10, 4)
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(axis=1))
+
+    m = Net()
+    v = m.init(jax.random.key(0))
+    ids = jnp.array([[1, 2], [3, 4]])
+
+    @jax.jit
+    def loss(params):
+        out = m.apply({"params": params, "state": {}}, ids)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(v["params"])
+    assert g["emb"]["weight"].shape == (10, 4)
+    # only looked-up rows have gradient
+    gw = np.asarray(g["emb"]["weight"])
+    assert np.allclose(gw[0], 0) and not np.allclose(gw[1], 0)
+
+
+def test_lstm_layer():
+    m = nn.LSTM(4, 8, num_layers=2, bidirectional=True)
+    v = m.init(jax.random.key(0))
+    out, (h, c) = m.apply(v, jnp.ones((2, 5, 4)))
+    assert out.shape == (2, 5, 16)
+
+
+def test_mha_layer():
+    m = nn.MultiHeadAttention(16, 4)
+    v = m.init(jax.random.key(0))
+    out = m.apply(v, jnp.ones((2, 6, 16)), causal=True)
+    assert out.shape == (2, 6, 16)
+
+
+def test_spectral_norm():
+    m = nn.SpectralNorm((8, 4))
+    v = m.init(jax.random.key(0))
+    w = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    wn, new_state = m.apply(v, w, training=True)
+    s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+    assert s[0] < 1.5  # roughly unit spectral norm after 1 power iter

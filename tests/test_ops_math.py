@@ -1,0 +1,123 @@
+"""Golden tests for math/elementwise/reduction ops (OpTest pattern,
+ref: unittests/test_elementwise_*_op.py, test_reduce_op.py,
+test_matmul_op.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import math as M
+from tests.op_test import check_grad, check_output
+
+
+def r(shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+class TestMatmul:
+    def test_2d(self):
+        check_output(M.matmul, np.matmul, [r((4, 5)), r((5, 3), 1)])
+
+    def test_transpose(self):
+        a, b = r((5, 4)), r((5, 3), 1)
+        check_output(lambda x, y: M.matmul(x, y, transpose_x=True),
+                     lambda x, y: x.T @ y, [a, b])
+
+    def test_batched(self):
+        check_output(M.matmul, np.matmul, [r((2, 4, 5)), r((2, 5, 3), 1)])
+
+    def test_grad(self):
+        check_grad(M.matmul, [r((3, 4)), r((4, 2), 1)], arg_idx=0)
+        check_grad(M.matmul, [r((3, 4)), r((4, 2), 1)], arg_idx=1)
+
+
+class TestMul:
+    def test_mul_flatten(self):
+        x, y = r((2, 3, 4)), r((12, 5), 1)
+        check_output(lambda a, b: M.mul(a, b, x_num_col_dims=1),
+                     lambda a, b: a.reshape(2, 12) @ b, [x, y])
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,npop", [
+        (M.elementwise_add, np.add), (M.elementwise_sub, np.subtract),
+        (M.elementwise_mul, np.multiply), (M.elementwise_div, np.divide),
+        (M.elementwise_max, np.maximum), (M.elementwise_min, np.minimum),
+    ])
+    def test_binary(self, op, npop):
+        check_output(op, npop, [r((3, 4)), r((3, 4), 1) + 0.5])
+
+    def test_broadcast_axis(self):
+        x, y = r((2, 3, 4, 5)), r((3, 4), 1)
+        out = M.elementwise_add(x, y, axis=1)
+        ref = x + y.reshape(1, 3, 4, 1)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    def test_grad(self):
+        check_grad(M.elementwise_mul, [r((3, 4)), r((3, 4), 1)], 0)
+
+
+class TestReduce:
+    @pytest.mark.parametrize("op,npop", [
+        (M.reduce_sum, np.sum), (M.reduce_mean, np.mean),
+        (M.reduce_max, np.max), (M.reduce_min, np.min),
+        (M.reduce_prod, np.prod),
+    ])
+    def test_full(self, op, npop):
+        check_output(op, npop, [r((3, 4))])
+
+    def test_axis_keepdim(self):
+        x = r((2, 3, 4))
+        check_output(lambda a: M.reduce_sum(a, dim=1, keep_dim=True),
+                     lambda a: np.sum(a, 1, keepdims=True), [x])
+
+    def test_grad(self):
+        check_grad(lambda x: M.reduce_mean(x, dim=0), [r((3, 4))])
+
+
+class TestUnary:
+    @pytest.mark.parametrize("op,npop", [
+        (M.exp, np.exp), (M.log, np.log), (M.sqrt, np.sqrt),
+        (M.abs, np.abs), (M.square, np.square), (M.sin, np.sin),
+        (M.cos, np.cos), (M.floor, np.floor), (M.ceil, np.ceil),
+    ])
+    def test_fwd(self, op, npop):
+        check_output(op, npop, [r((3, 4)) + 0.1])
+
+    def test_grad(self):
+        check_grad(M.sqrt, [r((3, 4)) + 0.5])
+
+
+class TestMisc:
+    def test_scale(self):
+        check_output(lambda x: M.scale(x, 2.0, 1.0),
+                     lambda x: x * 2 + 1, [r((3,))])
+
+    def test_clip(self):
+        check_output(lambda x: M.clip(x, 0.2, 0.8),
+                     lambda x: np.clip(x, 0.2, 0.8), [r((10,))])
+
+    def test_clip_by_norm(self):
+        x = r((5,)) * 10
+        out = M.clip_by_norm(jnp.asarray(x), 1.0)
+        assert abs(float(jnp.linalg.norm(out)) - 1.0) < 1e-5
+
+    def test_cumsum(self):
+        check_output(lambda x: M.cumsum(x, axis=0),
+                     lambda x: np.cumsum(x, 0), [r((4, 3))])
+        x = r((4,))
+        out = M.cumsum(jnp.asarray(x), axis=0, exclusive=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.concatenate([[0], np.cumsum(x)[:-1]]),
+                                   rtol=1e-5)
+
+    def test_norm(self):
+        x = r((3, 4))
+        out = M.norm(jnp.asarray(x), axis=-1)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out), axis=-1), 1.0, rtol=1e-4)
+
+    def test_sum_list(self):
+        xs = [r((3,)), r((3,), 1), r((3,), 2)]
+        check_output(lambda *a: M.sum(list(a)),
+                     lambda *a: a[0] + a[1] + a[2], xs)

@@ -1,0 +1,320 @@
+"""Tests for tensor ops, losses, activations, sequence, rnn, attention,
+metrics (ref: corresponding unittests/test_*_op.py files)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.ragged import RaggedBatch
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import attention as ATT
+from paddle_tpu.ops import loss as L
+from paddle_tpu.ops import metrics_ops as MO
+from paddle_tpu.ops import rnn as R
+from paddle_tpu.ops import sequence as S
+from paddle_tpu.ops import tensor_ops as T
+from tests.op_test import check_grad, check_output
+
+
+def r(shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+class TestTensorOps:
+    def test_concat_split(self):
+        xs = [r((2, 3)), r((2, 3), 1)]
+        out = T.concat([jnp.asarray(x) for x in xs], axis=1)
+        np.testing.assert_allclose(np.asarray(out), np.concatenate(xs, 1))
+        parts = T.split(out, 2, axis=1)
+        np.testing.assert_allclose(np.asarray(parts[0]), xs[0])
+
+    def test_split_sections(self):
+        x = r((6, 2))
+        parts = T.split(jnp.asarray(x), [2, 4], axis=0)
+        assert parts[0].shape == (2, 2) and parts[1].shape == (4, 2)
+
+    def test_gather_scatter(self):
+        x = r((5, 3))
+        idx = np.array([0, 2], np.int32)
+        out = T.gather(jnp.asarray(x), jnp.asarray(idx))
+        np.testing.assert_allclose(np.asarray(out), x[[0, 2]])
+        upd = r((2, 3), 1)
+        s = T.scatter(jnp.asarray(x), jnp.asarray(idx), jnp.asarray(upd))
+        assert np.allclose(np.asarray(s)[0], upd[0])
+
+    def test_gather_nd(self):
+        x = r((3, 4, 5))
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        out = T.gather_nd(jnp.asarray(x), jnp.asarray(idx))
+        np.testing.assert_allclose(np.asarray(out), x[[0, 2], [1, 3]])
+
+    def test_topk_argsort(self):
+        x = r((3, 10))
+        vals, idx = T.top_k(jnp.asarray(x), 3)
+        ref = np.sort(x, -1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-6)
+        sv, si = T.argsort(jnp.asarray(x), descending=True)
+        np.testing.assert_allclose(np.asarray(sv)[:, :3], ref, rtol=1e-6)
+
+    def test_one_hot(self):
+        out = T.one_hot(jnp.array([[1], [3]]), 5)
+        assert out.shape == (2, 5)
+        assert float(out[0, 1]) == 1.0
+
+    def test_masked_select(self):
+        x = np.arange(6, dtype=np.float32)
+        mask = x > 2.5
+        vals, cnt = T.masked_select(jnp.asarray(x), jnp.asarray(mask), size=3)
+        assert int(cnt) == 3
+        np.testing.assert_allclose(np.asarray(vals), [3, 4, 5])
+
+    def test_shard_index(self):
+        x = jnp.array([0, 5, 9, 13])
+        out = T.shard_index(x, 20, 2, 0)
+        np.testing.assert_array_equal(np.asarray(out), [0, 5, 9, -1])
+        out = T.shard_index(x, 20, 2, 1)
+        np.testing.assert_array_equal(np.asarray(out), [-1, -1, -1, 3])
+
+    def test_unique_with_counts(self):
+        x = jnp.array([1, 1, 2, 3, 3, 3])
+        u, c, n = T.unique_with_counts(x, size=6)
+        assert int(n) == 3
+
+    def test_pad(self):
+        x = r((2, 3))
+        out = T.pad(jnp.asarray(x), [0, 0, 1, 1], pad_value=9.0)
+        assert out.shape == (2, 5)
+        assert float(out[0, 0]) == 9.0
+
+    def test_creation(self):
+        assert T.fill_constant((2, 3), "float32", 1.5).shape == (2, 3)
+        assert T.eye(3).shape == (3, 3)
+        key = jax.random.key(0)
+        u = T.uniform_random(key, (100,), min=0, max=1)
+        assert 0 <= float(u.min()) and float(u.max()) <= 1
+
+    def test_compare_logical(self):
+        a, b = jnp.array([1, 2, 3]), jnp.array([2, 2, 2])
+        assert np.asarray(T.less_than(a, b)).tolist() == [True, False, False]
+        assert np.asarray(T.logical_and(a > 1, b > 1)).tolist() == \
+            [False, True, True]
+
+
+class TestActivations:
+    @pytest.mark.parametrize("op,ref", [
+        (A.relu, lambda x: np.maximum(x, 0)),
+        (A.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+        (A.tanh, np.tanh),
+        (A.softplus, lambda x: np.log1p(np.exp(x))),
+        (A.leaky_relu, lambda x: np.where(x >= 0, x, 0.02 * x)),
+        (A.relu6, lambda x: np.clip(x, 0, 6)),
+        (A.hard_swish, lambda x: x * np.clip(x + 3, 0, 6) / 6),
+    ])
+    def test_fwd(self, op, ref):
+        x = (r((4, 5)) - 0.5) * 4
+        check_output(op, ref, [x], atol=1e-5)
+
+    def test_softmax(self):
+        x = r((3, 5))
+        out = A.softmax(jnp.asarray(x))
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out), e / e.sum(-1, keepdims=True),
+                                   atol=1e-6)
+
+    def test_gelu_grad(self):
+        check_grad(A.gelu, [(r((3, 4)) - 0.5) * 2])
+
+    def test_maxout(self):
+        x = r((2, 6, 2, 2))
+        out = A.maxout(jnp.asarray(x), 2, axis=1)
+        assert out.shape == (2, 3, 2, 2)
+
+
+class TestLosses:
+    def test_softmax_ce_matches_manual(self):
+        logits = r((4, 7))
+        labels = np.array([[1], [2], [0], [6]], np.int64)
+        loss = L.softmax_with_cross_entropy(jnp.asarray(logits),
+                                            jnp.asarray(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels[:, 0]])[:, None]
+        np.testing.assert_allclose(np.asarray(loss), ref, atol=1e-5)
+
+    def test_soft_label(self):
+        logits = r((3, 5))
+        soft = np.full((3, 5), 0.2, np.float32)
+        loss = L.softmax_with_cross_entropy(jnp.asarray(logits),
+                                            jnp.asarray(soft), soft_label=True)
+        assert loss.shape == (3, 1)
+
+    def test_sigmoid_ce(self):
+        x, y = r((4, 3)) * 2 - 1, (r((4, 3), 1) > 0.5).astype(np.float32)
+        loss = L.sigmoid_cross_entropy_with_logits(jnp.asarray(x),
+                                                   jnp.asarray(y))
+        p = 1 / (1 + np.exp(-x))
+        ref = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        np.testing.assert_allclose(np.asarray(loss), ref, atol=1e-5)
+
+    def test_mse_huber_smooth(self):
+        x, y = r((4,)), r((4,), 1)
+        np.testing.assert_allclose(np.asarray(L.mse_loss(
+            jnp.asarray(x), jnp.asarray(y))), (x - y) ** 2, atol=1e-6)
+        h = L.huber_loss(jnp.asarray(x), jnp.asarray(y), delta=0.1)
+        assert h.shape == (4,)
+
+    def test_ctc_loss_runs(self):
+        logits = jnp.asarray(r((2, 10, 6)))
+        loss = L.ctc_loss(logits, jnp.array([10, 8]),
+                          jnp.array([[1, 2, 3, 0], [2, 4, 0, 0]]),
+                          jnp.array([3, 2]))
+        assert loss.shape == (2,)
+        assert np.all(np.asarray(loss) > 0)
+
+    def test_grad(self):
+        check_grad(lambda x: L.softmax_with_cross_entropy(
+            x, jnp.array([[1], [2]], jnp.int32)), [r((2, 5))])
+
+
+class TestSequence:
+    def make_rb(self):
+        return RaggedBatch.from_list(
+            [np.arange(3, dtype=np.float32).reshape(3, 1),
+             np.arange(5, dtype=np.float32).reshape(5, 1) + 10])
+
+    def test_pool(self):
+        rb = self.make_rb()
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_pool(rb, "sum")).reshape(-1), [3, 60])
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_pool(rb, "mean")).reshape(-1), [1, 12])
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_pool(rb, "max")).reshape(-1), [2, 14])
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_pool(rb, "first")).reshape(-1), [0, 10])
+        np.testing.assert_allclose(
+            np.asarray(S.sequence_pool(rb, "last")).reshape(-1), [2, 14])
+
+    def test_pad_unpad_roundtrip(self):
+        rb = self.make_rb()
+        dense, lengths = S.sequence_pad(rb, maxlen=6)
+        assert dense.shape == (2, 6, 1)
+        rb2 = S.sequence_unpad(dense, lengths)
+        np.testing.assert_allclose(np.asarray(rb2.values),
+                                   np.asarray(rb.values))
+
+    def test_reverse(self):
+        rb = self.make_rb()
+        rev = S.sequence_reverse(rb)
+        np.testing.assert_allclose(np.asarray(rev.values).reshape(-1),
+                                   [2, 1, 0, 14, 13, 12, 11, 10])
+
+    def test_softmax(self):
+        rb = RaggedBatch.from_list([np.array([1.0, 2.0]),
+                                    np.array([1.0, 1.0, 1.0])])
+        sm = S.sequence_softmax(rb)
+        v = np.asarray(sm.values)
+        np.testing.assert_allclose(v[:2].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(v[2:], 1 / 3, rtol=1e-5)
+
+    def test_expand(self):
+        x = jnp.asarray(r((2, 3)))
+        rby = RaggedBatch.from_list([np.zeros(2), np.zeros(3)])
+        out = S.sequence_expand(x, rby)
+        assert out.values.shape == (5, 3)
+
+    def test_mask(self):
+        m = S.sequence_mask(jnp.array([1, 3]), maxlen=4)
+        np.testing.assert_allclose(np.asarray(m),
+                                   [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+class TestRNN:
+    def test_lstm_shapes_and_masking(self):
+        x = jnp.asarray(r((2, 5, 3)))
+        h0 = jnp.zeros((2, 4))
+        c0 = jnp.zeros((2, 4))
+        w_ih, w_hh = jnp.asarray(r((3, 16), 1)), jnp.asarray(r((4, 16), 2))
+        out, (h, c) = R.lstm(x, h0, c0, w_ih, w_hh,
+                             lengths=jnp.array([5, 3]))
+        assert out.shape == (2, 5, 4)
+        # sequence 1 frozen after t=3: outputs at t=3,4 equal output at t=2
+        np.testing.assert_allclose(np.asarray(out)[1, 3], np.asarray(out)[1, 2])
+        np.testing.assert_allclose(np.asarray(h)[1], np.asarray(out)[1, 2])
+
+    def test_gru_cell_bounds(self):
+        h = R.gru_cell(jnp.asarray(r((2, 3))), jnp.zeros((2, 4)),
+                       jnp.asarray(r((3, 12), 1)), jnp.asarray(r((4, 12), 2)))
+        assert h.shape == (2, 4)
+        assert np.all(np.abs(np.asarray(h)) <= 1.0)
+
+    def test_lstm_grad_flows(self):
+        x = jnp.asarray(r((1, 3, 2)))
+        w_ih = jnp.asarray(r((2, 8), 1))
+
+        def f(w):
+            out, _ = R.lstm(x, jnp.zeros((1, 2)), jnp.zeros((1, 2)), w,
+                            jnp.asarray(r((2, 8), 2)))
+            return jnp.sum(out)
+        g = jax.grad(f)(w_ih)
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.sum(jnp.abs(g))) > 0
+
+
+class TestAttention:
+    def test_sdpa_matches_manual(self):
+        q = r((1, 2, 4, 8))
+        out = ATT.scaled_dot_product_attention(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q))
+        s = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(8)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, q)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def test_causal_mask(self):
+        q = jnp.asarray(r((1, 1, 4, 8)))
+        out = ATT.scaled_dot_product_attention(q, q, q, causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 0],
+                                   np.asarray(q)[0, 0, 0], atol=1e-5)
+
+    def test_flash_matches_sdpa(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        q = jnp.asarray(r((2, 2, 32, 16)))
+        k = jnp.asarray(r((2, 2, 32, 16), 1))
+        v = jnp.asarray(r((2, 2, 32, 16), 2))
+        ref = ATT.scaled_dot_product_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_k=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_flash_grad_matches(self):
+        from paddle_tpu.ops.pallas.flash_attention import chunked_attention
+        q = jnp.asarray(r((1, 1, 16, 8)))
+        g1 = jax.grad(lambda a: jnp.sum(chunked_attention(a, q, q,
+                                                          chunk_size=4)))(q)
+        g2 = jax.grad(lambda a: jnp.sum(ATT.scaled_dot_product_attention(
+            a, q, q)))(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+
+    def test_mha(self):
+        x = jnp.asarray(r((2, 5, 16)))
+        w = [jnp.asarray(r((16, 16), i)) for i in range(4)]
+        out = ATT.multihead_attention(x, *w, num_heads=4)
+        assert out.shape == (2, 5, 16)
+
+
+class TestMetricsOps:
+    def test_accuracy(self):
+        logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+        labels = np.array([1, 0, 0], np.int64)
+        acc = MO.accuracy(jnp.asarray(logits), jnp.asarray(labels))
+        np.testing.assert_allclose(float(acc), 2 / 3, rtol=1e-6)
+
+    def test_auc_perfect(self):
+        preds = np.array([0.1, 0.2, 0.8, 0.9], np.float32)
+        labels = np.array([0, 0, 1, 1], np.int64)
+        a = MO.auc(jnp.asarray(preds), jnp.asarray(labels))
+        assert float(a) > 0.99

@@ -1,0 +1,206 @@
+"""Golden tests for nn ops (ref: unittests/test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_layer_norm_op.py,
+test_dropout_op.py, test_lookup_table_op.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import nn as F
+from tests.op_test import check_grad, check_output
+
+
+def r(shape, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(np.float32)
+
+
+def np_conv2d(x, w, stride=1, pad=0):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    x = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, :, i * stride:i * stride + kh,
+                      j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2D:
+    def test_basic(self):
+        x, w = r((2, 3, 8, 8)), r((4, 3, 3, 3), 1)
+        check_output(lambda a, b: F.conv2d(a, b),
+                     lambda a, b: np_conv2d(a, b), [x, w], atol=1e-4)
+
+    def test_stride_pad(self):
+        x, w = r((1, 2, 9, 9)), r((3, 2, 3, 3), 1)
+        check_output(lambda a, b: F.conv2d(a, b, stride=2, padding=1),
+                     lambda a, b: np_conv2d(a, b, 2, 1), [x, w], atol=1e-4)
+
+    def test_groups(self):
+        x, w = r((1, 4, 6, 6)), r((4, 2, 3, 3), 1)
+        out = F.conv2d(jnp.asarray(x), jnp.asarray(w), groups=2)
+        ref = np.concatenate([
+            np_conv2d(x[:, :2], w[:2]), np_conv2d(x[:, 2:], w[2:])], 1)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    def test_grad(self):
+        check_grad(lambda a, b: F.conv2d(a, b, padding=1),
+                   [r((1, 2, 4, 4)), r((2, 2, 3, 3), 1)], arg_idx=1)
+
+    def test_depthwise(self):
+        x, w = r((1, 3, 6, 6)), r((3, 1, 3, 3), 1)
+        out = F.depthwise_conv2d(jnp.asarray(x), jnp.asarray(w))
+        assert out.shape == (1, 3, 4, 4)
+
+    def test_transpose_inverts_shape(self):
+        x = r((1, 4, 5, 5))
+        w = r((4, 6, 3, 3), 1)  # [in, out, kh, kw]
+        out = F.conv2d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2,
+                                 padding=1, output_padding=1)
+        assert out.shape == (1, 6, 10, 10)
+
+
+class TestPool:
+    def test_max(self):
+        x = r((1, 2, 4, 4))
+        out = F.pool2d(jnp.asarray(x), 2, "max", 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    def test_avg(self):
+        x = r((1, 2, 4, 4))
+        out = F.pool2d(jnp.asarray(x), 2, "avg", 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    def test_global(self):
+        x = r((2, 3, 5, 5))
+        out = F.pool2d(jnp.asarray(x), pool_type="avg", global_pooling=True)
+        np.testing.assert_allclose(np.asarray(out)[..., 0, 0],
+                                   x.mean((2, 3)), rtol=1e-6)
+
+    def test_adaptive(self):
+        x = r((1, 2, 8, 8))
+        out = F.adaptive_pool2d(jnp.asarray(x), 2, "avg")
+        ref = x.reshape(1, 2, 2, 4, 2, 4).mean((3, 5))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+
+class TestNorms:
+    def test_batch_norm_train(self):
+        x = r((4, 3, 5, 5))
+        scale, bias = np.ones(3, np.float32), np.zeros(3, np.float32)
+        out, nm, nv = F.batch_norm(jnp.asarray(x), jnp.asarray(scale),
+                                   jnp.asarray(bias), jnp.zeros(3),
+                                   jnp.ones(3), training=True)
+        m = x.mean((0, 2, 3))
+        v = x.var((0, 2, 3))
+        ref = (x - m.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+        # running stats updated toward batch stats
+        np.testing.assert_allclose(np.asarray(nm), 0.1 * m, atol=1e-5)
+
+    def test_batch_norm_eval(self):
+        x = r((4, 3, 5, 5))
+        out, _, _ = F.batch_norm(jnp.asarray(x), jnp.ones(3), jnp.zeros(3),
+                                 jnp.zeros(3), jnp.ones(3), training=False)
+        np.testing.assert_allclose(np.asarray(out),
+                                   x / np.sqrt(1 + 1e-5), atol=1e-5)
+
+    def test_layer_norm(self):
+        x = r((4, 10))
+        out = F.layer_norm(jnp.asarray(x), jnp.ones(10), jnp.zeros(10),
+                           begin_norm_axis=1)
+        m = x.mean(1, keepdims=True)
+        v = x.var(1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out), (x - m) / np.sqrt(v + 1e-5),
+                                   atol=1e-4)
+
+    def test_layer_norm_grad(self):
+        check_grad(lambda x: F.layer_norm(x, begin_norm_axis=1),
+                   [r((3, 6))], atol=1e-2)
+
+    def test_group_norm(self):
+        x = r((2, 4, 3, 3))
+        out = F.group_norm(jnp.asarray(x), groups=2)
+        xg = x.reshape(2, 2, 2, 3, 3)
+        m = xg.mean((2, 3, 4), keepdims=True)
+        v = xg.var((2, 3, 4), keepdims=True)
+        ref = ((xg - m) / np.sqrt(v + 1e-5)).reshape(2, 4, 3, 3)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    def test_instance_norm(self):
+        x = r((2, 3, 4, 4))
+        out = F.instance_norm(jnp.asarray(x))
+        m = x.mean((2, 3), keepdims=True)
+        v = x.var((2, 3), keepdims=True)
+        np.testing.assert_allclose(np.asarray(out), (x - m) / np.sqrt(v + 1e-5),
+                                   atol=1e-4)
+
+    def test_rms_norm(self):
+        x = r((2, 8))
+        out = F.rms_norm(jnp.asarray(x))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_scale(self):
+        x = np.ones((1000,), np.float32)
+        out = F.dropout(jnp.asarray(x), jax.random.key(0), 0.3, training=True)
+        kept = np.asarray(out) > 0
+        assert abs(kept.mean() - 0.7) < 0.05
+        np.testing.assert_allclose(np.asarray(out)[kept], 1.0 / 0.7, rtol=1e-5)
+
+    def test_dropout_eval(self):
+        x = r((5, 5))
+        out = F.dropout(jnp.asarray(x), None, 0.5, training=False)
+        np.testing.assert_allclose(np.asarray(out), x)
+
+    def test_lookup_table(self):
+        table = r((10, 4))
+        ids = np.array([[1], [3], [7]], np.int64)
+        out = F.lookup_table(jnp.asarray(ids), jnp.asarray(table))
+        np.testing.assert_allclose(np.asarray(out), table[[1, 3, 7]])
+
+    def test_lookup_padding_idx(self):
+        table = r((10, 4))
+        ids = np.array([0, 5], np.int64)
+        out = F.lookup_table(jnp.asarray(ids), jnp.asarray(table),
+                             padding_idx=0)
+        np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+
+
+class TestResize:
+    def test_nearest(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = F.interpolate(jnp.asarray(x), size=(2, 2), mode="nearest")
+        np.testing.assert_allclose(np.asarray(out).reshape(2, 2),
+                                   x[0, 0][::2, ::2])
+
+    def test_bilinear_identity(self):
+        x = r((1, 2, 4, 4))
+        out = F.interpolate(jnp.asarray(x), size=(4, 4), mode="bilinear")
+        np.testing.assert_allclose(np.asarray(out), x, atol=1e-5)
+
+    def test_pixel_shuffle(self):
+        x = r((1, 4, 2, 2))
+        out = F.pixel_shuffle(jnp.asarray(x), 2)
+        assert out.shape == (1, 1, 4, 4)
+
+
+class TestFC:
+    def test_fc(self):
+        x, w, b = r((3, 4)), r((4, 5), 1), r((5,), 2)
+        check_output(lambda a, ww, bb: F.fc(a, ww, bb),
+                     lambda a, ww, bb: a @ ww + bb, [x, w, b])
+
+    def test_fc_flatten(self):
+        x, w = r((2, 3, 4)), r((12, 5), 1)
+        out = F.fc(jnp.asarray(x), jnp.asarray(w))
+        assert out.shape == (2, 5)

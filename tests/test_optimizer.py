@@ -1,0 +1,175 @@
+"""Optimizer tests (ref: unittests/test_sgd_op.py, test_momentum_op.py,
+test_adam_op.py, test_lamb_op.py, test_lookahead.py + convergence fixtures
+like tests/book/test_fit_a_line.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.optimizer as opt
+from paddle_tpu.optimizer import lr_scheduler as lrs
+
+
+def quadratic_problem():
+    """min ||Wx - y||^2 over W — convex, checks convergence."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+    w_true = jnp.asarray(rng.rand(4, 3).astype(np.float32))
+    y = x @ w_true
+
+    def loss_fn(params, batch=None):
+        pred = x @ params["w"]
+        return jnp.mean(jnp.square(pred - y)), pred
+
+    params = {"w": jnp.zeros((4, 3))}
+    return loss_fn, params
+
+
+@pytest.mark.parametrize("maker", [
+    lambda: opt.SGD(0.5),
+    lambda: opt.Momentum(0.1, 0.9),
+    lambda: opt.Momentum(0.1, 0.9, use_nesterov=True),
+    lambda: opt.Adam(0.1),
+    lambda: opt.AdamW(0.1, weight_decay=0.0),
+    lambda: opt.Adamax(0.1),
+    lambda: opt.Adagrad(0.5),
+    lambda: opt.Adadelta(5.0),
+    lambda: opt.RMSProp(0.05),
+    lambda: opt.DecayedAdagrad(0.3),
+    lambda: opt.Ftrl(0.5),
+    lambda: opt.Lamb(0.1, lamb_weight_decay=0.0),
+    lambda: opt.LarsMomentum(5.0),  # LARS trust ratio is tiny near w=0
+])
+def test_converges(maker):
+    loss_fn, params = quadratic_problem()
+    o = maker()
+    st = o.init(params)
+    loss0 = None
+    for i in range(100):
+        loss, params, st, _ = jax.jit(
+            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+        if loss0 is None:
+            loss0 = float(loss)
+    assert float(loss) < loss0 * 0.1, (float(loss), loss0)
+
+
+def test_sgd_exact_step():
+    """ref: test_sgd_op.py — param -= lr * grad exactly."""
+    o = opt.SGD(0.1)
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.full((3,), 2.0)}
+    st = o.init(params)
+    new, st = o.apply_gradients(params, grads, st)
+    np.testing.assert_allclose(np.asarray(new["w"]), 1.0 - 0.1 * 2.0,
+                               rtol=1e-6)
+    assert int(st["step"]) == 1
+
+
+def test_momentum_matches_reference_formula():
+    """ref: operators/optimizers/momentum_op.h formula."""
+    o = opt.Momentum(0.1, 0.9)
+    p = {"w": jnp.ones((2,))}
+    g = {"w": jnp.full((2,), 1.0)}
+    st = o.init(p)
+    p, st = o.apply_gradients(p, g, st)
+    # v1 = 0.9*0 + 1 = 1; p1 = 1 - 0.1*1 = 0.9
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.9, rtol=1e-6)
+    p, st = o.apply_gradients(p, g, st)
+    # v2 = 0.9*1 + 1 = 1.9; p2 = 0.9 - 0.19 = 0.71
+    np.testing.assert_allclose(np.asarray(p["w"]), 0.71, rtol=1e-6)
+
+
+def test_adam_bias_correction():
+    """ref: test_adam_op.py — first step equals lr*sign(g) scaled."""
+    o = opt.Adam(0.001, 0.9, 0.999, epsilon=0.0)
+    p = {"w": jnp.zeros((1,))}
+    g = {"w": jnp.full((1,), 3.0)}
+    st = o.init(p)
+    p, st = o.apply_gradients(p, g, st)
+    np.testing.assert_allclose(np.asarray(p["w"]), -0.001, rtol=1e-5)
+
+
+def test_clip_by_global_norm():
+    c = opt.ClipByGlobalNorm(1.0)
+    grads = {"a": jnp.full((4,), 10.0), "b": jnp.full((3,), -10.0)}
+    clipped = c(grads)
+    gn = float(opt.global_norm(clipped))
+    np.testing.assert_allclose(gn, 1.0, rtol=1e-5)
+
+
+def test_l2_decay():
+    reg = opt.L2Decay(0.1)
+    grads = {"w": jnp.zeros((2,))}
+    params = {"w": jnp.full((2,), 3.0)}
+    out = reg(grads, params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.3, rtol=1e-6)
+
+
+def test_lookahead():
+    loss_fn, params = quadratic_problem()
+    o = opt.Lookahead(opt.SGD(0.5), alpha=0.5, k=5)
+    st = o.init(params)
+    for _ in range(60):
+        loss, params, st, _ = jax.jit(
+            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+    assert float(loss) < 1e-2
+
+
+def test_ema():
+    ema = opt.ExponentialMovingAverage(0.9)
+    params = {"w": jnp.ones((2,))}
+    st = ema.init(params)
+    st = ema.update(st, {"w": jnp.zeros((2,))})
+    shadow = ema.apply(st)
+    assert 0.0 < float(shadow["w"][0]) < 1.0
+
+
+def test_recompute_matches_plain():
+    loss_fn, params = quadratic_problem()
+    plain = opt.SGD(0.1)
+    rec = opt.RecomputeOptimizer(opt.SGD(0.1))
+    p1, s1 = dict(params), plain.init(params)
+    p2, s2 = dict(params), rec.init(params)
+    for _ in range(3):
+        _, p1, s1, _ = plain.minimize(loss_fn, p1, s1)
+        _, p2, s2, _ = rec.minimize(loss_fn, p2, s2)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
+
+
+def test_dgc_momentum_converges():
+    loss_fn, params = quadratic_problem()
+    o = opt.DGCMomentum(0.1, 0.9, rampup_begin_step=5, sparsity=0.5)
+    st = o.init(params)
+    for _ in range(150):
+        loss, params, st, _ = jax.jit(
+            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+    assert float(loss) < 0.05
+
+
+def test_lr_schedules():
+    step = jnp.asarray(0)
+    assert float(lrs.noam_decay(512, 4000)(jnp.asarray(1))) > 0
+    poly = lrs.polynomial_decay(0.1, 100, 0.01)
+    np.testing.assert_allclose(float(poly(jnp.asarray(0))), 0.1, rtol=1e-5)
+    np.testing.assert_allclose(float(poly(jnp.asarray(100))), 0.01, rtol=1e-5)
+    pw = lrs.piecewise_decay([10, 20], [0.1, 0.01, 0.001])
+    assert float(pw(jnp.asarray(5))) == pytest.approx(0.1)
+    assert float(pw(jnp.asarray(15))) == pytest.approx(0.01)
+    assert float(pw(jnp.asarray(25))) == pytest.approx(0.001)
+    warm = lrs.linear_lr_warmup(lrs.constant(0.1), 10, 0.0, 0.1)
+    assert float(warm(jnp.asarray(5))) == pytest.approx(0.05)
+    assert float(warm(jnp.asarray(50))) == pytest.approx(0.1)
+    exp = lrs.exponential_decay(0.1, 10, 0.5, staircase=True)
+    assert float(exp(jnp.asarray(19))) == pytest.approx(0.05)
+
+
+def test_schedule_in_optimizer():
+    loss_fn, params = quadratic_problem()
+    o = opt.SGD(lrs.piecewise_decay([50], [0.5, 0.05]))
+    st = o.init(params)
+    for _ in range(100):
+        loss, params, st, _ = jax.jit(
+            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+    assert float(loss) < 5e-3

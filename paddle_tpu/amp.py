@@ -28,19 +28,22 @@ class Policy:
     output_dtype: object = jnp.float32
 
     def cast_to_compute(self, tree):
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(self.compute_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+        return _cast_floating(tree, self.compute_dtype)
 
     def cast_to_param(self, tree):
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(self.param_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+        return _cast_floating(tree, self.param_dtype)
 
     def cast_to_output(self, tree):
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(self.output_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    """Cast floating arrays; pass python scalars / int arrays through."""
+    def leaf(x):
+        if not hasattr(x, "dtype"):
+            return x
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def bf16_policy():

@@ -28,8 +28,8 @@ class DataLoader:
     numpy samples (tuples), get device-resident batches.
     """
 
-    def __init__(self, batch_reader, places=None, prefetch=None, mesh=None,
-                 sharding_axis="dp", drop_last=True):
+    def __init__(self, batch_reader, prefetch=None, mesh=None,
+                 sharding_axis="dp"):
         self._batch_reader = batch_reader
         self._prefetch = prefetch or get_flag("reader_queue_size")
         self._mesh = mesh
@@ -66,8 +66,7 @@ class DataLoader:
             if buf and not drop_last:
                 yield _collate(buf)
 
-        return DataLoader(batch_reader, mesh=mesh, prefetch=prefetch,
-                          drop_last=drop_last)
+        return DataLoader(batch_reader, mesh=mesh, prefetch=prefetch)
 
     @staticmethod
     def from_batch_generator(generator, mesh=None, prefetch=None):
@@ -104,10 +103,15 @@ class DataLoader:
             except Exception as e:  # propagate to consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(stop)
-                except queue.Full:
-                    pass
+                # same cancellable retry as data batches — dropping the
+                # sentinel when the queue is momentarily full would leave
+                # the consumer blocked on q.get() forever
+                while not cancelled.is_set():
+                    try:
+                        q.put(stop, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()

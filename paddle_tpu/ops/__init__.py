@@ -16,6 +16,7 @@ Submodules:
   rnn           lstm/gru cells + scans          (ref: lstm_op.cc, gru_op.cc)
   metrics_ops   accuracy/auc/precision_recall   (ref: operators/metrics/)
   attention     fused attention                 (ref: ir multihead_matmul fuse)
+  detection     vision/detection ops            (ref: operators/detection/)
   pallas        hand-written TPU kernels        (ref: hand-written CUDA kernels)
 """
 
@@ -23,6 +24,7 @@ from paddle_tpu.ops import (
     activations,
     attention,
     control_flow,
+    detection,
     loss,
     math,
     metrics_ops,

@@ -1,0 +1,722 @@
+"""Vision / detection ops.
+
+Ref: /root/reference/paddle/fluid/operators/detection/ (60 files, ~15.4k LoC):
+iou_similarity_op.cc, box_coder_op.cc, prior_box_op.cc, density_prior_box_op.cc,
+anchor_generator_op.cc, yolo_box_op.cc, yolov3_loss_op.cc, multiclass_nms_op.cc,
+roi_align_op (operators/roi_align_op.cc), roi_pool_op.cc,
+generate_proposals_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+box_clip_op.cc, and python/paddle/fluid/layers/detection.py wrappers.
+
+TPU-first notes:
+  * Everything is STATIC-SHAPE. Ops that in the reference emit variable-length
+    LoD outputs (multiclass_nms, generate_proposals) instead return fixed-size
+    tensors padded with -1 plus an explicit valid-count/mask — the XLA-friendly
+    convention (same trick as TF's combined_non_max_suppression).
+  * NMS is a greedy suppression scan over a precomputed IoU matrix — O(N^2)
+    vectorized work on the VPU beats data-dependent loops that cannot compile.
+  * roi_align requires a positive static `sampling_ratio` (the reference's
+    adaptive ceil(roi_h/pooled_h) grid is data-dependent; we default -1 -> 2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.registry import register_op
+
+
+# ------------------------------------------------------------------ IoU
+@register_op("iou_similarity")
+def iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU between x:[N,4] and y:[M,4] -> [N,M].
+
+    ref: detection/iou_similarity_op.{cc,h} (IOUSimilarityFunctor)."""
+    offset = 0.0 if box_normalized else 1.0
+    ax1, ay1, ax2, ay2 = jnp.split(x, 4, axis=-1)          # [N,1]
+    bx1, by1, bx2, by2 = [v.T for v in jnp.split(y, 4, axis=-1)]  # [1,M]
+    area_x = (ax2 - ax1 + offset) * (ay2 - ay1 + offset)
+    area_y = (bx2 - bx1 + offset) * (by2 - by1 + offset)
+    iw = jnp.clip(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + offset,
+                  0.0, None)
+    ih = jnp.clip(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + offset,
+                  0.0, None)
+    inter = iw * ih
+    union = area_x + area_y - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("box_clip")
+def box_clip(boxes, im_shape):
+    """Clip [..,4] boxes to image [h, w]. ref: detection/box_clip_op.cc."""
+    h, w = im_shape[0], im_shape[1]
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    x1 = jnp.clip(x1, 0.0, w - 1.0)
+    y1 = jnp.clip(y1, 0.0, h - 1.0)
+    x2 = jnp.clip(x2, 0.0, w - 1.0)
+    y2 = jnp.clip(y2, 0.0, h - 1.0)
+    return jnp.concatenate([x1, y1, x2, y2], axis=-1)
+
+
+# ------------------------------------------------------------------ box_coder
+@register_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    """Encode/decode boxes against priors. ref: detection/box_coder_op.{cc,h}.
+
+    encode_center_size: target [N,4] x prior [M,4] -> [N,M,4]
+    decode_center_size: target [N,M,4]-or-[N,4] deltas + priors -> boxes.
+    prior_box_var: None | [4] | same-shape-as-prior variances."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((prior_box.shape[0], 4), prior_box.dtype)
+    else:
+        var = jnp.broadcast_to(jnp.asarray(prior_box_var),
+                               (prior_box.shape[0], 4))
+
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        # [N,1] vs [1,M] broadcast -> [N,M]
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        dw = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+        dh = jnp.log(th[:, None] / ph[None, :]) / var[None, :, 3]
+        return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+    enforce(code_type == "decode_center_size", "unknown code_type %s" % code_type)
+    t = target_box
+    if t.ndim == 2:
+        t = t[:, None, :] if axis == 0 else t[None, :, :]
+    if axis == 0:   # priors broadcast along rows
+        pcx_, pcy_, pw_, ph_ = pcx[None, :], pcy[None, :], pw[None, :], ph[None, :]
+        v = var[None, :, :]
+    else:           # axis == 1: priors along the first dim
+        pcx_, pcy_, pw_, ph_ = pcx[:, None], pcy[:, None], pw[:, None], ph[:, None]
+        v = var[:, None, :]
+    cx = v[..., 0] * t[..., 0] * pw_ + pcx_
+    cy = v[..., 1] * t[..., 1] * ph_ + pcy_
+    w = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+    h = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+# ------------------------------------------------------------------ priors
+@register_op("prior_box")
+def prior_box(feature_shape, image_shape, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes for one feature map.
+
+    feature_shape/image_shape: (h, w) statics. Returns (boxes [H,W,P,4],
+    variances [H,W,P,4]). ref: detection/prior_box_op.{cc,h} + layers/detection.py
+    prior_box()."""
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    step_w = steps[1] if steps[1] > 0 else float(iw) / fw
+    step_h = steps[0] if steps[0] > 0 else float(ih) / fh
+    max_sizes = list(max_sizes or [])
+
+    whs = []  # static python loop -> baked constants
+    for k, ms in enumerate(min_sizes):
+        base = [(float(ms), float(ms))]
+        rest = [(ms * np.sqrt(ar), ms / np.sqrt(ar)) for ar in ars
+                if abs(ar - 1.0) > 1e-6]
+        if max_sizes:
+            sq = float(np.sqrt(ms * max_sizes[k]))
+            if min_max_aspect_ratios_order:
+                base = base + [(sq, sq)]
+                whs += base + rest
+            else:
+                whs += base + rest + [(sq, sq)]
+        else:
+            whs += base + rest
+    wh = jnp.asarray(whs, jnp.float32)                      # [P,2]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w  # [W]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h  # [H]
+    cxg, cyg = jnp.meshgrid(cx, cy)                         # [H,W]
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]            # [H,W,1,2]
+    half = wh[None, None, :, :] / 2.0
+    boxes = jnp.concatenate([(c - half), (c + half)], axis=-1)
+    boxes = boxes / jnp.asarray([iw, ih, iw, ih], jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+@register_op("density_prior_box")
+def density_prior_box(feature_shape, image_shape, fixed_sizes, fixed_ratios,
+                      densities, variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5):
+    """Densified priors (ref: detection/density_prior_box_op.{cc,h}).
+
+    Returns (boxes [H,W,P,4], variances)."""
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    step_w = steps[1] if steps[1] > 0 else float(iw) / fw
+    step_h = steps[0] if steps[0] > 0 else float(ih) / fh
+    step_avg = int((step_w + step_h) * 0.5)
+    entries = []  # (shift_x, shift_y, w, h) per prior, relative to cell center
+    for size, density in zip(fixed_sizes, densities):
+        shift = int(step_avg / density)
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            for di in range(density):
+                for dj in range(density):
+                    sx = -step_avg / 2.0 + shift / 2.0 + dj * shift
+                    sy = -step_avg / 2.0 + shift / 2.0 + di * shift
+                    entries.append((sx, sy, bw, bh))
+    ent = jnp.asarray(entries, jnp.float32)                 # [P,4]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    center = jnp.stack([cxg, cyg], -1)[:, :, None, :]       # [H,W,1,2]
+    ctr = center + ent[None, None, :, :2]
+    half = ent[None, None, :, 2:] / 2.0
+    boxes = jnp.concatenate([ctr - half, ctr + half], axis=-1)
+    boxes = boxes / jnp.asarray([iw, ih, iw, ih], jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+@register_op("anchor_generator")
+def anchor_generator(feature_shape, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5):
+    """RPN anchors for one level -> ([H,W,A,4] anchors, variances).
+
+    ref: detection/anchor_generator_op.{cc,h}."""
+    fh, fw = feature_shape
+    whs = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            area = (stride[0] * stride[1])
+            area_ratios = area / ar
+            base_w = np.round(np.sqrt(area_ratios))
+            base_h = np.round(base_w * ar)
+            scale_w = s / stride[0]
+            scale_h = s / stride[1]
+            whs.append((scale_w * base_w, scale_h * base_h))
+    wh = jnp.asarray(whs, jnp.float32)                      # [A,2]
+    # pixel-inclusive convention: centers at offset*(stride-1), half-extent
+    # (w-1)/2, matching generate_proposals' +1 box widths
+    cx = jnp.arange(fw, dtype=jnp.float32) * stride[0] + \
+        offset * (stride[0] - 1.0)
+    cy = jnp.arange(fh, dtype=jnp.float32) * stride[1] + \
+        offset * (stride[1] - 1.0)
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    c = jnp.stack([cxg, cyg], -1)[:, :, None, :]
+    half = (wh[None, None, :, :] - 1.0) / 2.0
+    anchors = jnp.concatenate([c - half, c + half], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+# ------------------------------------------------------------------ NMS
+def _nms_keep_mask(boxes, scores, iou_threshold, box_normalized=True):
+    """Greedy NMS over score-sorted boxes -> keep mask in SORTED order plus
+    the sort order. Vectorized suppression scan (TPU-friendly O(N^2))."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = iou_similarity(b, b, box_normalized=box_normalized)
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & (idx > i) & keep[i]
+        return keep & ~sup
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    return keep, order
+
+
+@register_op("nms")
+def nms(boxes, scores, iou_threshold=0.3, score_threshold=-jnp.inf,
+        keep_top_k=-1, box_normalized=True):
+    """Single-class NMS -> (indices [K], valid mask [K]) with K static.
+
+    K = keep_top_k if >0 else N; invalid slots hold index 0 and mask False."""
+    n = boxes.shape[0]
+    k = n if keep_top_k is None or keep_top_k < 0 else min(keep_top_k, n)
+    keep, order = _nms_keep_mask(boxes, scores, iou_threshold, box_normalized)
+    keep = keep & (scores[order] > score_threshold)
+    # stable select: kept entries keep their (sorted) rank, dropped go last
+    rank = jnp.where(keep, jnp.arange(n), n)
+    sel = jnp.argsort(rank)[:k]
+    return order[sel], keep[jnp.argsort(rank)][:k]
+
+
+@register_op("multiclass_nms")
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, background_label=-1,
+                   box_normalized=True):
+    """Multi-class NMS, static-shape output.
+
+    bboxes: [N, 4] (shared across classes) or [N, C, 4]; scores: [C, N].
+    Returns out [keep_top_k, 6] rows (label, score, x1, y1, x2, y2) padded
+    with -1, plus valid-count scalar. ref: detection/multiclass_nms_op.cc
+    (per-class NMSFast + cross-class keep_top_k)."""
+    num_classes, n = scores.shape
+    if bboxes.ndim == 2:
+        bboxes = jnp.broadcast_to(bboxes[:, None, :], (n, num_classes, 4))
+    pre_k = min(nms_top_k, n) if nms_top_k > 0 else n
+
+    def per_class(c_boxes, c_scores):
+        # top nms_top_k by score first (ref NMSFast top_k), then greedy NMS
+        top_scores, top_idx = lax.top_k(c_scores, pre_k)
+        keep, order = _nms_keep_mask(c_boxes[top_idx], top_scores,
+                                     nms_threshold, box_normalized)
+        keep = keep & (top_scores[order] > score_threshold)
+        return top_idx[order], keep, top_scores[order]
+
+    cls_idx, cls_keep, cls_scores = jax.vmap(per_class, in_axes=(1, 0))(
+        bboxes, scores)                                     # [C,pre_k]
+    labels = jnp.broadcast_to(jnp.arange(num_classes)[:, None],
+                              (num_classes, pre_k))
+    if background_label >= 0:
+        cls_keep = cls_keep & (labels != background_label)
+    flat_scores = jnp.where(cls_keep, cls_scores, -jnp.inf).reshape(-1)
+    flat_labels = labels.reshape(-1)
+    flat_idx = cls_idx.reshape(-1)
+    k = min(keep_top_k if keep_top_k > 0 else flat_scores.shape[0],
+            flat_scores.shape[0])
+    top_scores, top = lax.top_k(flat_scores, k)
+    valid = top_scores > -jnp.inf
+    sel_label = flat_labels[top]
+    sel_box = bboxes[flat_idx[top], sel_label]
+    out = jnp.concatenate([sel_label[:, None].astype(bboxes.dtype),
+                           top_scores[:, None], sel_box], axis=-1)
+    out = jnp.where(valid[:, None], out, -1.0)
+    return out, valid.sum()
+
+
+# ------------------------------------------------------------------ RoI ops
+@register_op("roi_align")
+def roi_align(x, rois, roi_batch_idx, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, aligned=False):
+    """RoIAlign. x: [B,C,H,W]; rois: [R,4] (x1,y1,x2,y2 in image coords);
+    roi_batch_idx: [R] int. -> [R,C,ph,pw].
+
+    ref: operators/roi_align_op.{cc,cu}. Deviation: sampling_ratio<=0 (the
+    reference's adaptive grid) is made static as 2 samples/bin."""
+    b, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    roi_offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = roi * spatial_scale - roi_offset
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        # sample coords: [ph, s] and [pw, s]
+        sy = y1 + (jnp.arange(ph, dtype=x.dtype)[:, None] * bin_h
+                   + (jnp.arange(s, dtype=x.dtype)[None, :] + 0.5) * bin_h / s)
+        sx = x1 + (jnp.arange(pw, dtype=x.dtype)[:, None] * bin_w
+                   + (jnp.arange(s, dtype=x.dtype)[None, :] + 0.5) * bin_w / s)
+        yy = sy.reshape(-1)                                 # [ph*s]
+        xx = sx.reshape(-1)                                 # [pw*s]
+        img = x[bidx]                                       # [C,H,W]
+        vals = _bilinear_sample(img, yy, xx)                # [C, ph*s, pw*s]
+        vals = vals.reshape(c, ph, s, pw, s)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois, roi_batch_idx)
+
+
+def _bilinear_sample(img, ys, xs):
+    """img: [C,H,W]; ys: [Ny], xs: [Nx] -> [C,Ny,Nx] bilinear, zero outside."""
+    c, h, w = img.shape
+    y_ok = (ys >= -1.0) & (ys <= h)
+    x_ok = (xs >= -1.0) & (xs <= w)
+    y = jnp.clip(ys, 0.0, h - 1.0)
+    x = jnp.clip(xs, 0.0, w - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    ly = (y - y0)[None, :, None]
+    lx = (x - x0)[None, None, :]
+    v00 = img[:, y0][:, :, x0]
+    v01 = img[:, y0][:, :, x1]
+    v10 = img[:, y1][:, :, x0]
+    v11 = img[:, y1][:, :, x1]
+    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    return out * (y_ok[None, :, None] & x_ok[None, None, :])
+
+
+@register_op("roi_pool")
+def roi_pool(x, rois, roi_batch_idx, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    """RoI max-pool (quantized bins, ref: operators/roi_pool_op.{cc,cu}).
+
+    -> [R,C,ph,pw]."""
+    b, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+
+    def one_roi(roi, bidx):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        img = x[bidx]                                       # [C,H,W]
+        yy = jnp.arange(h, dtype=x.dtype)
+        xx = jnp.arange(w, dtype=x.dtype)
+        # bin membership masks, [ph,H] and [pw,W]
+        pi = jnp.arange(ph, dtype=x.dtype)[:, None]
+        pj = jnp.arange(pw, dtype=x.dtype)[:, None]
+        ys_lo = jnp.clip(jnp.floor(pi * bin_h + y1), 0, h)
+        ys_hi = jnp.clip(jnp.ceil((pi + 1) * bin_h + y1), 0, h)
+        xs_lo = jnp.clip(jnp.floor(pj * bin_w + x1), 0, w)
+        xs_hi = jnp.clip(jnp.ceil((pj + 1) * bin_w + x1), 0, w)
+        my = (yy[None, :] >= ys_lo) & (yy[None, :] < ys_hi)  # [ph,H]
+        mx = (xx[None, :] >= xs_lo) & (xx[None, :] < xs_hi)  # [pw,W]
+        m = my[:, None, :, None] & mx[None, :, None, :]      # [ph,pw,H,W]
+        masked = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        out = masked.max(axis=(-1, -2))                      # [C,ph,pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois, roi_batch_idx)
+
+
+# ------------------------------------------------------------------ YOLO
+@register_op("yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode YOLOv3 head. x: [B, A*(5+cls), H, W]; img_size: [B,2] (h,w).
+    -> (boxes [B, H*W*A, 4], scores [B, H*W*A, cls]).
+
+    ref: detection/yolo_box_op.{cc,h}."""
+    bsz, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(na, 2)       # [A,2] (w,h)
+    x = x.reshape(bsz, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    bias = (scale_x_y - 1.0) * 0.5
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias + gx) / w
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias + gy) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    conf = jnp.where(conf < conf_thresh, 0.0, conf)
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (cx - bw / 2.0) * imw
+    y1 = (cy - bh / 2.0) * imh
+    x2 = (cx + bw / 2.0) * imw
+    y2 = (cy + bh / 2.0) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)            # [B,A,H,W,4]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(bsz, h * w * na, 4)
+    scores = probs.transpose(0, 3, 4, 1, 2).reshape(bsz, h * w * na, class_num)
+    zero = (conf.transpose(0, 2, 3, 1).reshape(bsz, -1) > 0)
+    boxes = boxes * zero[..., None]
+    return boxes, scores
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32, use_label_smooth=False):
+    """YOLOv3 training loss for one detection head.
+
+    x: [B, A*(5+cls), H, W]; gt_box: [B, G, 4] (cx, cy, w, h, relative 0-1,
+    zero rows = padding); gt_label: [B, G] int. -> scalar-per-image loss [B].
+
+    ref: detection/yolov3_loss_op.{cc,h} — obj/noobj BCE with ignore mask,
+    coord SSE weighted by (2 - w*h), class BCE; gt matched to the best anchor
+    by wh-IoU, assigned to its grid cell."""
+    bsz, _, h, w = x.shape
+    namask = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]                   # [A,2]
+    x = x.reshape(bsz, namask, 5 + class_num, h, w)
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+
+    px = jax.nn.sigmoid(x[:, :, 0])                         # [B,A,H,W]
+    py = jax.nn.sigmoid(x[:, :, 1])
+    pw_ = x[:, :, 2]
+    ph_ = x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]                                      # [B,A,cls,H,W]
+
+    # decoded pred boxes (normalized cx cy w h) for the ignore mask
+    gxx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gyy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    pred_cx = (px + gxx) / w
+    pred_cy = (py + gyy) / h
+    pred_w = jnp.exp(pw_) * an[None, :, 0, None, None] / input_w
+    pred_h = jnp.exp(ph_) * an[None, :, 1, None, None] / input_h
+
+    gt_valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [B,G]
+
+    def cxcywh_iou(b1, b2):
+        # b1: [...,4] cx cy w h ; b2: [...,4]
+        b1x1, b1x2 = b1[..., 0] - b1[..., 2] / 2, b1[..., 0] + b1[..., 2] / 2
+        b1y1, b1y2 = b1[..., 1] - b1[..., 3] / 2, b1[..., 1] + b1[..., 3] / 2
+        b2x1, b2x2 = b2[..., 0] - b2[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2
+        b2y1, b2y2 = b2[..., 1] - b2[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2
+        iw = jnp.clip(jnp.minimum(b1x2, b2x2) - jnp.maximum(b1x1, b2x1), 0)
+        ih = jnp.clip(jnp.minimum(b1y2, b2y2) - jnp.maximum(b1y1, b2y1), 0)
+        inter = iw * ih
+        union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3] - inter)
+        return inter / jnp.maximum(union, 1e-10)
+
+    # ignore mask: pred boxes whose best-gt IoU > thresh don't get noobj loss
+    pred = jnp.stack([pred_cx, pred_cy, pred_w, pred_h], -1)  # [B,A,H,W,4]
+    iou_pg = cxcywh_iou(pred[:, :, :, :, None, :],
+                        gt_box[:, None, None, None, :, :])   # [B,A,H,W,G]
+    iou_pg = jnp.where(gt_valid[:, None, None, None, :], iou_pg, 0.0)
+    ignore = iou_pg.max(-1) > ignore_thresh                  # [B,A,H,W]
+
+    # match each gt to best anchor over ALL anchors by wh IoU at origin
+    gwh = gt_box[..., 2:4]                                   # [B,G,2]
+    awh_all = an_all / jnp.asarray([input_w, input_h], jnp.float32)
+    inter = (jnp.minimum(gwh[:, :, None, 0], awh_all[None, None, :, 0])
+             * jnp.minimum(gwh[:, :, None, 1], awh_all[None, None, :, 1]))
+    union = (gwh[..., 0] * gwh[..., 1])[:, :, None] + \
+        (awh_all[:, 0] * awh_all[:, 1])[None, None, :] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [B,G]
+    mask_arr = jnp.asarray(anchor_mask)
+    # local index of the matched anchor within this head (or -1)
+    local = jnp.argmax(best_anchor[..., None] == mask_arr[None, None, :], -1)
+    in_head = (best_anchor[..., None] == mask_arr[None, None, :]).any(-1)
+    assigned = gt_valid & in_head                            # [B,G]
+
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)  # [B,G]
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gt_box[..., 0] * w - gi
+    ty = gt_box[..., 1] * h - gj
+    tw = jnp.log(jnp.maximum(gwh[..., 0] * input_w, 1e-9)
+                 / an_all[best_anchor][..., 0])
+    th = jnp.log(jnp.maximum(gwh[..., 1] * input_h, 1e-9)
+                 / an_all[best_anchor][..., 1])
+    box_scale = 2.0 - gwh[..., 0] * gwh[..., 1]
+
+    def bce(logit_or_p, t, from_logit):
+        if from_logit:
+            return jnp.maximum(logit_or_p, 0) - logit_or_p * t + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit_or_p)))
+        p = jnp.clip(logit_or_p, 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+    smooth_delta = 1.0 / class_num if use_label_smooth else 0.0
+
+    def per_image(px_, py_, pw2, ph2, pobj_, pcls_, ignore_,
+                  gi_, gj_, loc_, asg, tx_, ty_, tw_, th_, bs_, glab):
+        obj_t = jnp.zeros((namask, h, w))
+        obj_t = obj_t.at[loc_, gj_, gi_].max(asg.astype(jnp.float32))
+        # coord + class losses gathered at assigned cells (per-gt)
+        g = (loc_, gj_, gi_)
+        lx = bce(px_[g], tx_, False)
+        ly = bce(py_[g], ty_, False)
+        lw = jnp.abs(pw2[g] - tw_)
+        lh = jnp.abs(ph2[g] - th_)
+        coord = ((lx + ly) * bs_ + (lw + lh) * bs_) * asg
+        onehot = jax.nn.one_hot(glab, class_num)
+        onehot = onehot * (1 - smooth_delta) + smooth_delta * 0.5
+        lcls = bce(pcls_.transpose(0, 2, 3, 1)[g], onehot, True).sum(-1) * asg
+        lobj = bce(pobj_, obj_t, True)
+        noobj = (obj_t == 0) & ~ignore_
+        obj_loss = jnp.where(obj_t > 0, lobj, 0.0).sum() + \
+            jnp.where(noobj, lobj, 0.0).sum()
+        return coord.sum() + lcls.sum() + obj_loss
+
+    return jax.vmap(per_image)(px, py, pw_, ph_, pobj, pcls, ignore,
+                               gi, gj, local, assigned, tx, ty, tw, th,
+                               box_scale, gt_label)
+
+
+# ------------------------------------------------------------------ proposals
+@register_op("generate_proposals")
+def generate_proposals(scores, bbox_deltas, anchors, variances, im_shape,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.7, min_size=0.0):
+    """RPN proposal generation for ONE image, static shapes.
+
+    scores: [A] objectness; bbox_deltas: [A,4]; anchors/variances: [A,4];
+    im_shape: (h, w). -> (rois [post_nms_top_n,4], roi_scores, valid mask).
+
+    ref: detection/generate_proposals_op.cc (ProposalForOneImage)."""
+    a = scores.shape[0]
+    pre_k = min(pre_nms_top_n, a)
+    top_scores, top = lax.top_k(scores, pre_k)
+    deltas = bbox_deltas[top]
+    anc = anchors[top]
+    var = variances[top]
+    # decode (ref box_coder decode_center_size w/ per-anchor variance)
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + aw * 0.5
+    acy = anc[:, 1] + ah * 0.5
+    cx = var[:, 0] * deltas[:, 0] * aw + acx
+    cy = var[:, 1] * deltas[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(var[:, 2] * deltas[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(var[:, 3] * deltas[:, 3], 10.0)) * ah
+    props = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                       cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], -1)
+    props = box_clip(props, im_shape)
+    ww = props[:, 2] - props[:, 0] + 1.0
+    hh = props[:, 3] - props[:, 1] + 1.0
+    alive = (ww >= max(min_size, 1.0)) & (hh >= max(min_size, 1.0))
+    sc = jnp.where(alive, top_scores, -jnp.inf)
+    keep, order = _nms_keep_mask(props, sc, nms_thresh, box_normalized=False)
+    keep = keep & (sc[order] > -jnp.inf)
+    rank = jnp.where(keep, jnp.arange(pre_k), pre_k)
+    sel = jnp.argsort(rank)[:post_nms_top_n]
+    valid = keep[jnp.argsort(rank)][:post_nms_top_n]
+    rois = props[order][sel] * valid[:, None]
+    return rois, sc[order][sel] * valid, valid
+
+
+# ------------------------------------------------------------------ matching
+@register_op("bipartite_match")
+def bipartite_match(dist, match_type="bipartite", overlap_threshold=0.5):
+    """Greedy bipartite matching on similarity [N_gt, M_prior].
+
+    Returns (match_indices [M] int (-1 unmatched), match_dist [M]).
+    ref: detection/bipartite_match_op.cc (BipartiteMatchFunctor): repeatedly
+    pick the global max, bind that row+col, until rows exhausted; then
+    per_prediction mode additionally matches cols with overlap > threshold."""
+    n, m = dist.shape
+    steps = min(n, m)
+
+    def body(state, _):
+        d, midx, mdist = state
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        val = d[i, j]
+        ok = val > 0
+        midx = jnp.where(ok, midx.at[j].set(i), midx)
+        mdist = jnp.where(ok, mdist.at[j].set(val), mdist)
+        d = jnp.where(ok, d.at[i, :].set(-1.0).at[:, j].set(-1.0), d)
+        return (d, midx, mdist), None
+
+    init = (dist, jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), dist.dtype))
+    (d, midx, mdist), _ = lax.scan(body, init, None, length=steps)
+
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0)
+        best_val = dist.max(axis=0)
+        extra = (midx < 0) & (best_val > overlap_threshold)
+        midx = jnp.where(extra, best_row.astype(jnp.int32), midx)
+        mdist = jnp.where(extra, best_val, mdist)
+    return midx, mdist
+
+
+@register_op("target_assign")
+def target_assign(x, match_indices, mismatch_value=0.0):
+    """Gather per-prior targets by match indices.
+
+    x: [N_gt, K]; match_indices: [M] (-1 = unmatched) -> (out [M,K], weight
+    [M,1]). ref: detection/target_assign_op.{cc,h}."""
+    matched = match_indices >= 0
+    safe = jnp.clip(match_indices, 0, x.shape[0] - 1)
+    out = jnp.where(matched[:, None], x[safe],
+                    jnp.asarray(mismatch_value, x.dtype))
+    return out, matched.astype(x.dtype)[:, None]
+
+
+@register_op("mine_hard_examples")
+def mine_hard_examples(neg_loss, match_indices, neg_pos_ratio=3.0,
+                       min_neg=1):
+    """Hard-negative mining mask (max_negative mode).
+
+    neg_loss: [M] per-prior classification loss; match_indices: [M].
+    -> bool mask of selected negatives. ref: detection/mine_hard_examples_op.cc."""
+    pos = match_indices >= 0
+    num_pos = pos.sum()
+    num_neg = jnp.maximum((num_pos * neg_pos_ratio).astype(jnp.int32), min_neg)
+    masked = jnp.where(pos, -jnp.inf, neg_loss)
+    order = jnp.argsort(-masked)
+    rank = jnp.argsort(order)
+    return (~pos) & (rank < num_neg)
+
+
+@register_op("ssd_loss")
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box_, prior_var=None,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, background_label=0,
+             loc_loss_weight=1.0, conf_loss_weight=1.0):
+    """SSD multibox loss for ONE image (vmap over batch for [B,...]).
+
+    location: [M,4] predicted offsets; confidence: [M,C] logits;
+    gt_box: [G,4] normalized x1y1x2y2 (zero rows pad); gt_label: [G] int.
+    ref: layers/detection.py ssd_loss() pipeline (iou -> bipartite_match ->
+    target_assign -> conf loss + hard mining -> smooth-l1 loc loss)."""
+    from paddle_tpu.ops.loss import smooth_l1_loss, softmax_with_cross_entropy
+    valid_gt = (gt_box[:, 2] > gt_box[:, 0]) & (gt_box[:, 3] > gt_box[:, 1])
+    sim = iou_similarity(gt_box, prior_box_)                 # [G,M]
+    sim = jnp.where(valid_gt[:, None], sim, -1.0)
+    midx, mdist = bipartite_match(sim, "per_prediction", overlap_threshold)
+    # encode gt against priors -> per-prior loc target. Zero-padded gt rows
+    # would hit log(0) = -inf inside the encoder and poison gradients
+    # through the matched-mask where (inf * 0 = NaN in backward), so swap
+    # them for a unit box first — they are never matched anyway.
+    safe_gt = jnp.where(valid_gt[:, None], gt_box,
+                        jnp.asarray([0.0, 0.0, 1.0, 1.0], gt_box.dtype))
+    enc = box_coder(prior_box_, prior_var, safe_gt,
+                    code_type="encode_center_size")          # [G,M,4]
+    g = jnp.clip(midx, 0, gt_box.shape[0] - 1)
+    loc_t = enc[g, jnp.arange(prior_box_.shape[0])]          # [M,4]
+    matched = midx >= 0
+    # conf target: matched -> gt label, else background
+    conf_t = jnp.where(matched, gt_label[g], background_label)
+    conf_l = softmax_with_cross_entropy(confidence, conf_t[:, None],
+                                        soft_label=False)[:, 0]
+    neg_sel = mine_hard_examples(conf_l, midx, neg_pos_ratio)
+    conf_loss = jnp.where(matched | neg_sel, conf_l, 0.0).sum()
+    loc_l = smooth_l1_loss(location, loc_t)[:, 0]
+    loc_loss = jnp.where(matched, loc_l, 0.0).sum()
+    n = jnp.maximum(matched.sum(), 1).astype(location.dtype)
+    return (conf_loss_weight * conf_loss + loc_loss_weight * loc_loss) / n
+
+
+@register_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(rois, min_level=2, max_level=5, refer_level=4,
+                             refer_scale=224):
+    """Assign each RoI to an FPN level: level = floor(refer + log2(sqrt(area)
+    / refer_scale)). Returns (level [R] int, one-hot mask [R, L]).
+    ref: detection/distribute_fpn_proposals_op.cc — static-shape variant
+    (masks instead of variable-size per-level lists)."""
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    mask = jax.nn.one_hot(lvl - min_level, max_level - min_level + 1)
+    return lvl, mask

@@ -277,6 +277,10 @@ class AdamW(Adam):
             new_p, new_s = [], []
             saved_wd = self.wd
             for g, p, s, use_decay in zip(flat_g, flat_p, flat_s, flat_m):
+                if g is None:
+                    new_p.append(p)
+                    new_s.append(s)
+                    continue
                 self.wd = saved_wd if use_decay else 0.0
                 np_, ns_ = self._update_leaf(g, p, s, lr, step)
                 new_p.append(np_)
@@ -375,10 +379,18 @@ class Dpsgd(Optimizer):
     def slots(self, p):
         return {}
 
+    def apply_gradients(self, params, grads, state):
+        # reset the trace-time leaf counter so each parameter draws
+        # INDEPENDENT noise (leaf order is fixed by the treedef)
+        self._leaf_idx = 0
+        return super().apply_gradients(params, grads, state)
+
     def _update_leaf(self, g, p, s, lr, step):
         g = g.astype(p.dtype)
+        leaf_idx = getattr(self, "_leaf_idx", 0)
+        self._leaf_idx = leaf_idx + 1
         key = jax.random.fold_in(jax.random.key(self.seed), step)
-        key = jax.random.fold_in(key, g.size)
+        key = jax.random.fold_in(key, leaf_idx)
         gn = jnp.sqrt(jnp.sum(jnp.square(g)))
         g = g * jnp.minimum(1.0, self.clip_v / jnp.maximum(gn, 1e-12))
         noise = self.sigma * self.clip_v / self.batch_size * \

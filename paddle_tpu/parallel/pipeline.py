@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from paddle_tpu.parallel.mesh import PP
 
@@ -92,11 +92,9 @@ def make_pipeline_fn(mesh, stage_fn, axis_name=PP):
     pspec = P(axis_name)
     return shard_map(
         inner, mesh=mesh,
-        in_specs=(jax.tree_util.tree_map(lambda _: pspec, None,
-                                         is_leaf=lambda _: True) or pspec,
-                  P()),
+        in_specs=(pspec, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
 
 

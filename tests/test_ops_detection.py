@@ -1,0 +1,342 @@
+"""Detection op golden tests (numpy references).
+
+Mirrors the reference's per-op test pattern (unittests/test_iou_similarity_op.py,
+test_box_coder_op.py, test_multiclass_nms_op.py, test_roi_align_op.py,
+test_yolo_box_op.py, test_bipartite_match_op.py ...)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import detection as D
+
+
+def np_iou(a, b, offset=0.0):
+    n, m = a.shape[0], b.shape[0]
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            ix1 = max(a[i, 0], b[j, 0])
+            iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2])
+            iy2 = min(a[i, 3], b[j, 3])
+            iw = max(ix2 - ix1 + offset, 0)
+            ih = max(iy2 - iy1 + offset, 0)
+            inter = iw * ih
+            ua = ((a[i, 2] - a[i, 0] + offset) * (a[i, 3] - a[i, 1] + offset)
+                  + (b[j, 2] - b[j, 0] + offset) * (b[j, 3] - b[j, 1] + offset)
+                  - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def rand_boxes(rng, n, lo=0.0, hi=10.0):
+    x1 = rng.uniform(lo, hi - 1, (n, 1))
+    y1 = rng.uniform(lo, hi - 1, (n, 1))
+    x2 = x1 + rng.uniform(0.5, hi - 1, (n, 1))
+    y2 = y1 + rng.uniform(0.5, hi - 1, (n, 1))
+    return np.concatenate([x1, y1, x2, y2], -1).astype(np.float32)
+
+
+class TestIouBoxCoder:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a, b = rand_boxes(rng, 5), rand_boxes(rng, 7)
+        got = np.asarray(D.iou_similarity(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(got, np_iou(a, b), rtol=1e-5)
+
+    def test_iou_unnormalized(self):
+        rng = np.random.RandomState(1)
+        a, b = rand_boxes(rng, 4), rand_boxes(rng, 4)
+        got = np.asarray(D.iou_similarity(jnp.asarray(a), jnp.asarray(b),
+                                          box_normalized=False))
+        np.testing.assert_allclose(got, np_iou(a, b, offset=1.0), rtol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(2)
+        priors = rand_boxes(rng, 6)
+        targets = rand_boxes(rng, 6)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        enc = D.box_coder(jnp.asarray(priors), var, jnp.asarray(targets),
+                          "encode_center_size")           # [N,M,4]
+        # decode the diagonal (each target against its own prior)
+        diag = jnp.stack([enc[i, i] for i in range(6)])
+        dec = D.box_coder(jnp.asarray(priors), var, diag,
+                          "decode_center_size", axis=1)
+        dec_diag = np.stack([np.asarray(dec)[i, i] for i in range(6)])
+        np.testing.assert_allclose(dec_diag, targets, rtol=1e-4, atol=1e-4)
+
+    def test_box_clip(self):
+        boxes = jnp.asarray([[-5.0, -5.0, 20.0, 30.0]])
+        out = np.asarray(D.box_clip(boxes, (10.0, 15.0)))
+        np.testing.assert_allclose(out, [[0, 0, 14, 9]])
+
+
+class TestPriors:
+    def test_prior_box_count_and_range(self):
+        boxes, var = D.prior_box((4, 4), (32, 32), min_sizes=[8.0],
+                                 max_sizes=[16.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        # priors per cell: 1 (min) + 2 (ar 2, 1/2) + 1 (sqrt(min*max)) = 4
+        assert boxes.shape == (4, 4, 4, 4)
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        # first prior at cell (0,0): square min_size centered at (4,4)/32
+        np.testing.assert_allclose(
+            b[0, 0, 0], [0.0, 0.0, 8.0 / 32, 8.0 / 32], atol=1e-6)
+
+    def test_density_prior_box(self):
+        boxes, var = D.density_prior_box((2, 2), (16, 16), fixed_sizes=[4.0],
+                                         fixed_ratios=[1.0], densities=[2])
+        assert boxes.shape == (2, 2, 4, 4)
+
+    def test_anchor_generator(self):
+        anchors, var = D.anchor_generator((3, 3), anchor_sizes=[32.0, 64.0],
+                                          aspect_ratios=[1.0],
+                                          stride=(16.0, 16.0))
+        assert anchors.shape == (3, 3, 2, 4)
+        a = np.asarray(anchors)[0, 0, 0]
+        # reference convention (anchor_generator_op.h): center 0.5*(16-1)=7.5,
+        # half-extent (32-1)/2 -> [-8, -8, 23, 23]
+        np.testing.assert_allclose(a, [-8.0, -8.0, 23.0, 23.0], atol=1e-5)
+
+
+def np_greedy_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(scores), bool)
+    iou = np_iou(boxes, boxes)
+    for oi, i in enumerate(order):
+        if sup[oi]:
+            continue
+        keep.append(i)
+        for oj in range(oi + 1, len(order)):
+            if iou[i, order[oj]] > thr:
+                sup[oj] = True
+    return keep
+
+
+class TestNMS:
+    def test_nms_matches_numpy(self):
+        rng = np.random.RandomState(3)
+        boxes = rand_boxes(rng, 20)
+        scores = rng.rand(20).astype(np.float32)
+        idx, valid = D.nms(jnp.asarray(boxes), jnp.asarray(scores), 0.5)
+        got = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid)) if v]
+        assert got == np_greedy_nms(boxes, scores, 0.5)
+
+    def test_nms_keep_top_k(self):
+        rng = np.random.RandomState(4)
+        boxes = rand_boxes(rng, 16)
+        scores = rng.rand(16).astype(np.float32)
+        idx, valid = D.nms(jnp.asarray(boxes), jnp.asarray(scores), 0.5,
+                           keep_top_k=3)
+        assert idx.shape == (3,)
+        ref = np_greedy_nms(boxes, scores, 0.5)[:3]
+        got = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid)) if v]
+        assert got == ref
+
+    def test_multiclass_nms(self):
+        rng = np.random.RandomState(5)
+        n, c = 30, 4
+        boxes = rand_boxes(rng, n)
+        scores = rng.rand(c, n).astype(np.float32)
+        out, count = D.multiclass_nms(jnp.asarray(boxes), jnp.asarray(scores),
+                                      score_threshold=0.3, nms_threshold=0.4,
+                                      keep_top_k=10, background_label=0)
+        out = np.asarray(out)
+        assert out.shape == (10, 6)
+        cnt = int(count)
+        # rows beyond count are -1 padding
+        assert (out[cnt:] == -1).all()
+        # no background-class rows; scores sorted desc
+        assert (out[:cnt, 0] != 0).all()
+        assert (np.diff(out[:cnt, 1]) <= 1e-6).all()
+        # every surviving row passes the score threshold
+        assert (out[:cnt, 1] > 0.3).all()
+
+    def test_multiclass_nms_jit(self):
+        rng = np.random.RandomState(6)
+        boxes = jnp.asarray(rand_boxes(rng, 12))
+        scores = jnp.asarray(rng.rand(3, 12).astype(np.float32))
+        f = jax.jit(lambda b, s: D.multiclass_nms(b, s, keep_top_k=5))
+        out, count = f(boxes, scores)
+        assert out.shape == (5, 6)
+
+
+def np_roi_align(x, rois, batch_idx, ph, pw, scale, s):
+    r = rois.shape[0]
+    c = x.shape[1]
+    out = np.zeros((r, c, ph, pw), np.float32)
+    for ri in range(r):
+        img = x[batch_idx[ri]]
+        x1, y1, x2, y2 = rois[ri] * scale
+        rw = max(x2 - x1, 1.0)
+        rh = max(y2 - y1, 1.0)
+        bw, bh = rw / pw, rh / ph
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(c, np.float32)
+                for iy in range(s):
+                    for ix in range(s):
+                        yv = y1 + i * bh + (iy + 0.5) * bh / s
+                        xv = x1 + j * bw + (ix + 0.5) * bw / s
+                        acc += np_bilinear(img, yv, xv)
+                out[ri, :, i, j] = acc / (s * s)
+    return out
+
+
+def np_bilinear(img, y, x):
+    c, h, w = img.shape
+    if y < -1.0 or y > h or x < -1.0 or x > w:
+        return np.zeros(c, np.float32)
+    y = min(max(y, 0.0), h - 1.0)
+    x = min(max(x, 0.0), w - 1.0)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+    ly, lx = y - y0, x - x0
+    return (img[:, y0, x0] * (1 - ly) * (1 - lx)
+            + img[:, y0, x1] * (1 - ly) * lx
+            + img[:, y1, x0] * ly * (1 - lx)
+            + img[:, y1, x1] * ly * lx)
+
+
+class TestRoiOps:
+    def test_roi_align(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[0, 0, 7, 7], [2, 2, 6, 5], [1, 0, 5, 7]],
+                        np.float32)
+        bidx = np.array([0, 1, 0], np.int32)
+        got = np.asarray(D.roi_align(jnp.asarray(x), jnp.asarray(rois),
+                                     jnp.asarray(bidx), 2, 2, 1.0, 2))
+        ref = np_roi_align(x, rois, bidx, 2, 2, 1.0, 2)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_roi_pool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], np.float32)
+        got = np.asarray(D.roi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                    jnp.asarray([0]), 2, 2, 1.0))
+        # quantized 2x2 max pool over the full image
+        np.testing.assert_allclose(got[0, 0], [[5, 7], [13, 15]])
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_decode(self):
+        rng = np.random.RandomState(8)
+        b, na, cls, h, w = 2, 2, 3, 4, 4
+        x = rng.randn(b, na * (5 + cls), h, w).astype(np.float32)
+        img_size = np.array([[128, 128], [96, 64]], np.int32)
+        boxes, scores = D.yolo_box(jnp.asarray(x), jnp.asarray(img_size),
+                                   anchors=[10, 13, 16, 30], class_num=cls,
+                                   conf_thresh=0.0, downsample_ratio=32)
+        assert boxes.shape == (b, h * w * na, 4)
+        assert scores.shape == (b, h * w * na, cls)
+        # scores = sigmoid(conf) * sigmoid(cls)
+        xr = x.reshape(b, na, 5 + cls, h, w)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        ref0 = sig(xr[0, 0, 4, 0, 0]) * sig(xr[0, 0, 5:, 0, 0])
+        np.testing.assert_allclose(np.asarray(scores)[0, 0], ref0, rtol=1e-5)
+
+    def test_yolov3_loss_finite_and_grad(self):
+        rng = np.random.RandomState(9)
+        b, cls, h, w = 2, 3, 4, 4
+        x = jnp.asarray(rng.randn(b, 3 * (5 + cls), h, w).astype(np.float32))
+        gt = np.zeros((b, 5, 4), np.float32)
+        gt[:, 0] = [0.5, 0.5, 0.3, 0.4]
+        gt[:, 1] = [0.2, 0.3, 0.1, 0.2]
+        lbl = np.zeros((b, 5), np.int32)
+        loss = D.yolov3_loss(x, jnp.asarray(gt), jnp.asarray(lbl),
+                             anchors=[10, 13, 16, 30, 33, 23],
+                             anchor_mask=[0, 1, 2], class_num=cls,
+                             downsample_ratio=32)
+        assert loss.shape == (b,)
+        assert np.isfinite(np.asarray(loss)).all()
+        g = jax.grad(lambda v: D.yolov3_loss(
+            v, jnp.asarray(gt), jnp.asarray(lbl),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=cls).sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestProposalsMatching:
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(10)
+        a = 50
+        anchors = rand_boxes(rng, a, 0, 60)
+        scores = rng.rand(a).astype(np.float32)
+        deltas = (rng.randn(a, 4) * 0.1).astype(np.float32)
+        var = np.ones((a, 4), np.float32)
+        rois, rsc, valid = D.generate_proposals(
+            jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(anchors),
+            jnp.asarray(var), (64.0, 64.0), pre_nms_top_n=30,
+            post_nms_top_n=10, nms_thresh=0.7)
+        assert rois.shape == (10, 4)
+        v = np.asarray(valid)
+        r = np.asarray(rois)[v]
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 63).all()
+        sc = np.asarray(rsc)[v]
+        assert (np.diff(sc) <= 1e-6).all()
+
+    def test_bipartite_match_greedy(self):
+        dist = jnp.asarray([[0.9, 0.1, 0.3],
+                            [0.8, 0.7, 0.2]])
+        midx, mdist = D.bipartite_match(dist)
+        # global max 0.9 -> gt0/prior0; next best among remaining: 0.7 -> gt1/prior1
+        np.testing.assert_array_equal(np.asarray(midx), [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(mdist), [0.9, 0.7, 0.0])
+
+    def test_bipartite_per_prediction(self):
+        dist = jnp.asarray([[0.9, 0.1, 0.6],
+                            [0.8, 0.7, 0.2]])
+        midx, _ = D.bipartite_match(dist, "per_prediction",
+                                    overlap_threshold=0.5)
+        # prior2 additionally matched to its argmax row (gt0, 0.6 > 0.5)
+        np.testing.assert_array_equal(np.asarray(midx), [0, 1, 0])
+
+    def test_target_assign(self):
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        out, w = D.target_assign(x, jnp.asarray([1, -1, 0]),
+                                 mismatch_value=-9.0)
+        np.testing.assert_allclose(np.asarray(out),
+                                   [[3, 4], [-9, -9], [1, 2]])
+        np.testing.assert_allclose(np.asarray(w)[:, 0], [1, 0, 1])
+
+    def test_mine_hard_examples(self):
+        loss = jnp.asarray([0.9, 0.1, 0.8, 0.2, 0.5])
+        match = jnp.asarray([0, -1, -1, -1, -1])  # 1 positive -> 3 negatives
+        sel = np.asarray(D.mine_hard_examples(loss, match, neg_pos_ratio=3.0))
+        # 1 positive * ratio 3 -> top-3 negative losses: idx 2 (0.8),
+        # 4 (0.5), 3 (0.2); the positive (idx 0) is never selected
+        assert list(np.where(sel)[0]) == [2, 3, 4]
+
+    def test_ssd_loss_runs(self):
+        rng = np.random.RandomState(11)
+        m, c, g = 12, 4, 3
+        priors = rand_boxes(rng, m, 0, 1.0) / 10.0
+        loc = jnp.asarray((rng.randn(m, 4) * 0.1).astype(np.float32))
+        conf = jnp.asarray(rng.randn(m, c).astype(np.float32))
+        gt = np.zeros((g, 4), np.float32)
+        gt[0] = priors[2] + 0.01
+        gt[1] = priors[7] - 0.01
+        lbl = np.array([1, 2, 0], np.int32)
+        loss = D.ssd_loss(loc, conf, jnp.asarray(gt), jnp.asarray(lbl),
+                          jnp.asarray(priors))
+        assert np.isfinite(float(loss))
+        # gradients must stay finite even when an image has NO valid gt
+        # (all-zero padding rows) — regression test for the log(0) poisoning
+        empty_gt = jnp.zeros((g, 4), np.float32)
+        grad = jax.grad(lambda l: D.ssd_loss(
+            l, conf, empty_gt, jnp.asarray(lbl), jnp.asarray(priors)))(loc)
+        assert np.isfinite(np.asarray(grad)).all()
+
+    def test_distribute_fpn_proposals(self):
+        rois = jnp.asarray([[0, 0, 10, 10],      # tiny -> min level
+                            [0, 0, 224, 224],    # refer scale -> level 4
+                            [0, 0, 1000, 1000]])  # huge -> max level
+        lvl, mask = D.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        np.testing.assert_array_equal(np.asarray(lvl), [2, 4, 5])
+        assert mask.shape == (3, 4)

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 import paddle_tpu as pt
 from paddle_tpu.parallel import collective as C
@@ -153,7 +153,7 @@ class TestRingAttention:
         ua = shard_map(
             lambda q_, k_, v_: ulysses_attention(q_, k_, v_, "sp"),
             mesh=sp_mesh, in_specs=(P(None, None, "sp", None),) * 3,
-            out_specs=P(None, None, "sp", None), check_rep=False)
+            out_specs=P(None, None, "sp", None), check_vma=False)
         out = ua(q, q, q)
         ref = scaled_dot_product_attention(q, q, q)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -178,7 +178,7 @@ class TestPipeline:
         pipe = shard_map(
             lambda ps, x: pipeline_forward(stage_fn, ps, x, "pp"),
             mesh=pp_mesh, in_specs=({"w": P("pp", None, None)}, P()),
-            out_specs=P(), check_rep=False)
+            out_specs=P(), check_vma=False)
         out = pipe(stacked, micro)
         ref = micro
         for sp in stage_params:

@@ -96,13 +96,24 @@ class Module:
         return {"params": params, "state": state}
 
     # --- application ---
-    def apply(self, variables, *args, training=False, rngs=None, **kwargs):
+    def apply(self, variables, *args, training=False, rngs=None,
+              calibrating=False, **kwargs):
         """Run forward purely. Returns output, or (output, new_state) when the
-        module carries mutable state and training=True."""
-        ctx = Context(training=training, rngs=rngs or {})
+        module carries mutable state and training=True.
+
+        calibrating=True is the PTQ stat-collection mode: layers behave as in
+        eval (Dropout off, BatchNorm uses running stats) but quantizer scale
+        states still update; the return is ALWAYS (output, new_state).
+        Incompatible with training=True."""
+        if training and calibrating:
+            raise EnforceError(
+                "calibrating=True requires training=False (calibration is an "
+                "eval-behavior pass that only updates quantizer statistics)")
+        ctx = Context(training=training, rngs=rngs or {},
+                      calibrating=calibrating)
         with _bind(self, variables, ctx):
             out = self.forward(*args, **kwargs)
-        if ctx.state_updates and training:
+        if calibrating or (ctx.state_updates and training):
             new_state = _merge_state(variables.get("state", {}),
                                      ctx.state_updates)
             return out, new_state
@@ -143,6 +154,10 @@ class Module:
     def training(self):
         return _CURRENT.ctx.training
 
+    @property
+    def calibrating(self):
+        return getattr(_CURRENT.ctx, "calibrating", False)
+
     def rng(self, name="dropout"):
         ctx = _CURRENT.ctx
         if name not in ctx.rngs:
@@ -165,8 +180,9 @@ class Module:
 
 
 class Context:
-    def __init__(self, training, rngs):
+    def __init__(self, training, rngs, calibrating=False):
         self.training = training
+        self.calibrating = calibrating
         self.rngs = dict(rngs)
         self.state_updates = {}  # path tuple -> value
 

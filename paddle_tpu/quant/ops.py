@@ -1,0 +1,130 @@
+"""Fake-quantization primitives with straight-through gradients.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py — the reference inserts `fake_quantize_abs_max`,
+`fake_quantize_range_abs_max`, `fake_quantize_moving_average_abs_max` and
+`fake_channel_wise_quantize_abs_max` graph ops before quantizable ops
+(:284-513) and pairs them with dequant ops (:515-566); backward is the
+straight-through estimator (gradient flows to the float input, :207
+_transform_backward).
+
+TPU-first: fake quant/dequant is a single fused elementwise op under one
+`jax.custom_vjp` — XLA fuses it into the surrounding matmul/conv epilogue,
+so QAT costs ~nothing extra on the MXU. Scales are explicit values (pytree
+state), not graph variables.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def qrange(bits):
+    """Symmetric signed range: [-bound, bound] with bound = 2^(bits-1) - 1."""
+    return float(2 ** (bits - 1) - 1)
+
+
+def abs_max_scale(x, channel_axis=None):
+    """Per-tensor (or per-channel) abs-max scale.
+
+    Ref: quantization_pass.py:297 _insert_quant_abs_max_op (per-tensor) and
+    :485 _insert_channel_quant_op (per-output-channel for conv weights).
+    """
+    x = jnp.asarray(x)
+    if channel_axis is None:
+        return jnp.max(jnp.abs(x))
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    return jnp.max(jnp.abs(x), axis=axes)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fake_quant_dequant(x, scale, bits=8, channel_axis=None):
+    """Simulate int quantization: round(x / step) * step, clipped to range.
+
+    Straight-through backward: dy passes to x where |x| <= scale, else 0
+    (the saturating-STE used by the reference's fake_quantize grad kernels).
+    """
+    y, _ = _fqdq_fwd(x, scale, bits, channel_axis)
+    return y
+
+
+def _broadcast_scale(scale, x, channel_axis):
+    scale = jnp.asarray(scale)
+    if channel_axis is None or scale.ndim == 0:
+        return scale
+    shape = [1] * x.ndim
+    shape[channel_axis] = scale.shape[0]
+    return scale.reshape(shape)
+
+
+def _fqdq_fwd(x, scale, bits, channel_axis):
+    bound = qrange(bits)
+    scale = jnp.asarray(scale)
+    s = _broadcast_scale(scale, x, channel_axis)
+    s = jnp.maximum(s, 1e-8)
+    step = s / bound
+    q = jnp.clip(jnp.round(x / step), -bound, bound)
+    y = q * step
+    mask = (jnp.abs(x) <= s).astype(x.dtype)
+    return y, (mask, scale)
+
+
+def _fqdq_bwd(bits, channel_axis, res, dy):
+    mask, scale = res
+    return dy * mask, jnp.zeros_like(scale)  # no gradient to the scale
+
+
+fake_quant_dequant.defvjp(_fqdq_fwd, _fqdq_bwd)
+
+
+def fake_quant_abs_max(x, bits=8, channel_axis=None):
+    """Dynamic abs-max fake quant (scale recomputed from the live tensor).
+
+    Ref: quantization_pass.py:297 — 'abs_max' quantize type.
+    """
+    scale = jax.lax.stop_gradient(abs_max_scale(x, channel_axis))
+    return fake_quant_dequant(x, scale, bits, channel_axis)
+
+
+def moving_average_scale(prev_scale, x, rate=0.9):
+    """state' = rate*state + (1-rate)*abs_max(x); returns the new scale.
+
+    Ref: quantization_pass.py:398 _insert_quant_moving_average_abs_max_op
+    (accum/state variables with moving_rate, default 0.9).
+    """
+    cur = abs_max_scale(x)
+    return rate * prev_scale + (1.0 - rate) * cur
+
+
+def range_abs_max_scale(prev_scale, x, step, window_size=10000):
+    """Windowed running max: reset at window boundaries, else running max.
+
+    Ref: quantization_pass.py:327 _insert_quant_range_abs_max_op
+    (window_size attr, scales buffer; here collapsed to the effective
+    running-max-within-window recurrence).
+    """
+    cur = abs_max_scale(x)
+    at_boundary = (step % window_size) == 0
+    return jnp.where(at_boundary, cur, jnp.maximum(prev_scale, cur))
+
+
+def quantize_to_int(x, scale, bits=8, channel_axis=None):
+    """Real quantization to integers (for freeze/export, not training).
+
+    Ref: quantization_pass.py:628 QuantizationFreezePass.apply — weights
+    are converted to round(w / step) int8 at freeze time.
+    """
+    bound = qrange(bits)
+    s = _broadcast_scale(jnp.maximum(jnp.asarray(scale), 1e-8), x,
+                         channel_axis)
+    q = jnp.clip(jnp.round(x * (bound / s)), -bound, bound)
+    return q.astype(jnp.int8 if bits <= 8 else jnp.int32)
+
+
+def dequantize_from_int(q, scale, bits=8, channel_axis=None):
+    """Inverse of quantize_to_int (ref: :515 _insert_dequant_op)."""
+    bound = qrange(bits)
+    q = jnp.asarray(q).astype(jnp.float32)
+    s = _broadcast_scale(jnp.asarray(scale), q, channel_axis)
+    return q * (s / bound)

@@ -1,0 +1,141 @@
+"""Post-training quantization + freeze/export.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:587 QuantizationFreezePass (fold fake-quant into real
+int8 weights + dequant), :846 area ConvertToInt8Pass, and
+mkldnn_post_training_strategy.py (calibration-based PTQ); also
+contrib/quantize/quantize_transpiler.py (program-rewrite flavour).
+
+TPU-first pipeline:
+    qmodel = qat.quantize_model(model, cfg)          # swap layers
+    variables = qat.upgrade_variables(qmodel, variables, key)
+    variables = ptq.calibrate(qmodel, variables, batches)   # act scales
+    variables = ptq.freeze(qmodel, variables)        # bake weight quant
+    int8_tree = ptq.export_int8(qmodel, variables)   # serving payload
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.quant import ops as Q
+from paddle_tpu.quant.qat import (QuantConfig, QuantizedConv2D,
+                                  QuantizedLinear)
+
+
+def _quantized_leaves(model, path=()):
+    """Yield (path, module) for every quantized layer in the tree,
+    including the root itself (quantize_model may swap the root)."""
+    if path == () and isinstance(model, (QuantizedLinear, QuantizedConv2D)):
+        yield (), model
+    for name, child in model._children.items():
+        p = path + (name,)
+        if isinstance(child, (QuantizedLinear, QuantizedConv2D)):
+            yield p, child
+        yield from _quantized_leaves(child, p)
+
+
+def calibrate(qmodel, variables, batches, apply_kwargs=None):
+    """Run calibration forwards so moving-average activation scales settle.
+
+    Ref: mkldnn_post_training_strategy.py — the reference feeds a calibration
+    dataset and collects per-tensor scales; here the quantizer state IS the
+    scale store. Runs in `calibrating` mode: Dropout/BatchNorm keep their
+    eval behavior (no noise, running stats untouched) while quantizer scale
+    states update; apply always returns (out, new_state) in this mode.
+    """
+    apply_kwargs = apply_kwargs or {}
+    for batch in batches:
+        args = batch if isinstance(batch, (list, tuple)) else (batch,)
+        _, new_state = qmodel.apply(variables, *args, calibrating=True,
+                                    **apply_kwargs)
+        variables = {"params": variables["params"], "state": new_state}
+    return variables
+
+
+def freeze(qmodel, variables):
+    """Bake weight fake-quantization into the stored float weights so eval
+    no longer re-quantizes stochastically-trained values.
+
+    Ref: quantization_pass.py:628 QuantizationFreezePass.apply.
+
+    Functional: returns a new variables tree; the input is not mutated.
+    """
+    def set_path(node, path, fn):
+        node = dict(node)
+        if len(path) == 1:
+            node[path[0]] = fn(node[path[0]])
+        else:
+            node[path[0]] = set_path(node[path[0]], path[1:], fn)
+        return node
+
+    params = variables["params"]
+    for path, mod in _quantized_leaves(qmodel):
+        cfg = mod.quant_cfg
+        axis = (mod.CHANNEL_AXIS
+                if cfg.weight_quantize_type == "channel_wise_abs_max"
+                else None)
+
+        def bake(leaf, axis=axis, bits=cfg.weight_bits):
+            leaf = dict(leaf)
+            if "weight" in leaf:
+                w = leaf["weight"]
+                scale = Q.abs_max_scale(w, axis)
+                leaf["weight"] = Q.dequantize_from_int(
+                    Q.quantize_to_int(w, scale, bits, axis),
+                    scale, bits, axis).astype(w.dtype)
+            return leaf
+
+        try:
+            params = bake(params) if path == () else \
+                set_path(params, path, bake)
+        except KeyError:
+            continue
+    return {"params": params, "state": variables.get("state", {})}
+
+
+def export_int8(qmodel, variables):
+    """Produce the serving payload: int8 weights + scales per quantized
+    layer, plus activation scales (ref: ConvertToInt8Pass + the scale
+    outputs the freeze pass leaves for the inference engine)."""
+    out = {}
+    params, state = variables["params"], variables.get("state", {})
+    for path, mod in _quantized_leaves(qmodel):
+        node, snode = params, state
+        for k in path:
+            node = node.get(k, {}) if isinstance(node, dict) else {}
+        for k in path + ("input_quant",):
+            snode = snode.get(k, {}) if isinstance(snode, dict) else {}
+        if "weight" not in node:
+            continue
+        cfg = mod.quant_cfg
+        axis = (mod.CHANNEL_AXIS
+                if cfg.weight_quantize_type == "channel_wise_abs_max"
+                else None)
+        w = node["weight"]
+        scale = Q.abs_max_scale(w, axis)
+        entry = {
+            "weight_int8": Q.quantize_to_int(w, scale, cfg.weight_bits, axis),
+            "weight_scale": scale,
+            "weight_bits": cfg.weight_bits,
+            "channel_axis": axis,
+        }
+        if "bias" in node:
+            entry["bias"] = node["bias"]
+        if isinstance(snode, dict) and "scale" in snode:
+            entry["act_scale"] = snode["scale"]
+            entry["act_bits"] = cfg.activation_bits
+        out["/".join(path)] = entry
+    return out
+
+
+def int8_linear(x, entry):
+    """Reference int8 serving kernel: dequantized-weight matmul. On TPU the
+    int8 weights ride HBM at 1/4 bandwidth and dequant fuses into the matmul
+    prologue (XLA handles the convert); true int8 MXU matmul arrives with
+    AQT-style lowering later."""
+    w = Q.dequantize_from_int(entry["weight_int8"], entry["weight_scale"],
+                              entry["weight_bits"], entry["channel_axis"])
+    y = jnp.asarray(x) @ w
+    if "bias" in entry:
+        y = y + entry["bias"]
+    return y

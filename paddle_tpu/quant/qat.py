@@ -1,0 +1,189 @@
+"""Quantization-aware training — the QuantizationTransformPass successor.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:58 QuantizationTransformPass — rewrites the graph,
+inserting fake quant/dequant before every quantizable op (conv2d, mul/fc...),
+with configurable weight/activation quantize types and bit widths.
+
+TPU-first: instead of graph surgery, `quantize_model` swaps Linear/Conv2D
+modules in the layer tree for quantized subclasses that fake-quant their
+weights and input activations in forward. Scales for the moving-average
+activation quantizer live in the module state tree (the functional analogue
+of the reference's scale Variables) and update during training forwards.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.nn import layers as L
+from paddle_tpu.nn.module import Module
+from paddle_tpu.quant import ops as Q
+
+WEIGHT_QUANT_TYPES = ("abs_max", "channel_wise_abs_max")
+ACT_QUANT_TYPES = ("abs_max", "moving_average_abs_max", "range_abs_max")
+
+
+@dataclasses.dataclass
+class QuantConfig:
+    """Ref: QuantizationTransformPass ctor args (quantization_pass.py:59-146):
+    weight_bits, activation_bits, activation_quantize_type,
+    weight_quantize_type, window_size, moving_rate."""
+    weight_bits: int = 8
+    activation_bits: int = 8
+    weight_quantize_type: str = "channel_wise_abs_max"
+    activation_quantize_type: str = "moving_average_abs_max"
+    moving_rate: float = 0.9
+    window_size: int = 10000
+
+    def __post_init__(self):
+        enforce(self.weight_quantize_type in WEIGHT_QUANT_TYPES,
+                "unknown weight_quantize_type %s", self.weight_quantize_type)
+        enforce(self.activation_quantize_type in ACT_QUANT_TYPES,
+                "unknown activation_quantize_type %s",
+                self.activation_quantize_type)
+
+
+class _ActQuant(Module):
+    """Input-activation fake quantizer with stateful scale."""
+
+    def __init__(self, cfg: QuantConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.activation_quantize_type != "abs_max":
+            self.state("scale", (), lambda k, s, d: jnp.ones(s, d))
+            self.state("step", (), lambda k, s, d: jnp.zeros(s, d),
+                       dtype=jnp.int32)
+
+    def forward(self, x):
+        cfg = self.cfg
+        if cfg.activation_quantize_type == "abs_max":
+            return Q.fake_quant_abs_max(x, cfg.activation_bits)
+        prev = self.s("scale")
+        if self.training or self.calibrating:
+            step = self.s("step")
+            if cfg.activation_quantize_type == "moving_average_abs_max":
+                # seed from the first observed batch instead of the 1.0 init
+                scale = jnp.where(
+                    step == 0, Q.abs_max_scale(x),
+                    Q.moving_average_scale(prev, x, cfg.moving_rate))
+            else:  # range_abs_max
+                scale = Q.range_abs_max_scale(prev, x, step, cfg.window_size)
+            self.update_state("scale", scale)
+            self.update_state("step", self.s("step") + 1)
+        else:
+            scale = prev
+        return Q.fake_quant_dequant(x, jax.lax.stop_gradient(scale),
+                                    cfg.activation_bits)
+
+
+def _quant_weight(w, cfg: QuantConfig, channel_axis):
+    if cfg.weight_quantize_type == "channel_wise_abs_max":
+        return Q.fake_quant_abs_max(w, cfg.weight_bits, channel_axis)
+    return Q.fake_quant_abs_max(w, cfg.weight_bits)
+
+
+def _clone_as_quantized(cls, m, cfg):
+    """Rebuild a float layer as its quantized subclass: same attribute dict,
+    same param/state/child specs, plus an input-activation quantizer."""
+    q = cls.__new__(cls)
+    Module.__init__(q)
+    q.__dict__.update({k: v for k, v in m.__dict__.items()
+                       if k not in ("_params", "_state", "_children")})
+    q._params.update(m._params)
+    q._state.update(m._state)
+    q._children.update(m._children)
+    q.quant_cfg = cfg
+    q.input_quant = _ActQuant(cfg)
+    return q
+
+
+class QuantizedLinear(L.Linear):
+    """Linear with fake-quantized weight + input (ref: 'mul'/'fc' in
+    _quantizable_op_type, quantization_pass.py:58 area).
+
+    Weight layout (in, out) → channel axis 1 (per-output-channel, matching
+    the reference's channel-wise scheme on the output dim).
+    """
+    CHANNEL_AXIS = 1
+
+    @classmethod
+    def from_float(cls, m: L.Linear, cfg: QuantConfig):
+        return _clone_as_quantized(cls, m, cfg)
+
+    def forward(self, x):
+        w = _quant_weight(self.p("weight"), self.quant_cfg, self.CHANNEL_AXIS)
+        x = self.input_quant(x)
+        y = x @ w
+        if self.has_bias:
+            y = y + self.p("bias")
+        return L._act(self.act, y)
+
+
+class QuantizedConv2D(L.Conv2D):
+    """Conv2D with fake-quantized weight + input; weight layout (O,I,H,W) →
+    channel axis 0 (ref: _insert_channel_quant_op quantizes conv filters
+    per output channel, quantization_pass.py:485)."""
+    CHANNEL_AXIS = 0
+
+    @classmethod
+    def from_float(cls, m: L.Conv2D, cfg: QuantConfig):
+        return _clone_as_quantized(cls, m, cfg)
+
+    def forward(self, x):
+        from paddle_tpu.ops import nn as opsnn
+        w = _quant_weight(self.p("weight"), self.quant_cfg, self.CHANNEL_AXIS)
+        x = self.input_quant(x)
+        y = opsnn.conv2d(x, w, self.p("bias") if self.has_bias else None,
+                         self.stride, self.padding, self.dilation,
+                         self.groups)
+        return L._act(self.act, y)
+
+
+_SWAP = {L.Conv2D: QuantizedConv2D, L.Linear: QuantizedLinear}
+
+
+def quantize_model(model: Module, config: QuantConfig = None) -> Module:
+    """Swap quantizable layers for quantized versions, in place on the layer
+    tree (the layer tree is a spec, not trained state — parameters live in
+    the variables pytree, whose param structure this preserves; it only adds
+    `input_quant` state entries).
+
+    Ref: QuantizationTransformPass.apply (quantization_pass.py:147).
+    """
+    config = config or QuantConfig()
+    root_cls = _SWAP.get(type(model))
+    if root_cls is not None:
+        return root_cls.from_float(model, config)
+    for name, child in list(model._children.items()):
+        cls = _SWAP.get(type(child))
+        if cls is not None:
+            qchild = cls.from_float(child, config)
+            model._children[name] = qchild
+            if getattr(model, name, None) is child:
+                object.__setattr__(model, name, qchild)
+            items = getattr(model, "_items", None)
+            if items is not None:
+                for i, it in enumerate(items):
+                    if it is child:
+                        items[i] = qchild
+        else:
+            quantize_model(child, config)
+    return model
+
+
+def upgrade_variables(qmodel: Module, variables, key):
+    """Merge trained float variables into a freshly-inited quantized tree
+    (adds the new quantizer state entries, keeps every trained value)."""
+    fresh = qmodel.init(key)
+
+    def merge(old, new):
+        if isinstance(new, dict):
+            return {k: merge(old.get(k), new[k]) if isinstance(old, dict)
+                    else new[k] for k in new}
+        return new if old is None else old
+
+    return {"params": merge(variables.get("params", {}), fresh["params"]),
+            "state": merge(variables.get("state", {}), fresh["state"])}

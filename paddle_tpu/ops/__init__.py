@@ -24,6 +24,7 @@ from paddle_tpu.ops import (
     activations,
     attention,
     control_flow,
+    crf,
     detection,
     loss,
     math,

@@ -1,0 +1,156 @@
+"""Linear-chain CRF ops + edit distance.
+
+Ref: /root/reference/paddle/fluid/operators/linear_chain_crf_op.cc (forward
+algorithm log-likelihood), crf_decoding_op.cc (Viterbi decode),
+edit_distance_op.cc (Levenshtein). These back the reference's
+label_semantic_roles book model (tests/book/test_label_semantic_roles.py).
+
+TPU-first: sequences are padded dense [B, T, K] + lengths (MXU-friendly static
+shapes); the time recurrences are `lax.scan`s. The reference's transition
+parameter layout is kept for parity: Transition is [K + 2, K] where row 0 =
+start weights, row 1 = stop weights, rows 2: = w[i, j] (score of tag i -> j).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+def _split_transition(transition):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    return start, stop, trans
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(emission, transition, label, lengths):
+    """Negative log-likelihood of `label` paths under a linear-chain CRF.
+
+    emission: [B, T, K] float unnormalized tag scores.
+    transition: [K+2, K] (row0 start, row1 stop, rows2: tag->tag).
+    label: [B, T] int gold tags.
+    lengths: [B] int valid lengths (>= 1).
+    Returns [B] negative log-likelihood (the reference's LogLikelihood output
+    is used directly as the cost; linear_chain_crf_op.cc computes
+    -(path_score - logZ)).
+    """
+    start, stop, trans = _split_transition(transition)
+    B, T, K = emission.shape
+    t_idx = jnp.arange(T)
+    mask = (t_idx[None, :] < lengths[:, None]).astype(emission.dtype)  # [B,T]
+
+    # ---- path score -----------------------------------------------------
+    lab = jnp.clip(label, 0, K - 1)
+    em_score = jnp.sum(
+        jnp.take_along_axis(emission, lab[..., None], axis=-1)[..., 0] * mask,
+        axis=1)
+    pair_scores = trans[lab[:, :-1], lab[:, 1:]]                       # [B,T-1]
+    pair_mask = mask[:, 1:]
+    tr_score = jnp.sum(pair_scores * pair_mask, axis=1)
+    first_tag = lab[:, 0]
+    last_pos = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(lab, last_pos[:, None], axis=1)[:, 0]
+    score = em_score + tr_score + start[first_tag] + stop[last_tag]
+
+    # ---- partition function (forward algorithm) -------------------------
+    alpha0 = start[None, :] + emission[:, 0, :]                        # [B,K]
+
+    def step(alpha, inp):
+        em_t, m_t = inp                                                # [B,K],[B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + em_t
+        alpha = jnp.where(m_t[:, None] > 0, nxt, alpha)
+        return alpha, None
+
+    xs = (jnp.moveaxis(emission[:, 1:, :], 1, 0), jnp.moveaxis(mask[:, 1:], 1, 0))
+    alphaT, _ = lax.scan(step, alpha0, xs)
+    log_z = jax.nn.logsumexp(alphaT + stop[None, :], axis=1)
+    return log_z - score
+
+
+@register_op("crf_decoding")
+def crf_decoding(emission, transition, lengths, label=None):
+    """Viterbi decode. Returns [B, T] best tag path (0 beyond length).
+
+    With `label` given, returns instead a [B, T] 0/1 array marking positions
+    where the decoded path matches the gold label (the reference's
+    crf_decoding_op.cc behavior when Label is fed).
+    """
+    start, stop, trans = _split_transition(transition)
+    B, T, K = emission.shape
+    t_idx = jnp.arange(T)
+    mask = t_idx[None, :] < lengths[:, None]
+
+    alpha0 = start[None, :] + emission[:, 0, :]
+
+    def fwd(alpha, inp):
+        em_t, m_t = inp
+        cand = alpha[:, :, None] + trans[None]                         # [B,K,K]
+        best_prev = jnp.argmax(cand, axis=1)                           # [B,K]
+        nxt = jnp.max(cand, axis=1) + em_t
+        alpha_new = jnp.where(m_t[:, None], nxt, alpha)
+        # beyond the end, point back at the same tag so backtrace is stable
+        best_prev = jnp.where(m_t[:, None], best_prev,
+                              jnp.arange(K)[None, :])
+        return alpha_new, best_prev
+
+    xs = (jnp.moveaxis(emission[:, 1:, :], 1, 0),
+          jnp.moveaxis(mask[:, 1:], 1, 0))
+    alphaT, backptrs = lax.scan(fwd, alpha0, xs)                       # [T-1,B,K]
+    last_tag = jnp.argmax(alphaT + stop[None, :], axis=1)              # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: step i consumes backptrs[i] with carry = tag[i+1] and
+    # emits that carry as ys[i]; the final carry is the tag at position 0.
+    first_tag, path_tail = lax.scan(back, last_tag, backptrs, reverse=True)
+    path = jnp.concatenate([first_tag[None], path_tail], axis=0)       # [T,B]
+    path = jnp.moveaxis(path, 0, 1)                                    # [B,T]
+    path = jnp.where(mask, path, 0)
+    if label is not None:
+        return jnp.where(mask, (path == label).astype(jnp.int32), 0)
+    return path
+
+
+@register_op("edit_distance")
+def edit_distance(hyp, hyp_lengths, ref, ref_lengths, normalized=False):
+    """Batched Levenshtein distance (ref: edit_distance_op.cc).
+
+    hyp: [B, T1] int, ref: [B, T2] int, with per-row valid lengths.
+    Returns ([B] distances float32, [B] ref sequence lengths int64-ish) to
+    mirror the reference's (Out, SequenceNum) pair — here just the distance
+    (and optionally normalized by ref length).
+    """
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+    big = jnp.float32(T1 + T2 + 1)
+    jr = jnp.arange(T2 + 1, dtype=jnp.float32)
+
+    row0 = jnp.broadcast_to(jr, (B, T2 + 1))
+    # when hyp_len == 0 the answer is ref_len
+    res0 = jnp.where(hyp_lengths == 0, ref_lengths.astype(jnp.float32), big)
+
+    def step(carry, i):
+        row, res = carry                                               # [B,T2+1]
+        h_i = hyp[:, i]                                                # [B]
+        sub_cost = (ref != h_i[:, None]).astype(jnp.float32)           # [B,T2]
+        # c[j] = min(row[j] + 1 (delete), row[j-1] + sub) for j=1..T2
+        c = jnp.minimum(row[:, 1:] + 1.0, row[:, :-1] + sub_cost)
+        c = jnp.concatenate([row[:, :1] + 1.0, c], axis=1)             # [B,T2+1]
+        # resolve insert chain new[j] = min_k<=j (c[k] + (j-k)) via cummin
+        new = jnp.minimum(
+            c, lax.cummin(c - jr[None, :], axis=1) + jr[None, :])
+        valid = i < hyp_lengths                                        # [B]
+        row = jnp.where(valid[:, None], new, row)
+        # record the answer row when we've just consumed the last hyp token
+        done = (i + 1) == hyp_lengths
+        ans = jnp.take_along_axis(row, ref_lengths[:, None], axis=1)[:, 0]
+        res = jnp.where(done, ans, res)
+        return (row, res), None
+
+    (_, res), _ = lax.scan(step, (row0, res0), jnp.arange(T1))
+    if normalized:
+        res = res / jnp.maximum(ref_lengths.astype(jnp.float32), 1.0)
+    return res

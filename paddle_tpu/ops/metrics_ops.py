@@ -62,3 +62,93 @@ def precision_recall(pred_label, label, num_classes):
     recall = tp / jnp.maximum(tp + fn, 1e-12)
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
     return precision, recall, f1
+
+
+def _extract_chunks(tags, chunk_scheme, num_chunk_types):
+    """Parse a tag sequence into {(start, end, type)} chunks.
+
+    Tag encoding matches the reference (operators/metrics/chunk_eval_op.cc /
+    .h ChunkEvalOp): with S tags per chunk type (IOB/IOE: 2, IOBES: 4,
+    plain: 1), tag = chunk_type * S + tag_index; any tag >= num_chunk_types*S
+    is Outside.
+    """
+    schemes = {"IOB": ("B", "I"), "IOE": ("I", "E"),
+               "IOBES": ("B", "I", "E", "S"), "plain": ("U",)}
+    names = schemes[chunk_scheme]
+    S = len(names)
+    chunks = set()
+    start = None
+    ctype = None
+
+    def close(end):
+        nonlocal start, ctype
+        if start is not None:
+            chunks.add((start, end, ctype))
+        start, ctype = None, None
+
+    for i, t in enumerate(tags):
+        t = int(t)
+        if t < 0 or t >= num_chunk_types * S:
+            close(i - 1)
+            continue
+        ty, ti = divmod(t, S)
+        tag = names[ti]
+        if chunk_scheme == "plain":
+            # a maximal run of same-type tokens is one chunk (chunk_eval_op.h
+            # ChunkEnd is false for consecutive same-type plain tags)
+            if ctype != ty:
+                close(i - 1)
+                start, ctype = i, ty
+        elif chunk_scheme == "IOB":
+            if tag == "B" or ctype != ty:
+                close(i - 1)
+                start, ctype = i, ty
+        elif chunk_scheme == "IOE":
+            if ctype != ty:
+                close(i - 1)
+                start, ctype = i, ty
+            if tag == "E":
+                close(i)
+        elif chunk_scheme == "IOBES":
+            if tag == "S":
+                close(i - 1)
+                chunks.add((i, i, ty))
+            elif tag == "B" or ctype != ty:
+                close(i - 1)
+                start, ctype = i, ty
+            if tag == "E" and start is not None:
+                close(i)
+    close(len(tags) - 1)
+    return chunks
+
+
+@register_op("chunk_eval")
+def chunk_eval(inference, label, lengths, chunk_scheme="IOB",
+               num_chunk_types=1, excluded_chunk_types=()):
+    """ref: operators/metrics/chunk_eval_op.cc — chunk-level P/R/F1 counts.
+
+    Host-side (the reference kernel is CPU-only too). inference/label:
+    [B, T] int arrays; lengths: [B]. Returns (precision, recall, f1,
+    num_infer_chunks, num_label_chunks, num_correct_chunks).
+    """
+    import numpy as np
+    inference = np.asarray(inference)
+    label = np.asarray(label)
+    lengths = np.asarray(lengths)
+    excl = set(excluded_chunk_types)
+    n_inf = n_lab = n_cor = 0
+    for b in range(inference.shape[0]):
+        L = int(lengths[b])
+        inf_c = {c for c in _extract_chunks(
+            inference[b, :L], chunk_scheme, num_chunk_types)
+            if c[2] not in excl}
+        lab_c = {c for c in _extract_chunks(
+            label[b, :L], chunk_scheme, num_chunk_types)
+            if c[2] not in excl}
+        n_inf += len(inf_c)
+        n_lab += len(lab_c)
+        n_cor += len(inf_c & lab_c)
+    precision = n_cor / max(n_inf, 1e-12)
+    recall = n_cor / max(n_lab, 1e-12)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+    return precision, recall, f1, n_inf, n_lab, n_cor

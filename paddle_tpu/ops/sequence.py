@@ -148,3 +148,139 @@ def sequence_enumerate(x, win_size, pad_value=0):
     idx = jnp.clip(idx, 0, n - 1)
     out = jnp.where(valid, x[idx], pad_value)
     return out
+
+
+@register_op("sequence_erase")
+def sequence_erase(rb: RaggedBatch, tokens):
+    """ref: sequence_ops/sequence_erase_op.cc — drop every occurrence of the
+    given token ids from each sequence.
+
+    Static-shape: survivors are packed to the front of the values buffer
+    (stable), row_lengths shrink; the buffer keeps its original size with the
+    tail zero-padded (XLA needs static shapes; callers use row_lengths).
+    """
+    v = rb.values
+    drop = jnp.zeros(v.shape, bool)
+    for t in tokens:
+        drop = drop | (v == t)
+    seg = rb.segment_ids()
+    keep = ~drop
+    # count survivors per row
+    new_lengths = jax.ops.segment_sum(keep.astype(jnp.int32), seg, rb.nrows)
+    # globally pack survivors (row-major, stable) and push dropped to the tail
+    order = jnp.argsort(jnp.where(drop, rb.nrows, seg), stable=True)
+    return RaggedBatch(jnp.where(
+        jnp.arange(v.shape[0]) < jnp.sum(new_lengths), v[order], 0),
+        new_lengths)
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as(x, rb_y: RaggedBatch):
+    """ref: sequence_ops/sequence_expand_as_op.cc — row i of x repeated
+    rb_y.row_lengths[i] times (same mechanics as sequence_expand here)."""
+    return sequence_expand(x, rb_y)
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, rb_ids: RaggedBatch, rb_updates: RaggedBatch):
+    """ref: sequence_ops/sequence_scatter_op.cc — for row i:
+    out[i, ids_i[k]] += updates_i[k]."""
+    rows = rb_ids.segment_ids()
+    return x.at[rows, rb_ids.values].add(rb_updates.values)
+
+
+def _padded_max_len(rb, max_len):
+    """Concrete longest-row length when available (eager), else None (the
+    caller's to_padded falls back to the flat total — correct but wasteful)."""
+    if max_len is not None:
+        return int(max_len)
+    if isinstance(rb.row_lengths, jax.core.Tracer):
+        return None
+    return int(jnp.max(rb.row_lengths))
+
+
+@register_op("sequence_conv")
+def sequence_conv(rb: RaggedBatch, filter_w, context_start=-1,
+                  context_length=3, bias=None, max_len=None):
+    """ref: sequence_ops/sequence_conv_op.cc — context-window projection.
+
+    For each position t: concat(x[t+context_start], ...,
+    x[t+context_start+context_length-1]) @ filter_w, zero-padded at sequence
+    boundaries. filter_w: [context_length * D, out_dim].
+    """
+    dense, _ = rb.to_padded(_padded_max_len(rb, max_len))    # [B, T, D]
+    B, T, D = dense.shape
+    lengths = rb.row_lengths
+    mask = (jnp.arange(T)[None, :] < lengths[:, None])
+    dense = jnp.where(mask[..., None], dense, 0.0)
+    cols = []
+    for k in range(context_length):
+        off = context_start + k
+        shifted = jnp.roll(dense, -off, axis=1)
+        pos = jnp.arange(T) + off
+        valid = (pos >= 0)[None, :] & (pos[None, :] < lengths[:, None])
+        cols.append(jnp.where(valid[..., None], shifted, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)                     # [B,T,ctx*D]
+    out = ctx @ filter_w
+    if bias is not None:
+        out = out + bias
+    return RaggedBatch.from_padded(out, lengths)
+
+
+@register_op("row_conv")
+def row_conv(rb: RaggedBatch, filter_w, max_len=None):
+    """ref: operators/row_conv_op.cc — lookahead convolution
+    (DeepSpeech2-style): out[t] = sum_k filter_w[k] * x[t + k], per channel,
+    future context only, zero past the sequence end."""
+    dense, _ = rb.to_padded(_padded_max_len(rb, max_len))    # [B,T,D]
+    B, T, D = dense.shape
+    lengths = rb.row_lengths
+    K = filter_w.shape[0]
+    out = jnp.zeros_like(dense)
+    for k in range(K):
+        shifted = jnp.roll(dense, -k, axis=1)
+        valid = (jnp.arange(T) + k)[None, :] < lengths[:, None]
+        out = out + jnp.where(valid[..., None], shifted, 0.0) * filter_w[k]
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    out = jnp.where(mask[..., None], out, 0.0)
+    return RaggedBatch.from_padded(out, lengths)
+
+
+@register_op("im2sequence")
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """ref: operators/im2sequence_op.cc — slide a kernel over NCHW images,
+    each patch flattened to one timestep: [N, C, H, W] ->
+    [N, out_h * out_w, C * kh * kw]."""
+    N, C, H, W = x.shape
+    kh, kw = kernels
+    sh, sw = strides
+    pt, pl, pb, pr = paddings
+    x = jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+    Hp, Wp = H + pt + pb, W + pl + pr
+    out_h = (Hp - kh) // sh + 1
+    out_w = (Wp - kw) // sw + 1
+    i0 = jnp.arange(out_h) * sh
+    j0 = jnp.arange(out_w) * sw
+    ii = i0[:, None] + jnp.arange(kh)[None, :]               # [oh, kh]
+    jj = j0[:, None] + jnp.arange(kw)[None, :]               # [ow, kw]
+    patches = x[:, :, ii[:, None, :, None], jj[None, :, None, :]]
+    # -> [N, C, oh, ow, kh, kw]
+    patches = jnp.transpose(patches, (0, 2, 3, 1, 4, 5))
+    return patches.reshape(N, out_h * out_w, C * kh * kw)
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """ref: operators/add_position_encoding_op.cc — out = alpha * x +
+    beta * sinusoid(position) over [B, T, D]; divisor 10000^(k/(half-1))
+    per add_position_encoding_op.h."""
+    B, T, D = x.shape
+    pos = jnp.arange(T, dtype=x.dtype)[:, None]
+    half = D // 2
+    denom = max(half - 1, 1)
+    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / denom)
+    enc = jnp.concatenate(
+        [jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1)
+    if enc.shape[-1] < D:
+        enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[-1])))
+    return alpha * x + beta * enc[None]

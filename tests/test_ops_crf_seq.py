@@ -1,0 +1,233 @@
+"""CRF / edit-distance / chunk-eval / new sequence ops — golden tests vs
+brute-force numpy references (the reference's OpTest pattern,
+unittests/test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_edit_distance_op.py, test_chunk_eval_op.py)."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.ragged import RaggedBatch
+from paddle_tpu.ops import crf, metrics_ops, sequence
+
+
+def brute_crf(emission, transition, lengths):
+    """Enumerate all paths; return (logZ per seq, best path per seq)."""
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    B, T, K = emission.shape
+    log_zs, best_paths, best_scores = [], [], []
+    for b in range(B):
+        L = int(lengths[b])
+        scores = {}
+        for path in itertools.product(range(K), repeat=L):
+            s = start[path[0]] + stop[path[-1]]
+            s += sum(emission[b, t, path[t]] for t in range(L))
+            s += sum(trans[path[t], path[t + 1]] for t in range(L - 1))
+            scores[path] = s
+        vals = np.array(list(scores.values()))
+        m = vals.max()
+        log_zs.append(m + np.log(np.exp(vals - m).sum()))
+        best = max(scores, key=scores.get)
+        best_paths.append(list(best) + [0] * (T - L))
+        best_scores.append(scores[best])
+    return np.array(log_zs), np.array(best_paths), scores
+
+
+class TestLinearChainCrf:
+    def setup_method(self, _):
+        rng = np.random.RandomState(7)
+        self.B, self.T, self.K = 3, 4, 3
+        self.emission = rng.randn(self.B, self.T, self.K).astype(np.float32)
+        self.transition = rng.randn(self.K + 2, self.K).astype(np.float32)
+        self.lengths = np.array([4, 2, 3], np.int32)
+        self.labels = rng.randint(0, self.K, (self.B, self.T)).astype(np.int32)
+
+    def test_nll_matches_brute_force(self):
+        log_zs, _, _ = brute_crf(self.emission, self.transition, self.lengths)
+        nll = np.asarray(crf.linear_chain_crf(
+            jnp.asarray(self.emission), jnp.asarray(self.transition),
+            jnp.asarray(self.labels), jnp.asarray(self.lengths)))
+        start, stop, trans = (self.transition[0], self.transition[1],
+                              self.transition[2:])
+        for b in range(self.B):
+            L = int(self.lengths[b])
+            p = self.labels[b, :L]
+            s = start[p[0]] + stop[p[-1]]
+            s += sum(self.emission[b, t, p[t]] for t in range(L))
+            s += sum(trans[p[t], p[t + 1]] for t in range(L - 1))
+            np.testing.assert_allclose(nll[b], log_zs[b] - s, rtol=1e-4)
+
+    def test_viterbi_matches_brute_force(self):
+        _, best, _ = brute_crf(self.emission, self.transition, self.lengths)
+        path = np.asarray(crf.crf_decoding(
+            jnp.asarray(self.emission), jnp.asarray(self.transition),
+            jnp.asarray(self.lengths)))
+        np.testing.assert_array_equal(path, best)
+
+    def test_decoding_with_label_marks_matches(self):
+        _, best, _ = brute_crf(self.emission, self.transition, self.lengths)
+        marks = np.asarray(crf.crf_decoding(
+            jnp.asarray(self.emission), jnp.asarray(self.transition),
+            jnp.asarray(self.lengths), jnp.asarray(best.astype(np.int32))))
+        mask = np.arange(self.T)[None] < self.lengths[:, None]
+        np.testing.assert_array_equal(marks, mask.astype(np.int32))
+
+    def test_grad_finite(self):
+        import jax
+        g = jax.grad(lambda e: jnp.sum(crf.linear_chain_crf(
+            e, jnp.asarray(self.transition), jnp.asarray(self.labels),
+            jnp.asarray(self.lengths))))(jnp.asarray(self.emission))
+        assert np.all(np.isfinite(np.asarray(g)))
+        # padded positions must not receive gradient
+        for b in range(self.B):
+            L = int(self.lengths[b])
+            np.testing.assert_allclose(np.asarray(g)[b, L:], 0.0, atol=1e-6)
+
+
+def py_levenshtein(a, b):
+    dp = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        prev = dp[:]
+        dp[0] = i
+        for j in range(1, len(b) + 1):
+            dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                        prev[j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[len(b)]
+
+
+class TestEditDistance:
+    def test_matches_python_dp(self):
+        rng = np.random.RandomState(0)
+        B, T1, T2 = 5, 7, 6
+        hyp = rng.randint(0, 4, (B, T1)).astype(np.int32)
+        ref = rng.randint(0, 4, (B, T2)).astype(np.int32)
+        hyp_len = rng.randint(0, T1 + 1, B).astype(np.int32)
+        ref_len = rng.randint(1, T2 + 1, B).astype(np.int32)
+        out = np.asarray(crf.edit_distance(
+            jnp.asarray(hyp), jnp.asarray(hyp_len), jnp.asarray(ref),
+            jnp.asarray(ref_len)))
+        for b in range(B):
+            expect = py_levenshtein(list(hyp[b, :hyp_len[b]]),
+                                    list(ref[b, :ref_len[b]]))
+            np.testing.assert_allclose(out[b], expect)
+
+    def test_normalized(self):
+        hyp = jnp.asarray([[1, 2, 3]], jnp.int32)
+        ref = jnp.asarray([[1, 2, 4, 5]], jnp.int32)
+        out = crf.edit_distance(hyp, jnp.asarray([3]), ref, jnp.asarray([4]),
+                                normalized=True)
+        np.testing.assert_allclose(np.asarray(out), [2.0 / 4.0])
+
+
+class TestChunkEval:
+    def test_iob_exact(self):
+        # tags (1 chunk type, IOB): B=0, I=1, O=2
+        label = np.array([[0, 1, 2, 0, 1, 1]])
+        infer = np.array([[0, 1, 2, 0, 2, 2]])
+        p, r, f1, ni, nl, nc = metrics_ops.chunk_eval(
+            infer, label, np.array([6]), "IOB", 1)
+        assert (ni, nl, nc) == (2, 2, 1)
+        np.testing.assert_allclose([p, r], [0.5, 0.5])
+
+    def test_iobes(self):
+        # S=4: B=0,I=1,E=2,S=3 for type 0; O = 4
+        label = np.array([[3, 0, 1, 2, 4]])
+        infer = np.array([[3, 0, 1, 2, 4]])
+        p, r, f1, ni, nl, nc = metrics_ops.chunk_eval(
+            infer, label, np.array([5]), "IOBES", 1)
+        assert (ni, nl, nc) == (2, 2, 2)
+        assert f1 == pytest.approx(1.0)
+
+    def test_plain_runs_are_single_chunks(self):
+        # plain scheme: a maximal same-type run is ONE chunk; 1 = Outside
+        infer = np.array([[0, 0]])
+        label = np.array([[0, 1]])
+        p, r, _, ni, nl, nc = metrics_ops.chunk_eval(
+            infer, label, np.array([2]), "plain", 1)
+        assert (ni, nl, nc) == (1, 1, 0)
+        assert (p, r) == (0.0, 0.0)
+
+    def test_excluded_types(self):
+        # 2 types IOB: type0 {B=0,I=1}, type1 {B=2,I=3}, O=4
+        label = np.array([[0, 1, 2, 3]])
+        infer = np.array([[0, 1, 2, 3]])
+        _, _, _, ni, nl, nc = metrics_ops.chunk_eval(
+            infer, label, np.array([4]), "IOB", 2, excluded_chunk_types=(1,))
+        assert (ni, nl, nc) == (1, 1, 1)
+
+
+class TestNewSequenceOps:
+    def test_sequence_erase(self):
+        rb = RaggedBatch.from_list([[1, 2, 3, 2], [2, 2], [4, 5]])
+        out = sequence.sequence_erase(rb, [2])
+        np.testing.assert_array_equal(np.asarray(out.row_lengths), [2, 0, 2])
+        n = int(np.sum(np.asarray(out.row_lengths)))
+        np.testing.assert_array_equal(np.asarray(out.values)[:n], [1, 3, 4, 5])
+
+    def test_sequence_scatter(self):
+        x = jnp.zeros((2, 5))
+        ids = RaggedBatch.from_list([[0, 2], [1]])
+        upd = RaggedBatch.from_list([[1.0, 2.0], [3.0]])
+        out = np.asarray(sequence.sequence_scatter(x, ids, upd))
+        expect = np.zeros((2, 5))
+        expect[0, 0], expect[0, 2], expect[1, 1] = 1, 2, 3
+        np.testing.assert_allclose(out, expect)
+
+    def test_sequence_conv_identity_window(self):
+        rng = np.random.RandomState(1)
+        D, O = 3, 2
+        rb = RaggedBatch.from_list(
+            [rng.randn(4, D).astype(np.float32),
+             rng.randn(2, D).astype(np.float32)])
+        w = rng.randn(3 * D, O).astype(np.float32)
+        out = sequence.sequence_conv(rb, jnp.asarray(w), context_start=-1,
+                                     context_length=3)
+        dense, _ = rb.to_padded()
+        dense = np.asarray(dense)
+        lens = np.asarray(rb.row_lengths)
+        for b, L in enumerate(lens):
+            for t in range(L):
+                ctx = np.zeros(3 * D, np.float32)
+                for k in range(3):
+                    src = t - 1 + k
+                    if 0 <= src < L:
+                        ctx[k * D:(k + 1) * D] = dense[b, src]
+                expect = ctx @ w
+                got = np.asarray(out.to_padded()[0])[b, t]
+                np.testing.assert_allclose(got, expect, atol=1e-5)
+
+    def test_row_conv(self):
+        rng = np.random.RandomState(2)
+        rb = RaggedBatch.from_list([rng.randn(5, 2).astype(np.float32)])
+        w = rng.randn(3, 2).astype(np.float32)
+        out = np.asarray(sequence.row_conv(rb, jnp.asarray(w)).to_padded()[0])
+        x = np.asarray(rb.to_padded()[0])[0]
+        for t in range(5):
+            expect = np.zeros(2, np.float32)
+            for k in range(3):
+                if t + k < 5:
+                    expect += w[k] * x[t + k]
+            np.testing.assert_allclose(out[0, t], expect, atol=1e-5)
+
+    def test_im2sequence(self):
+        x = np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4)
+        out = np.asarray(sequence.im2sequence(jnp.asarray(x), (2, 2), (2, 2)))
+        assert out.shape == (2, 4, 4)
+        np.testing.assert_allclose(out[0, 0], [0, 1, 4, 5])
+        np.testing.assert_allclose(out[0, 3], [10, 11, 14, 15])
+
+    def test_add_position_encoding(self):
+        x = np.zeros((1, 3, 4), np.float32)
+        out = np.asarray(sequence.add_position_encoding(jnp.asarray(x)))
+        # position 0: sin(0)=0, cos(0)=1
+        np.testing.assert_allclose(out[0, 0], [0, 0, 1, 1], atol=1e-6)
+
+    def test_sequence_expand_as(self):
+        x = jnp.asarray(np.eye(2, dtype=np.float32))
+        y = RaggedBatch.from_list([[1, 1, 1], [2, 2]])
+        out = sequence.sequence_expand_as(x, y)
+        np.testing.assert_array_equal(np.asarray(out.row_lengths), [3, 2])
+        expect = np.array([[1, 0], [1, 0], [1, 0], [0, 1], [0, 1]], np.float32)
+        np.testing.assert_allclose(np.asarray(out.values), expect)

@@ -33,6 +33,7 @@ from paddle_tpu.ops import (
     rnn,
     sequence,
     tensor_ops,
+    vision,
 )
 from paddle_tpu.ops.activations import *  # noqa: F401,F403
 from paddle_tpu.ops.math import *  # noqa: F401,F403

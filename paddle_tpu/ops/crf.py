@@ -129,11 +129,8 @@ def edit_distance(hyp, hyp_lengths, ref, ref_lengths, normalized=False):
     jr = jnp.arange(T2 + 1, dtype=jnp.float32)
 
     row0 = jnp.broadcast_to(jr, (B, T2 + 1))
-    # when hyp_len == 0 the answer is ref_len
-    res0 = jnp.where(hyp_lengths == 0, ref_lengths.astype(jnp.float32), big)
 
-    def step(carry, i):
-        row, res = carry                                               # [B,T2+1]
+    def step(row, i):
         h_i = hyp[:, i]                                                # [B]
         sub_cost = (ref != h_i[:, None]).astype(jnp.float32)           # [B,T2]
         # c[j] = min(row[j] + 1 (delete), row[j-1] + sub) for j=1..T2
@@ -142,15 +139,12 @@ def edit_distance(hyp, hyp_lengths, ref, ref_lengths, normalized=False):
         # resolve insert chain new[j] = min_k<=j (c[k] + (j-k)) via cummin
         new = jnp.minimum(
             c, lax.cummin(c - jr[None, :], axis=1) + jr[None, :])
-        valid = i < hyp_lengths                                        # [B]
-        row = jnp.where(valid[:, None], new, row)
-        # record the answer row when we've just consumed the last hyp token
-        done = (i + 1) == hyp_lengths
-        ans = jnp.take_along_axis(row, ref_lengths[:, None], axis=1)[:, 0]
-        res = jnp.where(done, ans, res)
-        return (row, res), None
+        # rows past hyp_len are frozen, so the final row is the answer row
+        row = jnp.where((i < hyp_lengths)[:, None], new, row)
+        return row, None
 
-    (_, res), _ = lax.scan(step, (row0, res0), jnp.arange(T1))
+    row, _ = lax.scan(step, row0, jnp.arange(T1))
+    res = jnp.take_along_axis(row, ref_lengths[:, None], axis=1)[:, 0]
     if normalized:
         res = res / jnp.maximum(ref_lengths.astype(jnp.float32), 1.0)
     return res

@@ -155,23 +155,21 @@ def sequence_erase(rb: RaggedBatch, tokens):
     """ref: sequence_ops/sequence_erase_op.cc — drop every occurrence of the
     given token ids from each sequence.
 
-    Static-shape: survivors are packed to the front of the values buffer
-    (stable), row_lengths shrink; the buffer keeps its original size with the
-    tail zero-padded (XLA needs static shapes; callers use row_lengths).
+    Host-side (eager only): the output's total length is data-dependent, and
+    RaggedBatch requires sum(row_lengths) == values.shape[0], so this is a
+    concrete (numpy) computation — like the reference's CPU-only kernel.
+    Under jit, mask tokens out with sequence ops instead of erasing.
     """
-    v = rb.values
-    drop = jnp.zeros(v.shape, bool)
-    for t in tokens:
-        drop = drop | (v == t)
-    seg = rb.segment_ids()
-    keep = ~drop
-    # count survivors per row
-    new_lengths = jax.ops.segment_sum(keep.astype(jnp.int32), seg, rb.nrows)
-    # globally pack survivors (row-major, stable) and push dropped to the tail
-    order = jnp.argsort(jnp.where(drop, rb.nrows, seg), stable=True)
-    return RaggedBatch(jnp.where(
-        jnp.arange(v.shape[0]) < jnp.sum(new_lengths), v[order], 0),
-        new_lengths)
+    import numpy as np
+    from paddle_tpu.core.enforce import enforce
+    enforce(not isinstance(rb.values, jax.core.Tracer),
+            "sequence_erase is host-side only (data-dependent output size); "
+            "do not call it under jit")
+    v = np.asarray(rb.values)
+    seg = np.asarray(rb.segment_ids())
+    keep = ~np.isin(v, np.asarray(list(tokens)))
+    new_lengths = np.bincount(seg[keep], minlength=rb.nrows).astype(np.int32)
+    return RaggedBatch(jnp.asarray(v[keep]), jnp.asarray(new_lengths))
 
 
 @register_op("sequence_expand_as")
@@ -187,6 +185,16 @@ def sequence_scatter(x, rb_ids: RaggedBatch, rb_updates: RaggedBatch):
     out[i, ids_i[k]] += updates_i[k]."""
     rows = rb_ids.segment_ids()
     return x.at[rows, rb_ids.values].add(rb_updates.values)
+
+
+def _repack(dense, rb):
+    """Inverse of rb.to_padded for a same-layout result: gather the valid
+    [B, T, ...] entries back to rb's flat layout. Fully static (the flat
+    total is rb.values.shape[0]) — works under jit, unlike from_padded."""
+    seg = rb.segment_ids()                                   # [total]
+    offs = rb.offsets()[:-1]
+    pos = jnp.arange(rb.values.shape[0], dtype=jnp.int32) - offs[seg]
+    return RaggedBatch(dense[seg, pos], rb.row_lengths)
 
 
 def _padded_max_len(rb, max_len):
@@ -224,7 +232,7 @@ def sequence_conv(rb: RaggedBatch, filter_w, context_start=-1,
     out = ctx @ filter_w
     if bias is not None:
         out = out + bias
-    return RaggedBatch.from_padded(out, lengths)
+    return _repack(out, rb)
 
 
 @register_op("row_conv")
@@ -243,7 +251,7 @@ def row_conv(rb: RaggedBatch, filter_w, max_len=None):
         out = out + jnp.where(valid[..., None], shifted, 0.0) * filter_w[k]
     mask = jnp.arange(T)[None, :] < lengths[:, None]
     out = jnp.where(mask[..., None], out, 0.0)
-    return RaggedBatch.from_padded(out, lengths)
+    return _repack(out, rb)
 
 
 @register_op("im2sequence")
@@ -277,8 +285,11 @@ def add_position_encoding(x, alpha=1.0, beta=1.0):
     B, T, D = x.shape
     pos = jnp.arange(T, dtype=x.dtype)[:, None]
     half = D // 2
-    denom = max(half - 1, 1)
-    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / denom)
+    if half <= 1:
+        div = jnp.full((max(half, 1),), 10000.0, x.dtype)
+    else:
+        div = jnp.power(10000.0,
+                        jnp.arange(half, dtype=x.dtype) / (half - 1))
     enc = jnp.concatenate(
         [jnp.sin(pos / div), jnp.cos(pos / div)], axis=-1)
     if enc.shape[-1] < D:

@@ -1,0 +1,413 @@
+"""Vision ops: sampling grids, deformable conv, 3-D pooling/conv, video ops.
+
+Ref: /root/reference/paddle/fluid/operators/{affine_grid_op.cc,
+grid_sampler_op.cc, deformable_conv_op.cc, space_to_depth_op.cc,
+shuffle_channel_op.cc, temporal_shift_op.cc, pool_op.cc (pool3d),
+conv_transpose_op.cc (conv3d_transpose), unpool_op.cc, spp_op.cc,
+data_norm_op.cc, detection/polygon_box_transform_op.cc,
+detection/psroi_pool_op.cc}.
+
+TPU-first: everything is expressed as dense gathers / reduce_windows /
+conv_general_dilated so XLA can tile onto the MXU; no per-pixel scalar loops.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("affine_grid")
+def affine_grid(theta, out_shape):
+    """ref: affine_grid_op.cc — theta [N,2,3] -> sampling grid [N,H,W,2]
+    in [-1,1] normalized coords (align_corners=True, the 1.5.x behavior)."""
+    N, C, H, W = out_shape
+    xs = jnp.linspace(-1.0, 1.0, W)
+    ys = jnp.linspace(-1.0, 1.0, H)
+    gx, gy = jnp.meshgrid(xs, ys)                            # [H,W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)                # [H,W,3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)          # [N,H,W,2]
+    return grid
+
+
+def _bilinear_sample(x, ix, iy):
+    """Sample NCHW `x` at float pixel coords ix/iy [N,...]; zero padding."""
+    N, C, H, W = x.shape
+    x0 = jnp.floor(ix)
+    y0 = jnp.floor(iy)
+    out = 0.0
+    for dx, dy in ((0, 0), (1, 0), (0, 1), (1, 1)):
+        xi = x0 + dx
+        yi = y0 + dy
+        w = (1 - jnp.abs(ix - xi)) * (1 - jnp.abs(iy - yi))
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        batch = jnp.arange(N).reshape((N,) + (1,) * (ix.ndim - 1))
+        vals = x[batch[..., None], jnp.arange(C), yc[..., None], xc[..., None]]
+        out = out + jnp.where((valid * w)[..., None] != 0,
+                              vals * (w * valid)[..., None], 0.0)
+    return out                                               # [N,...,C]
+
+
+@register_op("grid_sampler")
+def grid_sampler(x, grid):
+    """ref: grid_sampler_op.cc — bilinear sample NCHW x at grid [N,H,W,2]
+    ([-1,1] normalized, align_corners=True, zeros padding) -> [N,C,H,W]."""
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) * 0.5 * (W - 1)                # [N,Ho,Wo]
+    gy = (grid[..., 1] + 1.0) * 0.5 * (H - 1)
+    out = _bilinear_sample(x, gx, gy)                        # [N,Ho,Wo,C]
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, blocksize):
+    """ref: space_to_depth_op.cc — NCHW [N,C,H,W] -> [N,C*b*b,H/b,W/b]."""
+    N, C, H, W = x.shape
+    b = blocksize
+    x = x.reshape(N, C, H // b, b, W // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(N, C * b * b, H // b, W // b)
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(x, group):
+    """ref: shuffle_channel_op.cc — ShuffleNet channel shuffle."""
+    N, C, H, W = x.shape
+    x = x.reshape(N, group, C // group, H, W)
+    return jnp.swapaxes(x, 1, 2).reshape(N, C, H, W)
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    """ref: temporal_shift_op.cc — TSM video shift: x [N*T, C, H, W];
+    first C*ratio channels shift t-1, next C*ratio shift t+1."""
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    x = x.reshape(N, seg_num, C, H, W)
+    c1 = int(C * shift_ratio)
+    c2 = int(C * 2 * shift_ratio)
+    pad = jnp.zeros((N, 1, C, H, W), x.dtype)
+    prev = jnp.concatenate([pad, x[:, :-1]], axis=1)         # shift forward
+    nxt = jnp.concatenate([x[:, 1:], pad], axis=1)           # shift backward
+    out = jnp.concatenate(
+        [prev[:, :, :c1], nxt[:, :, c1:c2], x[:, :, c2:]], axis=2)
+    return out.reshape(NT, C, H, W)
+
+
+@register_op("pool3d")
+def pool3d(x, pool_size, pool_type="max", pool_stride=1, pool_padding=0,
+           ceil_mode=False, exclusive=True):
+    """ref: pool_op.cc pool3d — NCDHW max/avg pooling via reduce_window."""
+    ks = (pool_size,) * 3 if isinstance(pool_size, int) else tuple(pool_size)
+    st = (pool_stride,) * 3 if isinstance(pool_stride, int) \
+        else tuple(pool_stride)
+    pd = (pool_padding,) * 3 if isinstance(pool_padding, int) \
+        else tuple(pool_padding)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + (s - 1 if ceil_mode else 0)) for p, s in zip(pd, st))
+    if pool_type == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+        return out
+    ones = jnp.ones_like(x)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if exclusive:
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+    else:
+        cnt = float(ks[0] * ks[1] * ks[2])
+    return s / cnt
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, weight, stride=1, padding=0, dilation=1, groups=1,
+                     bias=None):
+    """ref: conv_transpose_op.cc — NCDHW transposed conv.
+    weight: [C_in, C_out/groups, kd, kh, kw] (reference layout)."""
+    st = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    kd, kh, kw = weight.shape[2:]
+    pads = tuple((dl[i] * (k - 1) - pd[i], dl[i] * (k - 1) - pd[i])
+                 for i, k in enumerate((kd, kh, kw)))
+    # transposed conv = lhs-dilated conv with flipped kernel
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    w = jnp.swapaxes(w, 0, 1)                                # [C_out/g, C_in, ...]
+    if groups > 1:
+        cin = x.shape[1] // groups
+        wg = w.reshape(w.shape[0], groups, cin, kd, kh, kw)
+        wg = jnp.moveaxis(wg, 1, 0).reshape(
+            groups * w.shape[0], cin, kd, kh, kw)
+        w = wg
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pads, lhs_dilation=st,
+        rhs_dilation=dl, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, pool_size, pool_stride=1, pool_padding=0):
+    """ref: pool_with_index_op.cc — returns (pooled, flat argmax index into
+    each image's HxW plane), as the reference's unpool consumes."""
+    ks = (pool_size,) * 2 if isinstance(pool_size, int) else tuple(pool_size)
+    st = (pool_stride,) * 2 if isinstance(pool_stride, int) \
+        else tuple(pool_stride)
+    pd = (pool_padding,) * 2 if isinstance(pool_padding, int) \
+        else tuple(pool_padding)
+    N, C, H, W = x.shape
+    idx_plane = jnp.arange(H * W, dtype=jnp.float32).reshape(1, 1, H, W)
+    idx_plane = jnp.broadcast_to(idx_plane, x.shape)
+
+    def select(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    vals, idxs = lax.reduce_window(
+        (x, idx_plane), (-jnp.inf, jnp.float32(-1)),
+        lambda a, b: select(a, b), window, strides, pads)
+    return vals, idxs.astype(jnp.int32)
+
+
+@register_op("unpool")
+def unpool(x, indices, out_hw):
+    """ref: unpool_op.cc — max-unpool: scatter pooled values back to their
+    argmax positions in a zeros [N,C,H,W] output."""
+    N, C, Hp, Wp = x.shape
+    H, W = out_hw
+    flat = jnp.zeros((N, C, H * W), x.dtype)
+    idx = indices.reshape(N, C, -1)
+    vals = x.reshape(N, C, -1)
+    flat = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None],
+                   jnp.clip(idx, 0, H * W - 1)].add(
+        jnp.where(idx >= 0, vals, 0.0))
+    return flat.reshape(N, C, H, W)
+
+
+@register_op("spp")
+def spp(x, pyramid_height=3, pool_type="max"):
+    """ref: spp_op.cc — spatial pyramid pooling: adaptive pools at bin counts
+    1,2,4,...,2^(h-1), flattened and concatenated per image."""
+    from paddle_tpu.ops.nn import adaptive_pool2d
+    N, C = x.shape[:2]
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        p = adaptive_pool2d(x, (bins, bins), pool_type=pool_type)
+        outs.append(p.reshape(N, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("data_norm")
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """ref: data_norm_op.cc — normalize by accumulated batch statistics
+    (CTR models): mean = sum/size, scale = rsqrt(square_sum/size)."""
+    means = batch_sum / batch_size
+    scales = jnp.sqrt(batch_size / jnp.maximum(batch_square_sum, epsilon))
+    return (x - means) * scales, means, scales
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(x):
+    """ref: detection/polygon_box_transform_op.cc — EAST geometry map:
+    even channels: 4*w - v, odd channels: 4*h - v."""
+    N, C, H, W = x.shape
+    ws = jnp.broadcast_to(jnp.arange(W, dtype=x.dtype), (H, W))
+    hs = jnp.broadcast_to(jnp.arange(H, dtype=x.dtype)[:, None], (H, W))
+    even = jnp.arange(C) % 2 == 0
+    coord = jnp.where(even[:, None, None], 4.0 * ws, 4.0 * hs)
+    return coord[None] - x
+
+
+@register_op("deformable_conv")
+def deformable_conv(x, offset, weight, stride=1, padding=0, dilation=1,
+                    deformable_groups=1, groups=1, mask=None):
+    """ref: deformable_conv_op.cc (v1) / deformable_conv_v2 with mask.
+
+    x: [N, C, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo] (y,x interleaved per
+    tap, reference layout); weight: [C_out, C_in/groups, kh, kw];
+    mask (v2): [N, dg*kh*kw, Ho, Wo] modulation in [0,1].
+
+    TPU-first: per-tap bilinear gathers (vectorized) followed by one big
+    [N*Ho*Wo, C*kh*kw] @ [C*kh*kw, C_out] matmul on the MXU — the im2col
+    formulation of deformable conv, not a scalar loop.
+    """
+    N, C, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    st = (stride,) * 2 if isinstance(stride, int) else tuple(stride)
+    pd = (padding,) * 2 if isinstance(padding, int) else tuple(padding)
+    dl = (dilation,) * 2 if isinstance(dilation, int) else tuple(dilation)
+    Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+    Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+    dg = deformable_groups
+
+    base_y = (jnp.arange(Ho) * st[0] - pd[0])[:, None]        # [Ho,1]
+    base_x = (jnp.arange(Wo) * st[1] - pd[1])[None, :]        # [1,Wo]
+    off = offset.reshape(N, dg, kh * kw, 2, Ho, Wo)
+    cols = []
+    cg = C // dg
+    for t in range(kh * kw):
+        ky, kx = divmod(t, kw)
+        oy = off[:, :, t, 0]                                  # [N,dg,Ho,Wo]
+        ox = off[:, :, t, 1]
+        iy = base_y[None, None] + ky * dl[0] + oy
+        ix = base_x[None, None] + kx * dl[1] + ox
+        tap = []
+        for g in range(dg):
+            xs = x[:, g * cg:(g + 1) * cg]                    # [N,cg,H,W]
+            s = _bilinear_sample(xs, ix[:, g], iy[:, g])      # [N,Ho,Wo,cg]
+            if mask is not None:
+                s = s * mask[:, g * kh * kw + t][..., None]
+            tap.append(s)
+        cols.append(jnp.concatenate(tap, axis=-1))            # [N,Ho,Wo,C]
+    col = jnp.stack(cols, axis=3)                             # [N,Ho,Wo,K,C]
+    col = col.reshape(N, Ho, Wo, kh * kw * C)
+    wmat = jnp.transpose(weight, (2, 3, 1, 0))                # [kh,kw,Cin_g,Cout]
+    if groups == 1:
+        wmat = wmat.reshape(kh * kw * C, Cout)
+        out = col @ wmat                                      # [N,Ho,Wo,Cout]
+    else:
+        cing = C // groups
+        coutg = Cout // groups
+        colg = col.reshape(N, Ho, Wo, kh * kw, groups, cing)
+        wg = weight.reshape(groups, coutg, cing, kh, kw)
+        # grouped path: per-group matmul (static small loop)
+        outs = []
+        for g in range(groups):
+            cslice = colg[..., g, :].reshape(N, Ho, Wo, kh * kw * cing)
+            wslice = jnp.transpose(wg[g], (2, 3, 1, 0)).reshape(
+                kh * kw * cing, coutg)
+            outs.append(cslice @ wslice)
+        out = jnp.concatenate(outs, axis=-1)
+    return jnp.transpose(out, (0, 3, 1, 2))                   # [N,Cout,Ho,Wo]
+
+
+@register_op("psroi_pool")
+def psroi_pool(x, rois, roi_batch_ids, output_channels, pooled_height,
+               pooled_width, spatial_scale=1.0):
+    """ref: detection/psroi_pool_op.cc — position-sensitive ROI average
+    pooling (R-FCN): x [N, out_c*ph*pw, H, W], rois [R,4] (x1,y1,x2,y2 in
+    image coords) -> [R, out_c, ph, pw]."""
+    N, C, H, W = x.shape
+    ph, pw = pooled_height, pooled_width
+    oc = output_channels
+    R = rois.shape[0]
+    x1 = jnp.round(rois[:, 0]) * spatial_scale
+    y1 = jnp.round(rois[:, 1]) * spatial_scale
+    x2 = jnp.round(rois[:, 2] + 1.0) * spatial_scale
+    y2 = jnp.round(rois[:, 3] + 1.0) * spatial_scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    xs = jnp.arange(W, dtype=x.dtype)
+    ys = jnp.arange(H, dtype=x.dtype)
+    out = jnp.zeros((R, oc, ph, pw), x.dtype)
+    xr = x[roi_batch_ids]                                     # [R,C,H,W]
+    for i in range(ph):
+        for j in range(pw):
+            hs = jnp.floor(y1 + i * bin_h)
+            he = jnp.ceil(y1 + (i + 1) * bin_h)
+            ws_ = jnp.floor(x1 + j * bin_w)
+            we = jnp.ceil(x1 + (j + 1) * bin_w)
+            hmask = ((ys[None, :] >= hs[:, None]) &
+                     (ys[None, :] < he[:, None]))              # [R,H]
+            wmask = ((xs[None, :] >= ws_[:, None]) &
+                     (xs[None, :] < we[:, None]))              # [R,W]
+            m = (hmask[:, :, None] & wmask[:, None, :]).astype(x.dtype)
+            cnt = jnp.maximum(jnp.sum(m, axis=(1, 2)), 1.0)    # [R]
+            # channel group for bin (i,j) — reference indexes c*ph*pw + i*pw+j
+            chan = jnp.arange(oc) * ph * pw + i * pw + j
+            vals = xr[:, chan]                                 # [R,oc,H,W]
+            s = jnp.sum(vals * m[:, None], axis=(2, 3))        # [R,oc]
+            out = out.at[:, :, i, j].set(s / cnt[:, None])
+    return out
+
+
+@register_op("collect_fpn_proposals")
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n):
+    """ref: detection/collect_fpn_proposals_op.cc — concat per-level
+    proposals and keep the global top-N by score. Lists of [Ni,4]/[Ni]."""
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, idx = lax.top_k(scores, k)
+    return rois[idx], top_s
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logits, labels, fg_num, gamma=2.0, alpha=0.25):
+    """ref: detection/sigmoid_focal_loss_op.cc — RetinaNet focal loss.
+
+    logits [N, C]; labels [N] int in [0, C] where 0 = background (reference
+    convention: class c maps to logit column c-1); normalized by fg_num.
+    """
+    N, C = logits.shape
+    target = (labels[:, None] == jnp.arange(1, C + 1)[None, :])
+    target = target.astype(logits.dtype)
+    p = jax.nn.sigmoid(logits)
+    ce = (target * jax.nn.softplus(-logits) +
+          (1.0 - target) * jax.nn.softplus(logits))
+    p_t = target * p + (1.0 - target) * (1.0 - p)
+    alpha_t = target * alpha + (1.0 - target) * (1.0 - alpha)
+    loss = alpha_t * jnp.power(1.0 - p_t, gamma) * ce
+    return loss / jnp.maximum(fg_num, 1.0)
+
+
+@register_op("retinanet_detection_output")
+def retinanet_detection_output(bboxes_list, scores_list, anchors_list, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3):
+    """ref: detection/retinanet_detection_output_op.cc — decode per-FPN-level
+    regression deltas against anchors, merge levels, per-class NMS.
+
+    bboxes_list: per-level [Ai, 4] deltas; scores_list: per-level [Ai, C]
+    sigmoid scores; anchors_list: per-level [Ai, 4] (x1,y1,x2,y2).
+    Returns [keep_top_k, 6] (label, score, x1..y2) padded with -1 + count.
+    """
+    from paddle_tpu.ops.detection import multiclass_nms
+
+    def decode(anchors, deltas):
+        # elementwise center-size decode (retinanet_detection_output_op.h
+        # DeltaBox), box_normalized=False convention
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(deltas[:, 2]) * aw
+        h = jnp.exp(deltas[:, 3]) * ah
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=1)
+
+    decoded, scs = [], []
+    for deltas, scores, anchors in zip(bboxes_list, scores_list, anchors_list):
+        k = min(nms_top_k, scores.shape[0])
+        best = jnp.max(scores, axis=1)
+        _, idx = lax.top_k(best, k)
+        d = decode(anchors[idx], deltas[idx])
+        h, w = im_info[0], im_info[1]
+        d = jnp.stack([jnp.clip(d[:, 0], 0, w - 1), jnp.clip(d[:, 1], 0, h - 1),
+                       jnp.clip(d[:, 2], 0, w - 1), jnp.clip(d[:, 3], 0, h - 1)],
+                      axis=1)
+        decoded.append(d)
+        scs.append(scores[idx])
+    boxes = jnp.concatenate(decoded, axis=0)                  # [A,4]
+    scores = jnp.concatenate(scs, axis=0)                     # [A,C]
+    return multiclass_nms(boxes, scores.T, score_threshold=score_threshold,
+                          nms_top_k=-1, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, background_label=-1,
+                          box_normalized=False)

@@ -231,3 +231,26 @@ class TestNewSequenceOps:
         np.testing.assert_array_equal(np.asarray(out.row_lengths), [3, 2])
         expect = np.array([[1, 0], [1, 0], [1, 0], [0, 1], [0, 1]], np.float32)
         np.testing.assert_allclose(np.asarray(out.values), expect)
+
+    def test_sequence_conv_under_jit(self):
+        import jax
+        rng = np.random.RandomState(3)
+        rb = RaggedBatch.from_list([rng.randn(3, 2).astype(np.float32),
+                                    rng.randn(2, 2).astype(np.float32)])
+        w = jnp.asarray(rng.randn(6, 4).astype(np.float32))
+        eager = sequence.sequence_conv(rb, w)
+        jitted = jax.jit(lambda r: sequence.sequence_conv(r, w))(rb)
+        np.testing.assert_allclose(np.asarray(jitted.values),
+                                   np.asarray(eager.values), atol=1e-5)
+
+    def test_sequence_erase_rejects_tracer(self):
+        import jax
+        rb = RaggedBatch.from_list([[1, 2], [3, 4]])
+        with pytest.raises(Exception):
+            jax.jit(lambda r: sequence.sequence_erase(r, [2]))(rb)
+
+    def test_erase_then_pool_consistent(self):
+        rb = RaggedBatch.from_list([[1.0, 2.0, 3.0], [4.0, 2.0]])
+        out = sequence.sequence_erase(rb, [2])
+        pooled = np.asarray(sequence.sequence_pool(out, "max"))
+        np.testing.assert_allclose(pooled, [3.0, 4.0])

@@ -1,0 +1,226 @@
+"""Vision op golden tests (OpTest pattern vs numpy references —
+test_affine_grid_op.py, test_grid_sampler_op.py, test_deformable_conv_op.py,
+test_space_to_depth_op.py, test_temporal_shift_op.py, test_pool3d_op.py,
+test_unpool_op.py, test_psroi_pool_op.py patterns)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import vision
+
+
+class TestGrids:
+    def test_affine_grid_identity(self):
+        theta = jnp.asarray([[[1.0, 0, 0], [0, 1.0, 0]]])
+        grid = np.asarray(vision.affine_grid(theta, (1, 1, 3, 5)))
+        assert grid.shape == (1, 3, 5, 2)
+        np.testing.assert_allclose(grid[0, 0, :, 0], np.linspace(-1, 1, 5),
+                                   atol=1e-6)
+        np.testing.assert_allclose(grid[0, :, 0, 1], np.linspace(-1, 1, 3),
+                                   atol=1e-6)
+
+    def test_grid_sampler_identity(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 4, 5).astype(np.float32)
+        theta = jnp.broadcast_to(
+            jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]]), (2, 2, 3))
+        grid = vision.affine_grid(theta, x.shape)
+        out = np.asarray(vision.grid_sampler(jnp.asarray(x), grid))
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+    def test_grid_sampler_shift_zero_pad(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        # grid entirely out of bounds -> zeros
+        grid = jnp.full((1, 2, 2, 2), 5.0)
+        out = np.asarray(vision.grid_sampler(jnp.asarray(x), grid))
+        np.testing.assert_allclose(out, 0.0)
+
+
+class TestLayoutOps:
+    def test_space_to_depth(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = np.asarray(vision.space_to_depth(jnp.asarray(x), 2))
+        assert out.shape == (1, 4, 2, 2)
+        # top-left output position gathers the 2x2 block corners
+        np.testing.assert_allclose(sorted(out[0, :, 0, 0]), [0, 1, 4, 5])
+
+    def test_space_to_depth_roundtrip_shape(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 4, 6).astype(np.float32)
+        out = vision.space_to_depth(jnp.asarray(x), 2)
+        assert out.shape == (2, 12, 2, 3)
+
+    def test_shuffle_channel(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+        out = np.asarray(vision.shuffle_channel(jnp.asarray(x), 2))
+        np.testing.assert_allclose(out.reshape(-1), [0, 4, 1, 5, 2, 6, 3, 7])
+
+    def test_temporal_shift(self):
+        # N=1, T=3, C=4, ratio .25 -> c1=1 backward-shift, c2=2 forward
+        x = np.arange(12, dtype=np.float32).reshape(3, 4, 1, 1)
+        out = np.asarray(vision.temporal_shift(jnp.asarray(x), 3, 0.25))
+        # channel 0 at t: value from t-1 (0 at t=0)
+        np.testing.assert_allclose(out[0, 0], 0.0)
+        np.testing.assert_allclose(out[1, 0], x[0, 0])
+        # channel 1 at t: value from t+1 (0 at t=T-1)
+        np.testing.assert_allclose(out[0, 1], x[1, 1])
+        np.testing.assert_allclose(out[2, 1], 0.0)
+        # channels 2,3 unshifted
+        np.testing.assert_allclose(out[:, 2:], x[:, 2:])
+
+    def test_polygon_box_transform(self):
+        x = np.zeros((1, 2, 2, 3), np.float32)
+        out = np.asarray(vision.polygon_box_transform(jnp.asarray(x)))
+        np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])   # 4*w
+        np.testing.assert_allclose(out[0, 1, :, 0], [0, 4])   # 4*h
+
+
+class Test3D:
+    def test_pool3d_max(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        out = np.asarray(vision.pool3d(jnp.asarray(x), 2, "max", 2))
+        np.testing.assert_allclose(out.reshape(-1), [7.0])
+
+    def test_pool3d_avg(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        out = np.asarray(vision.pool3d(jnp.asarray(x), 2, "avg", 2))
+        np.testing.assert_allclose(out.reshape(-1), [3.5])
+
+    def test_conv3d_transpose_vs_torch_semantics(self):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 4, 4, 4).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3, 3).astype(np.float32)
+        out = np.asarray(vision.conv3d_transpose(
+            jnp.asarray(x), jnp.asarray(w), stride=2, padding=1))
+        ref = F.conv_transpose3d(torch.from_numpy(x), torch.from_numpy(w),
+                                 stride=2, padding=1).numpy()
+        np.testing.assert_allclose(out, ref, atol=2e-4)
+
+    def test_unpool_roundtrip(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        pooled, idx = vision.max_pool2d_with_index(jnp.asarray(x), 2, 2)
+        assert pooled.shape == (2, 3, 2, 2)
+        up = np.asarray(vision.unpool(pooled, idx, (4, 4)))
+        # every pooled max value lands back at its argmax position
+        pn = np.asarray(pooled)
+        for n in range(2):
+            for c in range(3):
+                nz = up[n, c][up[n, c] != 0]
+                np.testing.assert_allclose(sorted(nz),
+                                           sorted(pn[n, c].reshape(-1)),
+                                           atol=1e-6)
+
+    def test_spp_shape(self):
+        x = jnp.ones((2, 3, 8, 8))
+        out = vision.spp(x, pyramid_height=3)
+        assert out.shape == (2, 3 * (1 + 4 + 16))
+
+
+class TestDeformable:
+    def test_zero_offset_matches_conv(self):
+        from paddle_tpu.ops.nn import conv2d
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        w = rng.randn(3, 4, 3, 3).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        out = np.asarray(vision.deformable_conv(
+            jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), padding=1))
+        ref = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), padding=1))
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_mask_scales(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        mask_half = np.full((1, 9, 4, 4), 0.5, np.float32)
+        out1 = np.asarray(vision.deformable_conv(
+            jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), padding=1))
+        out2 = np.asarray(vision.deformable_conv(
+            jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), padding=1,
+            mask=jnp.asarray(mask_half)))
+        np.testing.assert_allclose(out2, out1 * 0.5, atol=1e-4)
+
+    def test_grouped(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3).astype(np.float32)     # groups=2
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        out = np.asarray(vision.deformable_conv(
+            jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), padding=1,
+            groups=2))
+        from paddle_tpu.ops.nn import conv2d
+        ref = np.asarray(conv2d(jnp.asarray(x), jnp.asarray(w), padding=1,
+                                groups=2))
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+class TestDetectionExtras:
+    def test_psroi_pool_uniform(self):
+        # constant input per channel-group -> each output bin = that constant
+        oc, ph, pw = 2, 2, 2
+        C = oc * ph * pw
+        x = np.zeros((1, C, 8, 8), np.float32)
+        for c in range(C):
+            x[0, c] = c
+        rois = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
+        out = np.asarray(vision.psroi_pool(
+            jnp.asarray(x), rois, jnp.asarray([0]), oc, ph, pw))
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    np.testing.assert_allclose(out[0, c, i, j],
+                                               c * ph * pw + i * pw + j)
+
+    def test_collect_fpn_proposals(self):
+        r1 = jnp.asarray([[0.0, 0, 1, 1], [1, 1, 2, 2]])
+        r2 = jnp.asarray([[3.0, 3, 4, 4]])
+        s1 = jnp.asarray([0.9, 0.1])
+        s2 = jnp.asarray([0.5])
+        rois, scores = vision.collect_fpn_proposals([r1, r2], [s1, s2], 2)
+        np.testing.assert_allclose(np.asarray(scores), [0.9, 0.5])
+        np.testing.assert_allclose(np.asarray(rois)[1], [3, 3, 4, 4])
+
+    def test_sigmoid_focal_loss_reduces_easy(self):
+        logits = jnp.asarray([[5.0, -5.0]])
+        labels = jnp.asarray([1])        # class 1 -> column 0
+        loss = np.asarray(vision.sigmoid_focal_loss(logits, labels, 1.0))
+        # well-classified -> tiny loss everywhere
+        assert np.all(loss < 1e-2)
+        hard = np.asarray(vision.sigmoid_focal_loss(
+            -logits, labels, 1.0))
+        assert np.all(hard > loss)
+
+    def test_sigmoid_focal_loss_grad_finite(self):
+        g = jax.grad(lambda l: jnp.sum(vision.sigmoid_focal_loss(
+            l, jnp.asarray([1, 0]), 2.0)))(jnp.zeros((2, 3)))
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_retinanet_detection_output_shapes(self):
+        rng = np.random.RandomState(8)
+        anchors = jnp.asarray(
+            [[0.0, 0, 10, 10], [5, 5, 20, 20], [8, 8, 30, 30]])
+        deltas = jnp.asarray(rng.randn(3, 4).astype(np.float32) * 0.1)
+        scores = jax.nn.sigmoid(jnp.asarray(
+            rng.randn(3, 2).astype(np.float32)))
+        out, count = vision.retinanet_detection_output(
+            [deltas], [scores], [anchors], jnp.asarray([50.0, 50.0, 1.0]),
+            keep_top_k=5)
+        assert out.shape == (5, 6)
+        assert int(count) >= 1
+
+
+class TestDataNorm:
+    def test_normalizes(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(100, 4).astype(np.float32) * 3 + 1
+        bsize = jnp.full((4,), 100.0)
+        bsum = jnp.asarray(x.sum(0))
+        bsq = jnp.asarray((x ** 2).sum(0) - x.sum(0) ** 2 / 100)
+        out, means, scales = vision.data_norm(jnp.asarray(x), bsize, bsum, bsq)
+        np.testing.assert_allclose(np.asarray(out).mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=2e-2)

@@ -44,3 +44,15 @@ class ConvNet(nn.Module):
         x = F.pool2d(self.conv1(x), 2, "max", 2)
         x = F.pool2d(self.conv2(x), 2, "max", 2)
         return self.fc(x.reshape(x.shape[0], -1))
+
+
+class LinearRegression(nn.Module):
+    """The fit_a_line book model (ref: tests/book/test_fit_a_line.py):
+    single fc, square-error cost."""
+
+    def __init__(self, in_features=13):
+        super().__init__()
+        self.fc = nn.Linear(in_features, 1)
+
+    def forward(self, x):
+        return self.fc(x)[..., 0]

@@ -1,0 +1,77 @@
+"""Recommender system — the recommender_system book model (MovieLens-style).
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_recommender_system.py:
+user tower (user id + gender + age + job embeddings -> fc) and movie tower
+(movie id embedding + category/title sequence pooling -> fc), combined by
+cosine similarity, trained with square error against the rating.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+
+
+@dataclasses.dataclass
+class RecConfig:
+    num_users: int = 256
+    num_genders: int = 2
+    num_ages: int = 8
+    num_jobs: int = 32
+    num_movies: int = 512
+    num_categories: int = 32
+    title_vocab: int = 1024
+    embed_dim: int = 32
+    fc_dim: int = 64
+
+    @staticmethod
+    def tiny():
+        return RecConfig(num_users=16, num_movies=32, num_categories=8,
+                         title_vocab=64, embed_dim=8, fc_dim=16)
+
+
+class RecommenderNet(nn.Module):
+    """Twin-tower rating regressor: scaled cosine(usr, movie) * 5."""
+
+    def __init__(self, cfg: RecConfig):
+        super().__init__()
+        self.cfg = cfg
+        E, F = cfg.embed_dim, cfg.fc_dim
+        self.usr_emb = nn.Embedding(cfg.num_users, E)
+        self.gender_emb = nn.Embedding(cfg.num_genders, E // 2)
+        self.age_emb = nn.Embedding(cfg.num_ages, E // 2)
+        self.job_emb = nn.Embedding(cfg.num_jobs, E // 2)
+        self.usr_fc = nn.Linear(E + 3 * (E // 2), F, act="tanh")
+        self.mov_emb = nn.Embedding(cfg.num_movies, E)
+        self.cat_emb = nn.Embedding(cfg.num_categories, E // 2)
+        self.title_emb = nn.Embedding(cfg.title_vocab, E)
+        self.mov_fc = nn.Linear(E + E // 2 + E, F, act="tanh")
+
+    def forward(self, usr_id, gender, age, job, mov_id, categories,
+                cat_mask, title_ids, title_mask):
+        """categories/title_ids: [B, L] padded multi-hot sequences with
+        0/1 masks (the reference pools LoD sequences; here masked mean/sum)."""
+        u = jnp.concatenate([
+            self.usr_emb(usr_id), self.gender_emb(gender),
+            self.age_emb(age), self.job_emb(job)], axis=-1)
+        u = self.usr_fc(u)
+
+        cat = jnp.sum(self.cat_emb(categories) * cat_mask[..., None], 1) / \
+            jnp.maximum(jnp.sum(cat_mask, 1, keepdims=True), 1.0)
+        title = jnp.max(
+            self.title_emb(title_ids) * title_mask[..., None] +
+            (title_mask[..., None] - 1.0) * 1e9, axis=1)   # masked max pool
+        # rows with an empty title sequence fall back to zeros instead of -1e9
+        has_title = jnp.sum(title_mask, 1, keepdims=True) > 0
+        title = jnp.where(has_title, title, 0.0)
+        m = jnp.concatenate([self.mov_emb(mov_id), cat, title], axis=-1)
+        m = self.mov_fc(m)
+
+        cos = jnp.sum(u * m, -1) / jnp.maximum(
+            jnp.linalg.norm(u, axis=-1) * jnp.linalg.norm(m, axis=-1), 1e-8)
+        return 5.0 * cos                                    # rating scale
+
+
+def rating_loss(pred, rating):
+    return jnp.mean((pred - rating) ** 2)

@@ -1,0 +1,155 @@
+"""Attention seq2seq NMT — the machine_translation book model.
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_machine_translation.py
+(encoder-decoder GRU with attention + beam-search decode built from
+DynamicRNN / layers.attention primitives) and unittests/dist_transformer.py
+for the bigger NMT config.
+
+TPU-first: teacher-forced training forward is one batched scan (no
+DynamicRNN graph surgery); decoding reuses ops.rnn.beam_search_decode's
+static-shape beam search. Decode entry points run via
+`model.apply(variables, ..., method="greedy_decode")`.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import rnn as R
+
+
+@dataclasses.dataclass
+class Seq2SeqConfig:
+    src_vocab: int = 1024
+    tgt_vocab: int = 1024
+    embed_dim: int = 64
+    hidden: int = 128
+    bidirectional_encoder: bool = True
+    dropout: float = 0.0
+
+    @staticmethod
+    def tiny():
+        return Seq2SeqConfig(src_vocab=64, tgt_vocab=64, embed_dim=16,
+                             hidden=32)
+
+
+class AttentionSeq2Seq(nn.Module):
+    """GRU encoder-decoder with additive (Bahdanau) attention."""
+
+    def __init__(self, cfg: Seq2SeqConfig):
+        super().__init__()
+        self.cfg = cfg
+        H = cfg.hidden
+        self.src_embed = nn.Embedding(cfg.src_vocab, cfg.embed_dim)
+        self.tgt_embed = nn.Embedding(cfg.tgt_vocab, cfg.embed_dim)
+        self.encoder = nn.GRU(cfg.embed_dim, H,
+                              bidirectional=cfg.bidirectional_encoder)
+        enc_out = H * (2 if cfg.bidirectional_encoder else 1)
+        self.enc_proj = nn.Linear(enc_out, H)
+        # decoder GRU cell params (manual cell: attention feeds each step)
+        self.param("dec_w_ih", (cfg.embed_dim + enc_out, 3 * H))
+        self.param("dec_w_hh", (H, 3 * H))
+        self.param("dec_b", (3 * H,), I.zeros())
+        # additive attention
+        self.param("att_q", (H, H))
+        self.param("att_k", (enc_out, H))
+        self.param("att_v", (H, 1))
+        self.out_proj = nn.Linear(H, cfg.tgt_vocab)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def encode(self, src_ids, src_lengths):
+        """Returns (enc_out [B,S,E], att_keys [B,S,H], mask [B,S], h0 [B,H])."""
+        emb = self.src_embed(src_ids)
+        enc_out, _ = self.encoder(emb, lengths=src_lengths)
+        S = src_ids.shape[1]
+        mask = jnp.arange(S)[None, :] < src_lengths[:, None]
+        h0 = jnp.tanh(self.enc_proj(
+            jnp.sum(enc_out * mask[..., None], 1) /
+            jnp.maximum(src_lengths[:, None], 1)))
+        att_keys = enc_out @ self.p("att_k")   # hoisted: loop-invariant
+        return enc_out, att_keys, mask, h0
+
+    def _attend(self, h, enc_out, att_keys, enc_mask):
+        """h [B,H] -> context [B,E], weights [B,S]."""
+        q = h @ self.p("att_q")                            # [B,H]
+        e = (jnp.tanh(q[:, None, :] + att_keys) @ self.p("att_v"))[..., 0]
+        e = jnp.where(enc_mask, e, -1e9)
+        w = jax.nn.softmax(e, axis=-1)
+        ctx = jnp.einsum("bs,bse->be", w, enc_out)
+        return ctx, w
+
+    def _dec_step(self, h, y_emb, enc_out, att_keys, enc_mask):
+        ctx, _ = self._attend(h, enc_out, att_keys, enc_mask)
+        x = jnp.concatenate([y_emb, ctx], axis=-1)
+        return R.gru_cell(x, h, self.p("dec_w_ih"), self.p("dec_w_hh"),
+                          self.p("dec_b"))
+
+    def forward(self, src_ids, src_lengths, tgt_ids):
+        """Teacher-forced training: tgt_ids [B,T] (BOS-prefixed); returns
+        logits [B,T,V] predicting tgt_ids shifted left."""
+        enc_out, att_keys, mask, h0 = self.encode(src_ids, src_lengths)
+        y = self.dropout(self.tgt_embed(tgt_ids))          # [B,T,E]
+
+        def step(h, y_t):
+            h = self._dec_step(h, y_t, enc_out, att_keys, mask)
+            return h, h
+
+        _, hs = lax.scan(step, h0, jnp.moveaxis(y, 1, 0))
+        hs = jnp.moveaxis(hs, 0, 1)                        # [B,T,H]
+        return self.out_proj(hs)
+
+    def greedy_decode(self, src_ids, src_lengths, bos_id, eos_id, max_len):
+        """Greedy decode -> [B, max_len] token ids. Run via
+        apply(variables, ..., method="greedy_decode")."""
+        enc_out, att_keys, mask, h0 = self.encode(src_ids, src_lengths)
+
+        def step(carry, _):
+            h, tok, done = carry
+            y = self.tgt_embed(tok)
+            h = self._dec_step(h, y, enc_out, att_keys, mask)
+            logits = self.out_proj(h)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+            return (h, nxt, done), nxt
+
+        B = src_ids.shape[0]
+        tok0 = jnp.full((B,), bos_id, jnp.int32)
+        done0 = jnp.zeros((B,), bool)
+        _, toks = lax.scan(step, (h0, tok0, done0), None, length=max_len)
+        return jnp.moveaxis(toks, 0, 1)
+
+    def beam_decode(self, src_ids, src_lengths, bos_id, eos_id, beam_size,
+                    max_len):
+        """Beam-search decode (ref: beam_search_op path in the book model).
+        Returns (sequences [B, K, max_len], scores [B, K]). Run via
+        apply(variables, ..., method="beam_decode")."""
+        B = src_ids.shape[0]
+        V = self.cfg.tgt_vocab
+        K = beam_size
+        enc_out, att_keys, mask, h0 = self.encode(src_ids, src_lengths)
+        enc_k = jnp.repeat(enc_out, K, axis=0)
+        keys_k = jnp.repeat(att_keys, K, axis=0)
+        mask_k = jnp.repeat(mask, K, axis=0)
+        h_k = jnp.repeat(h0, K, axis=0)
+
+        def log_probs_fn(tokens, h):
+            y = self.tgt_embed(tokens)
+            h = self._dec_step(h, y, enc_k, keys_k, mask_k)
+            return jax.nn.log_softmax(self.out_proj(h), -1), h
+
+        return R.beam_search_decode(log_probs_fn, h_k, bos_id, eos_id,
+                                    beam_size, max_len, B, V)
+
+
+def nmt_loss(logits, labels, lengths):
+    """Masked cross-entropy; labels [B,T] are the gold next tokens."""
+    T = labels.shape[1]
+    mask = (jnp.arange(T)[None, :] < lengths[:, None]).astype(logits.dtype)
+    logp = jax.nn.log_softmax(logits, -1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], -1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

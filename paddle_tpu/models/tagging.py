@@ -1,0 +1,71 @@
+"""BiLSTM-CRF sequence tagger — the label_semantic_roles book model.
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_label_semantic_roles.py
+(word+predicate+context embeddings -> stacked bidirectional LSTM chain ->
+linear_chain_crf cost, crf_decoding inference) and layers/nn.py lstm/embedding.
+
+TPU-first: padded [B,T] batches + lengths (no LoD); CRF loss/decode are the
+lax.scan ops in ops/crf.py.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import crf as C
+
+
+@dataclasses.dataclass
+class TaggerConfig:
+    vocab_size: int = 4096
+    num_tags: int = 16
+    embed_dim: int = 32
+    hidden: int = 64
+    num_lstm_layers: int = 2      # ref uses depth 8 stacked bi-LSTM
+    num_extra_features: int = 0   # e.g. predicate/context marks (SRL)
+    dropout: float = 0.0
+
+    @staticmethod
+    def tiny():
+        return TaggerConfig(vocab_size=64, num_tags=5, embed_dim=8, hidden=16,
+                            num_lstm_layers=1)
+
+
+class BiLstmCrfTagger(nn.Module):
+    def __init__(self, cfg: TaggerConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.embed_dim)
+        if cfg.num_extra_features:
+            self.extra_embeds = [
+                nn.Embedding(cfg.vocab_size, cfg.embed_dim)
+                for _ in range(cfg.num_extra_features)]
+        in_dim = cfg.embed_dim * (1 + cfg.num_extra_features)
+        self.lstm = nn.LSTM(in_dim, cfg.hidden,
+                            num_layers=cfg.num_lstm_layers, bidirectional=True)
+        self.emission = nn.Linear(cfg.hidden * 2, cfg.num_tags)
+        # CRF transition params, reference layout [K+2, K]
+        self.param("transition", (cfg.num_tags + 2, cfg.num_tags),
+                   I.uniform(-0.1, 0.1))
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def emissions(self, token_ids, lengths, extra_ids=None):
+        emb = self.embed(token_ids)
+        if self.cfg.num_extra_features:
+            feats = [emb] + [e(extra_ids[..., i])
+                             for i, e in enumerate(self.extra_embeds)]
+            emb = jnp.concatenate(feats, axis=-1)
+        emb = self.dropout(emb)
+        out, _ = self.lstm(emb, lengths=lengths)
+        return self.emission(out)                          # [B,T,K]
+
+    def forward(self, token_ids, lengths, labels=None, extra_ids=None):
+        """With labels: mean CRF negative log-likelihood (training cost).
+        Without: Viterbi-decoded tag paths [B,T]."""
+        em = self.emissions(token_ids, lengths, extra_ids)
+        if labels is not None:
+            nll = C.linear_chain_crf(em, self.p("transition"), labels, lengths)
+            return jnp.mean(nll)
+        return C.crf_decoding(em, self.p("transition"), lengths)

@@ -1,0 +1,126 @@
+"""VGG + SE-ResNeXt image classifiers.
+
+Ref: /root/reference/python/paddle/fluid/tests/book/test_image_classification.py
+(vgg16_bn_drop for CIFAR) and unittests/dist_se_resnext.py /
+test_parallel_executor_seresnext.py (SE-ResNeXt-50: grouped 3x3 bottleneck +
+squeeze-and-excitation gate) — the reference's multi-device regression models.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.models.resnet import ConvBN
+from paddle_tpu.ops import nn as F
+
+
+class VGG(nn.Module):
+    """Configurable VGG with BN (the book's vgg16_bn_drop shape)."""
+
+    CFGS = {
+        11: (1, 1, 2, 2, 2),
+        13: (2, 2, 2, 2, 2),
+        16: (2, 2, 3, 3, 3),
+        19: (2, 2, 4, 4, 4),
+    }
+
+    def __init__(self, depth=16, num_classes=10, in_channels=3, dropout=0.5):
+        super().__init__()
+        widths = (64, 128, 256, 512, 512)
+        blocks = []
+        cin = in_channels
+        for reps, w in zip(self.CFGS[depth], widths):
+            for _ in range(reps):
+                blocks.append(ConvBN(cin, w, 3))
+                cin = w
+        self.blocks = blocks
+        self.stage_reps = self.CFGS[depth]
+        self.drop = nn.Dropout(dropout)
+        self.fc1 = nn.Linear(512, 512, act="relu")
+        self.fc2 = nn.Linear(512, 512, act="relu")
+        self.head = nn.Linear(512, num_classes)
+
+    def forward(self, x):
+        i = 0
+        for reps in self.stage_reps:
+            for _ in range(reps):
+                x = self.blocks[i](x)
+                i += 1
+            x = F.pool2d(x, 2, pool_type="max", stride=2)
+        x = jnp.mean(x, axis=(2, 3))          # global pool to [B, 512]
+        x = self.drop(self.fc1(x))
+        x = self.drop(self.fc2(x))
+        return self.head(x)
+
+
+def vgg16(num_classes=10, **kw):
+    return VGG(16, num_classes, **kw)
+
+
+class SEBlock(nn.Module):
+    """Squeeze-and-excitation channel gate (dist_se_resnext.py
+    squeeze_excitation)."""
+
+    def __init__(self, channels, reduction=16):
+        super().__init__()
+        mid = max(channels // reduction, 4)
+        self.fc1 = nn.Linear(channels, mid, act="relu")
+        self.fc2 = nn.Linear(mid, channels, act="sigmoid")
+
+    def forward(self, x):
+        s = jnp.mean(x, axis=(2, 3))          # [B,C]
+        s = self.fc2(self.fc1(s))
+        return x * s[:, :, None, None]
+
+
+class SEBottleneck(nn.Module):
+    expansion = 2
+
+    def __init__(self, cin, width, cardinality=32, stride=1, reduction=16):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = ConvBN(cin, width, 1)
+        self.conv2 = ConvBN(width, width, 3, stride, groups=cardinality)
+        self.conv3 = ConvBN(width, cout, 1, act=None)
+        self.se = SEBlock(cout, reduction)
+        self.short = None
+        if stride != 1 or cin != cout:
+            self.short = ConvBN(cin, cout, 1, stride, act=None)
+
+    def forward(self, x):
+        out = self.se(self.conv3(self.conv2(self.conv1(x))))
+        sc = self.short(x) if self.short is not None else x
+        return jnp.maximum(out + sc, 0)
+
+
+class SEResNeXt(nn.Module):
+    """SE-ResNeXt-50 (32x4d family), the reference's parallel-executor
+    regression model."""
+
+    def __init__(self, layers=(3, 4, 6, 3), cardinality=32, num_classes=1000,
+                 in_channels=3):
+        super().__init__()
+        self.stem = ConvBN(in_channels, 64, 7, stride=2)
+        widths = (128, 256, 512, 1024)
+        blocks = []
+        cin = 64
+        for si, (reps, w) in enumerate(zip(layers, widths)):
+            for bi in range(reps):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blocks.append(SEBottleneck(cin, w, cardinality, stride))
+                cin = w * SEBottleneck.expansion
+        self.blocks = blocks
+        self.head = nn.Linear(cin, num_classes,
+                              weight_init=I.uniform(-0.001, 0.001))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = F.pool2d(x, 3, pool_type="max", stride=2, padding=1)
+        for b in self.blocks:
+            x = b(x)
+        x = jnp.mean(x, axis=(2, 3))
+        return self.head(x)
+
+
+def se_resnext50(num_classes=1000, **kw):
+    return SEResNeXt((3, 4, 6, 3), num_classes=num_classes, **kw)

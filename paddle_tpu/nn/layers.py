@@ -359,19 +359,25 @@ class LSTM(Module):
 
 
 class GRU(Module):
-    """ref: dygraph/nn.py GRUUnit generalized to multi-step."""
+    """ref: dygraph/nn.py GRUUnit generalized to multi-step (+bidirectional
+    like the reference's stacked fwd/bwd gru pattern in book models)."""
 
     def __init__(self, input_size, hidden_size, num_layers=1,
-                 dtype=jnp.float32):
+                 bidirectional=False, dtype=jnp.float32):
         super().__init__()
         self.hidden_size, self.num_layers = hidden_size, num_layers
+        self.bidirectional = bidirectional
+        ndir = 2 if bidirectional else 1
         for layer in range(num_layers):
-            isz = input_size if layer == 0 else hidden_size
-            self.param(f"w_ih_l{layer}", (isz, 3 * hidden_size), I.xavier(), dtype)
-            self.param(f"w_hh_l{layer}", (hidden_size, 3 * hidden_size),
-                       I.xavier(), dtype)
-            self.param(f"b_ih_l{layer}", (3 * hidden_size,), I.zeros(), dtype)
-            self.param(f"b_hh_l{layer}", (3 * hidden_size,), I.zeros(), dtype)
+            isz = input_size if layer == 0 else hidden_size * ndir
+            for d in range(ndir):
+                sfx = f"l{layer}d{d}"
+                self.param(f"w_ih_{sfx}", (isz, 3 * hidden_size), I.xavier(),
+                           dtype)
+                self.param(f"w_hh_{sfx}", (hidden_size, 3 * hidden_size),
+                           I.xavier(), dtype)
+                self.param(f"b_ih_{sfx}", (3 * hidden_size,), I.zeros(), dtype)
+                self.param(f"b_hh_{sfx}", (3 * hidden_size,), I.zeros(), dtype)
 
     def forward(self, x, lengths=None):
         b = x.shape[0]
@@ -379,10 +385,23 @@ class GRU(Module):
         out = x
         last = []
         for layer in range(self.num_layers):
-            out, h = R.gru(out, h0, self.p(f"w_ih_l{layer}"),
-                           self.p(f"w_hh_l{layer}"), self.p(f"b_ih_l{layer}"),
-                           self.p(f"b_hh_l{layer}"), lengths=lengths)
-            last.append(h)
+            if self.bidirectional:
+                sf, sb = f"l{layer}d0", f"l{layer}d1"
+                of, hf = R.gru(out, h0, self.p(f"w_ih_{sf}"),
+                               self.p(f"w_hh_{sf}"), self.p(f"b_ih_{sf}"),
+                               self.p(f"b_hh_{sf}"), lengths=lengths)
+                ob, hb = R.gru(out, h0, self.p(f"w_ih_{sb}"),
+                               self.p(f"w_hh_{sb}"), self.p(f"b_ih_{sb}"),
+                               self.p(f"b_hh_{sb}"), lengths=lengths,
+                               reverse=True)
+                out = jnp.concatenate([of, ob], -1)
+                last += [hf, hb]
+            else:
+                s = f"l{layer}d0"
+                out, h = R.gru(out, h0, self.p(f"w_ih_{s}"),
+                               self.p(f"w_hh_{s}"), self.p(f"b_ih_{s}"),
+                               self.p(f"b_hh_{s}"), lengths=lengths)
+                last.append(h)
         return out, jnp.stack(last)
 
 

@@ -97,9 +97,13 @@ class Module:
 
     # --- application ---
     def apply(self, variables, *args, training=False, rngs=None,
-              calibrating=False, **kwargs):
+              calibrating=False, method=None, **kwargs):
         """Run forward purely. Returns output, or (output, new_state) when the
         module carries mutable state and training=True.
+
+        method: alternate entry point — a method name (str) or bound method
+        of this module to run instead of forward (e.g. a model's
+        greedy_decode); it executes with params bound exactly like forward.
 
         calibrating=True is the PTQ stat-collection mode: layers behave as in
         eval (Dropout off, BatchNorm uses running stats) but quantizer scale
@@ -111,8 +115,10 @@ class Module:
                 "eval-behavior pass that only updates quantizer statistics)")
         ctx = Context(training=training, rngs=rngs or {},
                       calibrating=calibrating)
+        fn = (self.forward if method is None
+              else getattr(self, method) if isinstance(method, str) else method)
         with _bind(self, variables, ctx):
-            out = self.forward(*args, **kwargs)
+            out = fn(*args, **kwargs)
         if calibrating or (ctx.state_updates and training):
             new_state = _merge_state(variables.get("state", {}),
                                      ctx.state_updates)

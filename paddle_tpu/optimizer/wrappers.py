@@ -117,11 +117,10 @@ class RecomputeOptimizer:
         return jax.checkpoint(fn)
 
     def minimize(self, loss_fn, params, state, *args, **kwargs):
-        ck = self.wrap(loss_fn)
-        (loss, aux), grads = jax.value_and_grad(ck, has_aux=True)(
-            params, *args, **kwargs)
-        params, state = self.apply_gradients(params, grads, state)
-        return loss, params, state, aux
+        # delegate to inner.minimize so grad-computation wrappers compose:
+        # Recompute(amp(...)) checkpoints the loss the amp path differentiates
+        return self.inner.minimize(self.wrap(loss_fn), params, state,
+                                   *args, **kwargs)
 
 
 class DGCMomentum(Momentum):

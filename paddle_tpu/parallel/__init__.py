@@ -16,13 +16,21 @@ Ref map (reference → here):
 from paddle_tpu.parallel import (
     api,
     collective,
+    communicator,
     dgc,
     embedding,
+    fleet as fleet_mod,
+    heartbeat,
     launch,
     mesh,
     pipeline,
     ring_attention,
 )
+from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet
+from paddle_tpu.parallel.communicator import (GeoSGD, GradientMerge, LocalSGD,
+                                              stack_replicas, unstack_replica)
+from paddle_tpu.parallel.heartbeat import (FileHeartbeat, HeartBeatMonitor,
+                                           barrier_with_timeout)
 from paddle_tpu.parallel.mesh import (
     DP, EP, FSDP, PP, SP, TP,
     data_parallel_mesh,

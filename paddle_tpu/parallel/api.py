@@ -83,8 +83,14 @@ class DataParallel:
         self._step = _step
 
     def init(self, params):
-        params = replicate(self.mesh, params)
-        return params, replicate(self.mesh, self.optimizer.init(params))
+        # jnp.copy first: the train step donates its inputs, and device_put
+        # can zero-copy alias its source (even with may_alias=False on CPU),
+        # so donation would free the caller's original arrays
+        put = lambda x: jax.device_put(  # noqa: E731
+            jnp.copy(x), NamedSharding(self.mesh, P()))
+        params = jax.tree_util.tree_map(put, params)
+        return params, jax.tree_util.tree_map(
+            put, self.optimizer.init(params))
 
     def step(self, params, opt_state, batch):
         batch = shard_batch(self.mesh, batch)

@@ -1,0 +1,164 @@
+"""Gradient-communication schedules: gradient merge, Local SGD, Geo-SGD.
+
+Ref: /root/reference/paddle/fluid/operators/distributed/communicator.h:276
+(AsyncCommunicator — background threads merging grads before send) and :323
+(GeoSgdCommunicator — train locally, periodically sync parameter deltas);
+transpiler/collective.py:269 (LocalSGD — averaged params every k steps).
+
+TPU-first: there are no background send threads — the schedules become
+*functional wrappers* compiled into the train step:
+
+- `GradientMerge` accumulates k micro-grads before one optimizer apply
+  (the async communicator's merge, made deterministic).
+- Local SGD / Geo-SGD need *divergent* per-group replicas, which GSPMD's
+  replicated params can't express; they run under `shard_map` with params
+  stacked over the dp axis (each group owns a copy) and sync by `pmean`
+  every k steps — the delta ride over ICI replaces the pserver delta RPC.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class GradientMerge:
+    """Accumulate `merge_steps` gradients, then apply their mean once.
+
+    Wraps any paddle_tpu Optimizer; state layout:
+      {"inner": opt_state, "acc": grads-like, "count": i32}
+    Equivalent to `merge_steps`-times larger batch (ref: communicator
+    merged-send; also fluid's GradientMergeOptimizer in later versions).
+    """
+
+    def __init__(self, optimizer, merge_steps):
+        assert merge_steps >= 1
+        self.inner = optimizer
+        self.merge_steps = merge_steps
+
+    def init(self, params):
+        return {
+            "inner": self.inner.init(params),
+            "acc": _tmap(jnp.zeros_like, params),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def apply_gradients(self, params, grads, state):
+        acc = _tmap(lambda a, g: a + g, state["acc"], grads)
+        count = state["count"] + 1
+        do_apply = count >= self.merge_steps
+
+        def apply_branch(operand):
+            params, acc, inner = operand
+            mean = _tmap(lambda a: a / self.merge_steps, acc)
+            p2, s2 = self.inner.apply_gradients(params, mean, inner)
+            return p2, s2, _tmap(jnp.zeros_like, acc), jnp.zeros((), jnp.int32)
+
+        def skip_branch(operand):
+            params, acc, inner = operand
+            return params, inner, acc, count
+
+        params, inner, acc, count = lax.cond(
+            do_apply, apply_branch, skip_branch,
+            (params, acc, state["inner"]))
+        return params, {"inner": inner, "acc": acc, "count": count}
+
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, *args, **kwargs)
+        params, state = self.apply_gradients(params, grads, state)
+        return loss, params, state, aux
+
+
+def stack_replicas(params, n):
+    """Stack n copies of params along a new leading axis (to be sharded over
+    the dp/ep axis inside shard_map for divergent-replica schedules)."""
+    return _tmap(lambda p: jnp.broadcast_to(p[None], (n,) + p.shape), params)
+
+
+def unstack_replica(params, i=0):
+    return _tmap(lambda p: p[i], params)
+
+
+class LocalSGD:
+    """Local SGD: k local optimizer steps per group, then param averaging.
+
+    Ref: transpiler/collective.py:269 (LocalSGD transpiler inserts periodic
+    broadcast-averaged params instead of per-step allreduce).
+
+    Use inside shard_map with params carrying a leading sharded dp axis of
+    size 1 per shard (see tests / fleet.localized_train_step): `step()` is the
+    per-group local update; `sync()` is the periodic pmean.
+    """
+
+    def __init__(self, optimizer, sync_steps, axis_name="dp"):
+        self.inner = optimizer
+        self.sync_steps = sync_steps
+        self.axis_name = axis_name
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "since_sync": jnp.zeros((), jnp.int32)}
+
+    def step(self, loss_fn, params, state, *args, **kwargs):
+        """One local step + conditional sync (call under shard_map).
+        Delegates to inner.minimize so AMP/recompute wrappers compose."""
+        loss, params, inner, aux = self.inner.minimize(
+            loss_fn, params, state["inner"], *args, **kwargs)
+        since = state["since_sync"] + 1
+        do_sync = since >= self.sync_steps
+        params = lax.cond(
+            do_sync,
+            # pmean output is unvarying over the axis; pcast back to varying
+            # so both cond branches carry the same shard_map type
+            lambda p: _tmap(lambda x: lax.pcast(
+                lax.pmean(x, self.axis_name), self.axis_name, to="varying"),
+                p),
+            lambda p: p, params)
+        since = jnp.where(do_sync, 0, since)
+        return loss, params, {"inner": inner, "since_sync": since}, aux
+
+
+class GeoSGD:
+    """Geo-SGD: k local steps, then communicate the *delta* vs the last
+    synced anchor and apply everyone's average delta to the anchor.
+
+    Ref: operators/distributed/communicator.h:323 GeoSgdCommunicator +
+    geo_sgd_transpiler.py — local training with periodic delta push/pull
+    against the pserver copy; here the anchor is the pserver copy and the
+    delta allreduce rides ICI/DCN.
+    """
+
+    def __init__(self, optimizer, sync_steps, axis_name="dp"):
+        self.inner = optimizer
+        self.sync_steps = sync_steps
+        self.axis_name = axis_name
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "anchor": params,
+                "since_sync": jnp.zeros((), jnp.int32)}
+
+    def step(self, loss_fn, params, state, *args, **kwargs):
+        loss, params, inner, aux = self.inner.minimize(
+            loss_fn, params, state["inner"], *args, **kwargs)
+        since = state["since_sync"] + 1
+        do_sync = since >= self.sync_steps
+
+        def sync_branch(operand):
+            params, anchor = operand
+            delta = _tmap(lambda p, a: p - a, params, anchor)
+            mean_delta = _tmap(lambda d: lax.pcast(
+                lax.pmean(d, self.axis_name), self.axis_name, to="varying"),
+                delta)
+            new_anchor = _tmap(lambda a, d: a + d, anchor, mean_delta)
+            return new_anchor, new_anchor
+
+        params, anchor = lax.cond(
+            do_sync, sync_branch, lambda o: o, (params, state["anchor"]))
+        since = jnp.where(do_sync, 0, since)
+        return loss, params, {"inner": inner, "anchor": anchor,
+                              "since_sync": since}, aux

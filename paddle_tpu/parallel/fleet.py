@@ -1,0 +1,162 @@
+"""Fleet — the unified distributed-training facade.
+
+Ref: /root/reference/python/paddle/fluid/incubate/fleet/base/fleet_base.py:38
+(Fleet singleton: init(role_maker), distributed_optimizer(opt, strategy),
+worker_index/num, barriers) and incubate/fleet/collective/__init__.py:94
+(DistributedStrategy wrapping Build/ExecutionStrategy knobs: local_sgd,
+use_hierarchical_allreduce, fusion sizes...).
+
+TPU-first: the strategy names a mesh shape + gradient schedule instead of
+graph-rewrite knobs; distributed_optimizer composes the functional wrappers
+(GradientMerge / LocalSGD / GeoSGD / DGC / AMP) and `fleet.build_mesh()`
+hands back the jax.sharding.Mesh the train step pjits over. Multi-host
+bootstrap is jax.distributed (replacing gen_nccl_id + role makers reading
+PADDLE_TRAINER_* env), but the same env vars are honored for launcher parity.
+"""
+
+import dataclasses
+import os
+
+import jax
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel.communicator import GeoSGD, GradientMerge, LocalSGD
+
+
+@dataclasses.dataclass
+class DistributedStrategy:
+    """Mesh shape + communication schedule (ref: fleet DistributedStrategy +
+    DistributeTranspilerConfig in one place)."""
+    dp: int = -1                 # data-parallel ways (-1: infer)
+    fsdp: int = 1                # param-sharded data parallel
+    tp: int = 1                  # tensor parallel
+    pp: int = 1                  # pipeline stages
+    sp: int = 1                  # sequence/context parallel
+    ep: int = 1                  # embedding/expert shards
+    amp: bool = False            # bf16 mixed precision
+    recompute: bool = False      # activation checkpointing wrapper
+    gradient_merge_steps: int = 1
+    local_sgd_steps: int = 0     # >0: LocalSGD with this sync period
+    geo_sgd_steps: int = 0       # >0: Geo-SGD delta sync period
+    dgc: bool = False            # top-k compressed grads
+    dgc_sparsity: float = 0.99
+
+    def mesh_axes(self):
+        axes = {}
+        for name in ("dp", "fsdp", "tp", "pp", "sp", "ep"):
+            size = getattr(self, name)
+            if size == -1 or size > 1:
+                axes[name] = size
+        return axes or {"dp": -1}
+
+
+class Fleet:
+    """Process-level facade (singleton `fleet`, like the reference)."""
+
+    def __init__(self):
+        self._initialized = False
+        self._strategy = None
+        self._mesh = None
+        self._barrier_gen = 0
+
+    # -- role / topology (ref: role_maker.py) --
+    def init(self, coordinator_address=None, num_processes=None,
+             process_id=None):
+        """Single-host: no-op. Multi-host: jax.distributed bootstrap; honors
+        PADDLE_TRAINER_* envs for launcher parity (launch.py:78-81)."""
+        if coordinator_address is None:
+            coordinator_address = os.environ.get("PADDLE_COORDINATOR")
+        if num_processes is None and "PADDLE_TRAINERS_NUM" in os.environ:
+            num_processes = int(os.environ["PADDLE_TRAINERS_NUM"])
+        if process_id is None and "PADDLE_TRAINER_ID" in os.environ:
+            process_id = int(os.environ["PADDLE_TRAINER_ID"])
+        if coordinator_address is not None:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+        self._initialized = True
+        return self
+
+    @property
+    def worker_index(self):
+        return jax.process_index()
+
+    @property
+    def worker_num(self):
+        return jax.process_count()
+
+    def is_first_worker(self):
+        return self.worker_index == 0
+
+    # -- mesh (ref: ParallelExecutor places / nccl rings) --
+    def build_mesh(self, strategy=None, devices=None):
+        strategy = strategy or self._strategy or DistributedStrategy()
+        self._mesh = mesh_lib.make_mesh(strategy.mesh_axes(), devices)
+        self._strategy = strategy
+        return self._mesh
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    # -- optimizer composition (ref: fleet_base distributed_optimizer) --
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Compose the strategy's schedule wrappers around an Optimizer.
+
+        Returns an object with init/apply_gradients/minimize (GradientMerge,
+        plain) or init/step (LocalSGD/GeoSGD — divergent replicas, run under
+        shard_map)."""
+        strategy = strategy or self._strategy or DistributedStrategy()
+        self._strategy = strategy
+        enforce(not (strategy.local_sgd_steps and strategy.geo_sgd_steps),
+                "local_sgd_steps and geo_sgd_steps are mutually exclusive")
+        if strategy.dgc:
+            from paddle_tpu.optimizer.wrappers import DGCMomentum
+            enforce(isinstance(optimizer, DGCMomentum),
+                    "strategy.dgc=True requires a DGCMomentum optimizer "
+                    "(its sparse allreduce IS the communication schedule)")
+        # composition, innermost out: base -> GradientMerge (application) ->
+        # AMP -> Recompute (gradient computation) -> LocalSGD/GeoSGD
+        # (replica schedule); grad-computation wrappers delegate downward so
+        # every legal combination actually takes effect.
+        if strategy.gradient_merge_steps > 1:
+            optimizer = GradientMerge(optimizer, strategy.gradient_merge_steps)
+        if strategy.amp:
+            from paddle_tpu import amp
+            optimizer = amp.decorate(optimizer, amp.bf16_policy())
+        if strategy.recompute:
+            from paddle_tpu.optimizer.wrappers import RecomputeOptimizer
+            optimizer = RecomputeOptimizer(optimizer)
+        if strategy.local_sgd_steps:
+            return LocalSGD(optimizer, strategy.local_sgd_steps)
+        if strategy.geo_sgd_steps:
+            return GeoSGD(optimizer, strategy.geo_sgd_steps)
+        return optimizer
+
+    # -- convenience: one-call data-parallel trainer --
+    def data_parallel(self, optimizer, loss_fn, strategy=None, devices=None):
+        from paddle_tpu.parallel.api import DataParallel
+        m = self.build_mesh(strategy, devices)
+        enforce(not (self._strategy.local_sgd_steps
+                     or self._strategy.geo_sgd_steps),
+                "LocalSGD/GeoSGD need divergent per-group replicas (run "
+                "their .step under shard_map with stack_replicas); they "
+                "cannot ride the replicated-param DataParallel path")
+        opt = self.distributed_optimizer(optimizer, self._strategy)
+        return DataParallel(m, opt, loss_fn)
+
+    def barrier(self, directory=None, tag="fleet", timeout_s=300.0):
+        """Worker barrier (ref: fleet_base barrier_worker). In-process
+        single-host: no-op; cross-process: file barrier on a shared dir."""
+        if directory is None or self.worker_num == 1:
+            return
+        from paddle_tpu.parallel.heartbeat import barrier_with_timeout
+        # generation counter: barrier files are one-shot per tag, so each
+        # call uses a fresh tag (all workers call in the same order)
+        self._barrier_gen += 1
+        barrier_with_timeout(directory, self.worker_index, self.worker_num,
+                             timeout_s=timeout_s,
+                             tag=f"{tag}-{self._barrier_gen}")
+
+
+fleet = Fleet()

@@ -1,0 +1,157 @@
+"""Worker liveness monitoring — failure detection on the DCN fabric.
+
+Ref: /root/reference/paddle/fluid/operators/distributed/heart_beat_monitor.h:38
+(HeartBeatMonitor on the pserver: per-trainer UNINITED/RUNNING/COMPLETED
+states, a monitor thread warning when a RUNNING trainer stops sending grads)
+and rpc retry/deadline flags (operators/distributed/: FLAGS_rpc_deadline,
+FLAGS_rpc_retry_times).
+
+TPU-first: XLA collectives have no per-message deadline — liveness is
+tracked out-of-band. `HeartBeatMonitor` is in-process (thread) fed by worker
+pings; `FileHeartbeat` extends it across processes via mtime files on a
+shared dir (the typical multi-host TPU pod setup), replacing the reference's
+grad-arrival sniffing. `barrier_with_timeout` is the bounded-wait barrier
+the RPC layer's batch barriers provided.
+"""
+
+import os
+import threading
+import time
+
+from paddle_tpu.core import flags as F
+
+UNINITED = "UNINITED"
+RUNNING = "RUNNING"
+COMPLETED = "COMPLETED"
+STALLED = "STALLED"
+
+
+class HeartBeatMonitor:
+    """Track worker liveness from pings; invoke `on_stall(worker, age)` when
+    a RUNNING worker goes silent past the timeout."""
+
+    def __init__(self, num_workers, timeout_s=None, interval_s=None,
+                 on_stall=None, clock=time.monotonic):
+        self.num_workers = num_workers
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else F.get_flag("dist_heartbeat_timeout_s"))
+        self.interval_s = (interval_s if interval_s is not None
+                           else F.get_flag("dist_heartbeat_interval_s"))
+        self.on_stall = on_stall
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last = {}          # worker -> last ping time
+        self._state = {i: UNINITED for i in range(num_workers)}
+        self._thread = None
+        self._stop = threading.Event()
+
+    def update(self, worker, state=RUNNING):
+        """Record a ping (ref: HeartBeatMonitor::Update)."""
+        with self._lock:
+            self._last[worker] = self._clock()
+            if self._state.get(worker) != COMPLETED or state == COMPLETED:
+                self._state[worker] = state
+
+    def complete(self, worker):
+        self.update(worker, COMPLETED)
+
+    def check(self):
+        """One scan; returns {worker: (state, age_s)}. RUNNING workers past
+        the timeout flip to STALLED and fire on_stall."""
+        now = self._clock()
+        out = {}
+        with self._lock:
+            for w in range(self.num_workers):
+                age = now - self._last.get(w, now)
+                st = self._state.get(w, UNINITED)
+                if st == RUNNING and age > self.timeout_s:
+                    st = self._state[w] = STALLED
+                    if self.on_stall is not None:
+                        self.on_stall(w, age)
+                out[w] = (st, age)
+        return out
+
+    def start(self):
+        """Background monitor thread (ref: LostWorkerMonitor loop)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.check()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="heartbeat-monitor")
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def all_completed(self):
+        with self._lock:
+            return all(s == COMPLETED for s in self._state.values())
+
+
+class FileHeartbeat:
+    """Cross-process heartbeat over a shared directory: each worker touches
+    `<dir>/worker_<i>.hb`; any process can monitor mtimes."""
+
+    def __init__(self, directory, worker):
+        self.dir = directory
+        self.worker = worker
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"worker_{worker}.hb")
+
+    def ping(self):
+        with open(self.path, "a"):
+            os.utime(self.path, None)
+
+    def complete(self):
+        with open(self.path + ".done", "w") as f:
+            f.write("done")
+
+    @staticmethod
+    def scan(directory, num_workers, timeout_s):
+        """Returns {worker: (state, age_s)} from file mtimes."""
+        now = time.time()
+        out = {}
+        for w in range(num_workers):
+            p = os.path.join(directory, f"worker_{w}.hb")
+            if os.path.exists(p + ".done"):
+                out[w] = (COMPLETED, 0.0)
+            elif not os.path.exists(p):
+                out[w] = (UNINITED, 0.0)
+            else:
+                age = now - os.path.getmtime(p)
+                out[w] = (STALLED if age > timeout_s else RUNNING, age)
+        return out
+
+
+def barrier_with_timeout(directory, worker, num_workers, timeout_s=300.0,
+                         tag="barrier", poll_s=0.05):
+    """File-based N-way barrier with a deadline (ref: the RPC layer's
+    batch_barrier/fetch_barrier with FLAGS_rpc_deadline). Raises TimeoutError
+    listing the missing workers.
+
+    One-shot per (directory, tag): marker files persist, so reuse a tag only
+    for the same sync point (Fleet.barrier stamps a generation counter)."""
+    os.makedirs(directory, exist_ok=True)
+    mine = os.path.join(directory, f"{tag}.{worker}")
+    with open(mine, "w") as f:
+        f.write(str(worker))
+    deadline = time.time() + timeout_s
+    while True:
+        present = {i for i in range(num_workers)
+                   if os.path.exists(os.path.join(directory, f"{tag}.{i}"))}
+        if len(present) == num_workers:
+            return
+        if time.time() > deadline:
+            missing = sorted(set(range(num_workers)) - present)
+            raise TimeoutError(
+                f"barrier '{tag}' timed out after {timeout_s}s; "
+                f"missing workers {missing}")
+        time.sleep(poll_s)

@@ -144,9 +144,15 @@ PJRT_Buffer* ToDevice(const PJRT_Api* api, PJRT_Client* client,
 
 }  // namespace
 
+bool FileExists(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  return static_cast<bool>(f);
+}
+
 int main(int argc, char** argv) {
   std::string model_dir, plugin_path;
   int iters = 100, warmup = 10;
+  bool train = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> std::string {
@@ -157,17 +163,29 @@ int main(int argc, char** argv) {
     else if (a == "--plugin") plugin_path = next();
     else if (a == "--iters") iters = atoi(next().c_str());
     else if (a == "--warmup") warmup = atoi(next().c_str());
+    else if (a == "--train") train = true;
     else Die("unknown flag " + a + " (usage: pt_predictor --model_dir D "
-             "--plugin P [--iters N] [--warmup N])");
+             "--plugin P [--iters N] [--warmup N] [--train])");
   }
   if (model_dir.empty()) Die("--model_dir is required");
 
   // Artifact load + validation happens before plugin resolution so the
   // artifact path is testable on machines without a PJRT plugin.
+  // Train artifacts (save_train_program) feed outputs 1..n back into
+  // inputs 0..n-1 each iteration (the C++ train loop of
+  // /root/reference/paddle/fluid/train, minus the per-op interpreter).
   std::string mlir = ReadFileOrDie(model_dir + "/model.stablehlo");
   std::vector<HostTensor> params = LoadParams(model_dir + "/params.bin");
-  fprintf(stderr, "loaded model (%zu bytes MLIR, %zu params)\n", mlir.size(),
-          params.size());
+  std::vector<HostTensor> extra_inputs;
+  if (FileExists(model_dir + "/inputs.bin")) {
+    extra_inputs = LoadParams(model_dir + "/inputs.bin");
+  }
+  if (train && !FileExists(model_dir + "/inputs.bin")) {
+    Die("--train needs an inputs.bin (export via save_train_program)");
+  }
+  fprintf(stderr, "loaded model (%zu bytes MLIR, %zu params, %zu inputs%s)\n",
+          mlir.size(), params.size(), extra_inputs.size(),
+          train ? ", train mode" : "");
 
   if (plugin_path.empty()) {
     fprintf(stderr, "no --plugin given (libtpu.so on TPU hosts); artifact "
@@ -219,9 +237,12 @@ int main(int argc, char** argv) {
   PJRT_LoadedExecutable* exe = comp.executable;
 
   // -- stage params once (weights live on device across calls, like the
-  //    reference predictor's persistable scope) --
+  //    reference predictor's persistable scope); batch inputs after them --
   std::vector<PJRT_Buffer*> arg_bufs;
   for (const auto& t : params) arg_bufs.push_back(ToDevice(api, client, device, t));
+  const size_t n_state = arg_bufs.size();
+  for (const auto& t : extra_inputs)
+    arg_bufs.push_back(ToDevice(api, client, device, t));
 
   PJRT_ExecuteOptions opts;
   memset(&opts, 0, sizeof(opts));
@@ -244,7 +265,16 @@ int main(int argc, char** argv) {
   PJRT_Buffer** output_list = outputs.data();
   PJRT_Buffer* const* arg_list = arg_bufs.data();
 
-  auto run_once = [&]() {
+  auto destroy_buffer = [&](PJRT_Buffer* b) {
+    if (!b) return;
+    PJRT_Buffer_Destroy_Args bd;
+    memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = b;
+    api->PJRT_Buffer_Destroy(&bd);
+  };
+
+  auto execute = [&]() {
     PJRT_LoadedExecutable_Execute_Args ex;
     memset(&ex, 0, sizeof(ex));
     ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
@@ -267,14 +297,64 @@ int main(int argc, char** argv) {
     edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
     edargs.event = done;
     api->PJRT_Event_Destroy(&edargs);
-    for (auto* b : outputs) {
-      if (!b) continue;
-      PJRT_Buffer_Destroy_Args bd;
-      memset(&bd, 0, sizeof(bd));
-      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-      bd.buffer = b;
-      api->PJRT_Buffer_Destroy(&bd);
+  };
+
+  auto read_scalar_f32 = [&](PJRT_Buffer* b) -> float {
+    float v = 0.0f;
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = b;
+    th.dst = &v;
+    th.dst_size = sizeof(v);
+    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
+    if (th.event) {
+      PJRT_Event_Await_Args eargs;
+      memset(&eargs, 0, sizeof(eargs));
+      eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      eargs.event = th.event;
+      CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(d2h)");
+      PJRT_Event_Destroy_Args edargs;
+      memset(&edargs, 0, sizeof(edargs));
+      edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+      edargs.event = th.event;
+      api->PJRT_Event_Destroy(&edargs);
     }
+    return v;
+  };
+
+  if (train) {
+    // Training loop: outputs = [loss, new_state...]; state outputs replace
+    // the leading state inputs each iteration.
+    if (outputs.size() < 1 + n_state)
+      Die("train program must output [loss, state...]");
+    auto t0 = std::chrono::steady_clock::now();
+    float loss = 0.0f;
+    for (int i = 0; i < iters; ++i) {
+      execute();
+      loss = read_scalar_f32(outputs[0]);
+      destroy_buffer(outputs[0]);
+      for (size_t j = 0; j < n_state; ++j) {
+        destroy_buffer(arg_bufs[j]);
+        arg_bufs[j] = outputs[1 + j];
+      }
+      for (size_t j = 1 + n_state; j < outputs.size(); ++j)
+        destroy_buffer(outputs[j]);
+      if (i == 0 || (i + 1) % 10 == 0 || i + 1 == iters)
+        fprintf(stderr, "iter %d loss %.6f\n", i + 1, loss);
+    }
+    auto t1 = std::chrono::steady_clock::now();
+    double total_ms =
+        std::chrono::duration<double, std::milli>(t1 - t0).count();
+    printf("{\"mode\": \"train\", \"iters\": %d, \"final_loss\": %.6f, "
+           "\"mean_step_ms\": %.3f}\n",
+           iters, loss, total_ms / iters);
+    return 0;
+  }
+
+  auto run_once = [&]() {
+    execute();
+    for (auto* b : outputs) destroy_buffer(b);
   };
 
   for (int i = 0; i < warmup; ++i) run_once();

@@ -43,11 +43,60 @@ def save_inference_model(path, fn, example_args, params):
              **{f"p{i}": np.asarray(x) for i, x in enumerate(flat)})
     _write_params_bin(os.path.join(path, "params.bin"), flat)
 
+    _write_params_bin(os.path.join(path, "inputs.bin"),
+                      [jnp.asarray(a) for a in example_args])
+
     sig = {
+        "mode": "infer",
         "inputs": [{"shape": list(np.shape(a)),
                     "dtype": str(np.asarray(a).dtype)}
                    for a in example_args],
         "num_params": len(flat),
+        "treedef": str(treedef),
+    }
+    with open(os.path.join(path, "signature.json"), "w") as f:
+        json.dump(sig, f, indent=2)
+    return path
+
+
+def save_train_program(path, train_step, state, example_batch):
+    """Export ONE optimizer step for the Python-free C++ training loop.
+
+    Ref: /root/reference/paddle/fluid/train/ (test_train_recognize_digits.cc
+    — load a train ProgramDesc, loop Executor::Run in pure C++). Here the
+    artifact is a StableHLO program of the whole jitted step; the C++ loop
+    (csrc/predictor --train) feeds each iteration's state outputs back in.
+
+    train_step(state, *batch) -> (loss, new_state); state is any pytree
+    (params + optimizer slots). Program signature:
+      inputs  = [*flat(state), *batch]      (flat(state) = params.bin)
+      outputs = [loss, *flat(new_state)]    (output 1+j feeds input j)
+    """
+    os.makedirs(path, exist_ok=True)
+    flat, treedef = jax.tree_util.tree_flatten(state)
+    n = len(flat)
+
+    def step_flat(*args):
+        st = jax.tree_util.tree_unflatten(treedef, args[:n])
+        loss, new_state = train_step(st, *args[n:])
+        new_flat = treedef.flatten_up_to(new_state)
+        return (loss, *new_flat)
+
+    lowered = jax.jit(step_flat).lower(*flat, *example_batch)
+    with open(os.path.join(path, "model.stablehlo"), "w") as f:
+        f.write(lowered.as_text(dialect="stablehlo"))
+    _write_params_bin(os.path.join(path, "params.bin"), flat)
+    _write_params_bin(os.path.join(path, "inputs.bin"),
+                      [jnp.asarray(a) for a in example_batch])
+    np.savez(os.path.join(path, "params.npz"),
+             **{f"p{i}": np.asarray(x) for i, x in enumerate(flat)})
+    sig = {
+        "mode": "train",
+        "inputs": [{"shape": list(np.shape(a)),
+                    "dtype": str(np.asarray(a).dtype)}
+                   for a in example_batch],
+        "num_params": n,
+        "feedback": [[1 + j, j] for j in range(n)],
         "treedef": str(treedef),
     }
     with open(os.path.join(path, "signature.json"), "w") as f:

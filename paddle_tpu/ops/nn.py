@@ -246,17 +246,13 @@ def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
 @register_op("layer_norm")
 def layer_norm(x, scale=None, bias=None, begin_norm_axis=1, epsilon=1e-5):
     """ref: operators/layer_norm_op.cc — normalize over dims
-    [begin_norm_axis:]; scale/bias are flat over those dims."""
-    red = tuple(range(begin_norm_axis, x.ndim))
-    m = jnp.mean(x, axis=red, keepdims=True)
-    v = jnp.var(x, axis=red, keepdims=True)
-    out = (x - m) * lax.rsqrt(v + epsilon)
-    tail = x.shape[begin_norm_axis:]
-    if scale is not None:
-        out = out * scale.reshape(tail)
-    if bias is not None:
-        out = out + bias.reshape(tail)
-    return out
+    [begin_norm_axis:]; scale/bias are flat over those dims.
+
+    Single implementation: the fused Pallas kernel on TPU (fp32 statistics,
+    stats-carrying backward), its XLA twin elsewhere."""
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm_fused
+    return layer_norm_fused(x, scale, bias, begin_norm_axis=begin_norm_axis,
+                            epsilon=epsilon)
 
 
 @register_op("rms_norm")

@@ -1,0 +1,137 @@
+"""Fused layer norm — Pallas TPU kernel + XLA fallback.
+
+The counterpart of the reference's hand-written CUDA layer_norm
+(/root/reference/paddle/fluid/operators/layer_norm_op.cu — block-reduce
+mean/var then normalize in one pass) and the fused
+fused_fc_elementwise_layernorm op family. One HBM read + one write per
+element: mean/var/normalize/affine all happen on a VMEM-resident row tile;
+the kernel also emits mean/rstd so the backward needs no second stats pass.
+
+Layout: x [R, C] (rows = everything before begin_norm_axis, flattened).
+Grid: (ceil(R / BR),); each program normalizes a [BR, C] tile (the padded
+tail tile computes garbage rows whose writes fall off the array). fp32
+statistics regardless of input dtype; backward consumes the saved stats.
+
+This is the single implementation behind the registered "layer_norm" op
+(ops/nn.py routes here), so module path, captured programs, and direct
+callers all share it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas import on_tpu
+
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, m_ref, r_ref, *, epsilon):
+    x = x_ref[:].astype(jnp.float32)                       # [BR, C]
+    m = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - m
+    v = jnp.mean(xc * xc, axis=1, keepdims=True)
+    r = jax.lax.rsqrt(v + epsilon)
+    y = xc * r
+    y = y * g_ref[:].astype(jnp.float32)[None, :]
+    y = y + b_ref[:].astype(jnp.float32)[None, :]
+    o_ref[:] = y.astype(o_ref.dtype)
+    m_ref[:] = m
+    r_ref[:] = r
+
+
+def _pick_block_rows(rows, cols, dtype_bytes, vmem_budget=2 ** 21):
+    """Rows per tile: keep ~2 copies of the tile within a 2MB VMEM slice.
+    Need not divide rows — the grid rounds up and the tail tile is padded."""
+    per_row = max(cols * dtype_bytes * 2, 1)
+    return max(min(vmem_budget // per_row, rows, 256), 1)
+
+
+def _stats_pallas(x2d, gamma, beta, epsilon):
+    R, C = x2d.shape
+    br = _pick_block_rows(R, C, x2d.dtype.itemsize)
+    kern = functools.partial(_ln_fwd_kernel, epsilon=epsilon)
+    return pl.pallas_call(
+        kern,
+        grid=(pl.cdiv(R, br),),
+        in_specs=[
+            pl.BlockSpec((br, C), lambda i: (i, 0)),
+            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((C,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, C), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C), x2d.dtype),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+    )(x2d, gamma, beta)
+
+
+def _stats_xla(x2d, gamma, beta, epsilon):
+    x = x2d.astype(jnp.float32)
+    m = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - m
+    v = jnp.mean(xc * xc, axis=1, keepdims=True)
+    r = jax.lax.rsqrt(v + epsilon)
+    y = xc * r
+    y = y * gamma.astype(jnp.float32)[None, :] + \
+        beta.astype(jnp.float32)[None, :]
+    return y.astype(x2d.dtype), m, r
+
+
+def _stats(x2d, gamma, beta, epsilon):
+    if on_tpu():
+        return _stats_pallas(x2d, gamma, beta, epsilon)
+    return _stats_xla(x2d, gamma, beta, epsilon)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _layer_norm_rows(x2d, gamma, beta, epsilon):
+    return _stats(x2d, gamma, beta, epsilon)[0]
+
+
+def _ln_fwd(x2d, gamma, beta, epsilon):
+    out, m, r = _stats(x2d, gamma, beta, epsilon)
+    return out, (x2d, gamma, beta, m, r)
+
+
+def _ln_bwd(epsilon, res, dy):
+    x2d, gamma, beta, m, r = res
+    x = x2d.astype(jnp.float32)
+    dy = dy.astype(jnp.float32)
+    xhat = (x - m) * r
+    dgamma = jnp.sum(dy * xhat, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(dy, axis=0).astype(beta.dtype)
+    wdy = dy * gamma.astype(jnp.float32)[None, :]
+    c1 = jnp.mean(wdy, axis=1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * r
+    return dx.astype(x2d.dtype), dgamma, dbeta
+
+
+_layer_norm_rows.defvjp(_ln_fwd, _ln_bwd)
+
+
+def layer_norm_fused(x, scale=None, bias=None, begin_norm_axis=1,
+                     epsilon=1e-5):
+    """Layer norm over dims [begin_norm_axis:]; scale/bias flat over those
+    dims (the reference layer_norm_op.cc contract)."""
+    lead = x.shape[:begin_norm_axis]
+    tail = x.shape[begin_norm_axis:]
+    R = 1
+    for d in lead:
+        R *= d
+    C = 1
+    for d in tail:
+        C *= d
+    gamma = (scale.reshape(C) if scale is not None
+             else jnp.ones((C,), x.dtype))
+    beta = (bias.reshape(C) if bias is not None
+            else jnp.zeros((C,), x.dtype))
+    out = _layer_norm_rows(x.reshape(R, C), gamma, beta, epsilon)
+    return out.reshape(x.shape)

@@ -1,0 +1,96 @@
+"""Fused layer-norm: numpy-golden parity + gradient correctness (the Pallas
+TPU path itself is exercised by bench.py on hardware; CPU runs the XLA twin
+of the same single implementation behind ops.nn.layer_norm)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import nn as F
+from paddle_tpu.ops.pallas.layer_norm import layer_norm_fused
+
+
+def np_layer_norm(x, scale, bias, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    out = (x - m) / np.sqrt(v + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+class TestLayerNormFused:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6, 32).astype(np.float32)
+        scale = (rng.rand(32) + 0.5).astype(np.float32)
+        bias = rng.randn(32).astype(np.float32)
+        out = F.layer_norm(jnp.asarray(x), jnp.asarray(scale),
+                           jnp.asarray(bias), begin_norm_axis=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np_layer_norm(x, scale, bias), atol=1e-5)
+
+    def test_no_affine(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 16)
+                        .astype(np.float32))
+        out = np.asarray(layer_norm_fused(x, begin_norm_axis=1))
+        np.testing.assert_allclose(out.mean(1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(1), 1.0, atol=1e-3)
+
+    def test_prime_row_count(self):
+        # R with no small divisors must still work (grid rounds up)
+        x = np.random.RandomState(2).randn(509, 24).astype(np.float32)
+        out = np.asarray(layer_norm_fused(jnp.asarray(x), begin_norm_axis=1))
+        np.testing.assert_allclose(out, np_layer_norm(x, None, None),
+                                   atol=1e-5)
+
+    def test_grad_matches_numeric(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(5, 24).astype(np.float32))
+        scale = jnp.asarray((rng.rand(24) + 0.5).astype(np.float32))
+        bias = jnp.asarray(rng.randn(24).astype(np.float32))
+        co = jnp.asarray(rng.randn(5, 24).astype(np.float32))
+
+        def f(x, s, b):
+            return jnp.sum(layer_norm_fused(x, s, b, begin_norm_axis=1) * co)
+
+        gx, gs, gb = jax.grad(f, argnums=(0, 1, 2))(x, scale, bias)
+        for arg, g in ((0, gx), (1, gs), (2, gb)):
+            eps = 1e-3
+            args = [np.array(x), np.array(scale), np.array(bias)]
+            flat = args[arg].reshape(-1)
+            gflat = np.asarray(g).reshape(-1)
+            for i in range(0, flat.size, max(flat.size // 7, 1)):
+                old = flat[i]
+                flat[i] = old + eps
+                fp = float(f(*[jnp.asarray(a) for a in args]))
+                flat[i] = old - eps
+                fm = float(f(*[jnp.asarray(a) for a in args]))
+                flat[i] = old
+                np.testing.assert_allclose(gflat[i], (fp - fm) / (2 * eps),
+                                           atol=2e-2, rtol=2e-2)
+
+    def test_grad_dtypes_follow_primals(self):
+        # bf16 activations with fp32 master scale/bias: each gradient must
+        # carry its own primal's dtype
+        x = jnp.ones((4, 16), jnp.bfloat16)
+        scale = jnp.ones((16,), jnp.float32)
+        bias = jnp.zeros((16,), jnp.float32)
+
+        def f(x, s, b):
+            return jnp.sum(layer_norm_fused(x, s, b).astype(jnp.float32))
+
+        gx, gs, gb = jax.grad(f, argnums=(0, 1, 2))(x, scale, bias)
+        assert gx.dtype == jnp.bfloat16
+        assert gs.dtype == jnp.float32
+        assert gb.dtype == jnp.float32
+
+    def test_under_jit_and_bf16(self):
+        x = jnp.asarray(np.random.RandomState(4).randn(8, 128)
+                        .astype(np.float32)).astype(jnp.bfloat16)
+        out = jax.jit(lambda a: layer_norm_fused(a, begin_norm_axis=1))(x)
+        assert out.dtype == jnp.bfloat16
+        m = np.asarray(out.astype(jnp.float32)).mean(1)
+        np.testing.assert_allclose(m, 0.0, atol=2e-2)

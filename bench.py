@@ -191,20 +191,61 @@ def bench_resnet(steps, batch):
     }
 
 
+def _run_inner(args):
+    if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
+        raise RuntimeError("forced failure")   # outer error-JSON path
+    if args.model == "bert":
+        res = bench_bert(args.steps, args.batch or 64, args.seq)
+    else:
+        res = bench_resnet(args.steps, args.batch or 128)
+    res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
+    return res
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="bert", choices=["bert", "resnet50"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    if args.model == "bert":
-        res = bench_bert(args.steps, args.batch or 64, args.seq)
-    else:
-        res = bench_resnet(args.steps, args.batch or 128)
-    res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
-    print(json.dumps(res))
+    if args._inner:
+        print(json.dumps(_run_inner(args)))
+        return
+
+    # Outer wrapper: the tunneled TPU backend can fail to initialize
+    # transiently (round 1's BENCH was rc=1 for exactly this). Run the bench
+    # in a child process, retry with backoff on failure, and ALWAYS emit one
+    # parseable JSON line no matter what.
+    import subprocess
+    attempts = int(os.environ.get("PT_BENCH_ATTEMPTS", "3"))
+    per_attempt = float(os.environ.get("PT_BENCH_TIMEOUT", "900"))
+    last_tail = ""
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 *sys.argv[1:], "--_inner"],
+                stdout=subprocess.PIPE, text=True, timeout=per_attempt)
+        except subprocess.TimeoutExpired:
+            last_tail = f"timeout after {per_attempt}s"
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                res = json.loads(line)
+                if isinstance(res, dict) and "metric" in res:
+                    print(json.dumps(res))
+                    return
+            except ValueError:
+                continue
+        last_tail = proc.stdout.strip()[-500:] or f"rc={proc.returncode}"
+        if attempt + 1 < attempts:
+            time.sleep(5.0 * (attempt + 1))
+    print(json.dumps({
+        "metric": "bench_failed", "value": 0.0, "unit": "error",
+        "vs_baseline": 0.0, "error": last_tail[-500:]}))
 
 
 if __name__ == "__main__":

@@ -10,4 +10,5 @@ from paddle_tpu.io.inference import (
     Predictor,
     load_inference_model,
     save_inference_model,
+    save_train_program,
 )

@@ -98,6 +98,60 @@ def make_pipeline_fn(mesh, stage_fn, axis_name=PP):
     )
 
 
+def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
+                             remat=False):
+    """GPipe-style pipeline-parallel TRAINING step.
+
+    Ref: /root/reference/python/paddle/fluid/optimizer.py:2985
+    (PipelineOptimizer: cut program into sections, microbatch, train) and
+    section_worker.cc:141 (SectionWorker::TrainFiles runs forward AND
+    backward AND optimizer per section).
+
+    TPU-first redesign: the pipelined forward is pure differentiable lax
+    (scan over ticks + ppermute hops), so the *backward pipeline schedule
+    falls out of autodiff*: JAX transposes each ppermute into the reverse
+    hop and the scan into a reverse-tick scan, which is exactly the GPipe
+    backward wave; per-stage gradient accumulation across microbatches is
+    the scan-transpose's natural cotangent sum. No section threads, no
+    queues, no hand-written 1F1B — XLA schedules the waves.
+
+    `remat=True` wraps each stage in jax.checkpoint so activations are
+    rebuilt in the backward wave (the memory win 1F1B exists for;
+    ref backward.py:576 _append_backward_ops_with_checkpoints_).
+
+    Args:
+      mesh: Mesh with `axis_name` of size n_stages.
+      stage_fn(stage_params, h) -> h  — same signature every stage.
+      loss_fn(outputs, labels) -> scalar, where outputs is [M, mb, ...]
+        stacked final-stage activations.
+      opt: paddle_tpu Optimizer; state/params are the stage-stacked pytrees
+        (leading dim n_stages, sharded over `axis_name`), so each device
+        updates its own stage's slice — the reference's per-section
+        optimizer ops.
+
+    Returns step(params, opt_state, x, y) -> (loss, params, opt_state)
+    where x is [M, mb, ...] microbatches and y the matching labels.
+    """
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def inner(params, x):
+        return pipeline_forward(fn, params, x, axis_name)
+
+    pspec = P(axis_name)
+    fwd = shard_map(inner, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+                    check_vma=False)
+
+    def global_loss(params, x, y):
+        return loss_fn(fwd(params, x), y)
+
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(global_loss)(params, x, y)
+        params, opt_state = opt.apply_gradients(params, grads, opt_state)
+        return loss, params, opt_state
+
+    return step
+
+
 def stack_stage_params(per_stage_params):
     """[{params of stage i}] -> stacked pytree with leading stage dim."""
     return jax.tree_util.tree_map(

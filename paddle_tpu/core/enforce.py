@@ -61,3 +61,30 @@ def enforce_rank(x, rank, name="tensor"):
 def enforce_shape_match(a, b, msg=""):
     if tuple(a.shape) != tuple(b.shape):
         raise EnforceError(f"Shape mismatch: {a.shape} vs {b.shape}. {msg}")
+
+
+def check_numerics(tree, label="tensors"):
+    """Host-side NaN/Inf validation of a pytree of arrays.
+
+    Ref: /root/reference/paddle/fluid/platform/flags.cc:44
+    (FLAGS_check_nan_inf validates every op output at the executor level).
+    TPU-first: device code can't raise (and the tunneled PJRT platform has no
+    host callbacks), so the check runs on fetched host values — call it on
+    step outputs / fetched vars. Raises EnforceError naming the bad leaves.
+    """
+    import jax
+    import numpy as np
+
+    bad = []
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            bad.append(f"{jax.tree_util.keystr(path)} "
+                       f"(nan={n_nan}, inf={n_inf})")
+    if bad:
+        raise EnforceError(
+            f"check_nan_inf: non-finite values in {label}: " + ", ".join(bad))
+    return tree

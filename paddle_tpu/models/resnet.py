@@ -17,12 +17,13 @@ from paddle_tpu.ops import nn as F
 
 
 class ConvBN(nn.Module):
-    def __init__(self, cin, cout, k, stride=1, act="relu", groups=1):
+    def __init__(self, cin, cout, k, stride=1, act="relu", groups=1,
+                 data_format="NCHW"):
         super().__init__()
         self.conv = nn.Conv2D(cin, cout, k, stride=stride,
                               padding=(k - 1) // 2, groups=groups, bias=False,
-                              weight_init=I.msra())
-        self.bn = nn.BatchNorm(cout, act=act)
+                              weight_init=I.msra(), data_format=data_format)
+        self.bn = nn.BatchNorm(cout, act=act, data_format=data_format)
 
     def forward(self, x):
         return self.bn(self.conv(x))
@@ -31,13 +32,14 @@ class ConvBN(nn.Module):
 class BasicBlock(nn.Module):
     expansion = 1
 
-    def __init__(self, cin, cout, stride=1):
+    def __init__(self, cin, cout, stride=1, data_format="NCHW"):
         super().__init__()
-        self.conv1 = ConvBN(cin, cout, 3, stride)
-        self.conv2 = ConvBN(cout, cout, 3, act=None)
+        self.conv1 = ConvBN(cin, cout, 3, stride, data_format=data_format)
+        self.conv2 = ConvBN(cout, cout, 3, act=None, data_format=data_format)
         self.short = None
         if stride != 1 or cin != cout:
-            self.short = ConvBN(cin, cout, 1, stride, act=None)
+            self.short = ConvBN(cin, cout, 1, stride, act=None,
+                                data_format=data_format)
 
     def forward(self, x):
         out = self.conv2(self.conv1(x))
@@ -48,15 +50,16 @@ class BasicBlock(nn.Module):
 class Bottleneck(nn.Module):
     expansion = 4
 
-    def __init__(self, cin, width, stride=1):
+    def __init__(self, cin, width, stride=1, data_format="NCHW"):
         super().__init__()
         cout = width * self.expansion
-        self.conv1 = ConvBN(cin, width, 1)
-        self.conv2 = ConvBN(width, width, 3, stride)
-        self.conv3 = ConvBN(width, cout, 1, act=None)
+        self.conv1 = ConvBN(cin, width, 1, data_format=data_format)
+        self.conv2 = ConvBN(width, width, 3, stride, data_format=data_format)
+        self.conv3 = ConvBN(width, cout, 1, act=None, data_format=data_format)
         self.short = None
         if stride != 1 or cin != cout:
-            self.short = ConvBN(cin, cout, 1, stride, act=None)
+            self.short = ConvBN(cin, cout, 1, stride, act=None,
+                                data_format=data_format)
 
     def forward(self, x):
         out = self.conv3(self.conv2(self.conv1(x)))
@@ -74,14 +77,23 @@ _CONFIGS = {
 
 
 class ResNet(nn.Module):
-    def __init__(self, depth=50, num_classes=1000, small_input=False):
+    """TPU-first default is channels-last (data_format='NHWC'): convs run
+    ~3x faster than NCHW on TPU (measured; see nn.Conv2D docstring). Inputs
+    are still accepted as NCHW [B,3,H,W] per the reference convention and
+    transposed once at the stem — one cheap transpose per step vs per-conv
+    layout churn."""
+
+    def __init__(self, depth=50, num_classes=1000, small_input=False,
+                 data_format="NHWC"):
         super().__init__()
         block, layers = _CONFIGS[depth]
         self.small_input = small_input
+        self.data_format = data_format
+        df = data_format
         if small_input:  # CIFAR-style stem (ref: tests/book resnet_cifar10)
-            self.stem = ConvBN(3, 64, 3)
+            self.stem = ConvBN(3, 64, 3, data_format=df)
         else:
-            self.stem = ConvBN(3, 64, 7, stride=2)
+            self.stem = ConvBN(3, 64, 7, stride=2, data_format=df)
         stages = []
         cin = 64
         for i, n in enumerate(layers):
@@ -89,7 +101,7 @@ class ResNet(nn.Module):
             blocks = []
             for j in range(n):
                 stride = 2 if (j == 0 and i > 0) else 1
-                blocks.append(block(cin, width, stride))
+                blocks.append(block(cin, width, stride, data_format=df))
                 cin = width * block.expansion
             stages.append(nn.Sequential(blocks))
         self.stages = stages  # becomes ModuleList
@@ -97,12 +109,16 @@ class ResNet(nn.Module):
                             weight_init=I.uniform(-0.01, 0.01))
 
     def forward(self, x):
+        if self.data_format == "NHWC":
+            x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW input -> NHWC compute
         x = self.stem(x)
         if not self.small_input:
-            x = F.pool2d(x, 3, "max", 2, padding=1)
+            x = F.pool2d(x, 3, "max", 2, padding=1,
+                         data_format=self.data_format)
         for stage in self.stages:
             x = stage(x)
-        x = F.pool2d(x, pool_type="avg", global_pooling=True)
+        x = F.pool2d(x, pool_type="avg", global_pooling=True,
+                     data_format=self.data_format)
         return self.fc(x.reshape(x.shape[0], -1))
 
 

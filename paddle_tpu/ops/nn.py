@@ -229,16 +229,25 @@ def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
     if training:
-        m = jnp.mean(x, axis=red)
-        v = jnp.var(x, axis=red)
+        # One-pass statistics: E[x] and E[x^2] reduce in a single fused
+        # sweep over the activations (jnp.var would be a second full HBM
+        # read — BN is bandwidth-bound on TPU, so the pass count is the
+        # cost). Accumulate in fp32 regardless of activation dtype.
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=red)
+        m2 = jnp.mean(jnp.square(xf), axis=red)
+        v = jnp.maximum(m2 - jnp.square(m), 0.0)
         n = x.size // x.shape[axis]
         unbiased = v * n / max(n - 1, 1)
-        new_mean = momentum * mean + (1 - momentum) * m
-        new_var = momentum * variance + (1 - momentum) * unbiased
+        one = jnp.asarray(1.0, mean.dtype)
+        new_mean = momentum * mean + (one - momentum) * m.astype(mean.dtype)
+        new_var = momentum * variance + (one - momentum) * unbiased.astype(
+            variance.dtype)
+        m, v = m.astype(x.dtype), v.astype(x.dtype)
     else:
         m, v = mean, variance
         new_mean, new_var = mean, variance
-    inv = lax.rsqrt(v + epsilon)
+    inv = lax.rsqrt(v.astype(x.dtype) + jnp.asarray(epsilon, x.dtype))
     out = (x - m.reshape(shape)) * (inv * scale).reshape(shape) + bias.reshape(shape)
     return out, new_mean, new_var
 

@@ -42,19 +42,47 @@ class Optimizer:
 
     # -- public API --
     def init(self, params):
-        return {
+        state = {
             "step": jnp.zeros((), jnp.int32),
             "slots": _tmap(lambda p: self.slots(p), params,
                            ),
         }
+        from paddle_tpu.core.flags import get_flag
+        if get_flag("check_nan_inf"):
+            # ref flags.cc:44 FLAGS_check_nan_inf. Under jit the step can't
+            # raise, so bad steps are *skipped* and counted here; eager calls
+            # raise EnforceError immediately (see apply_gradients).
+            state["nan_inf_steps"] = jnp.zeros((), jnp.int32)
+        return state
 
     def apply_gradients(self, params, grads, state):
         """ref: optimizer.py apply_gradients :557 (clip → regularize →
-        per-param update ops)."""
+        per-param update ops).
+
+        With flag check_nan_inf set (ref flags.cc:44): eager calls raise
+        EnforceError on non-finite gradients; traced (jit) calls skip the
+        whole update and increment state['nan_inf_steps'] instead, since
+        device code cannot raise on TPU (no host callbacks on PJRT tunnel).
+        """
+        from paddle_tpu.core.flags import get_flag
+        check = get_flag("check_nan_inf")
+        grads_in = grads
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         if self.regularization is not None:
             grads = self.regularization(grads, params)
+        if check:
+            import jax.core as jcore
+            leaves = [g for g in jax.tree_util.tree_leaves(grads_in)
+                      if g is not None]
+            finite = jnp.array(True)
+            for g in leaves:
+                if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+                    finite = finite & jnp.all(jnp.isfinite(g))
+            if not isinstance(finite, jcore.Tracer) and not jnp.all(finite):
+                from paddle_tpu.core.enforce import check_numerics
+                check_numerics(grads_in, "gradients")
+            params_in, state_in = params, state
         step = state["step"]
         lr = self.lr(step)
         flat_p, treedef = jax.tree_util.tree_flatten(params)
@@ -71,7 +99,18 @@ class Optimizer:
             new_s.append(ns_)
         params = jax.tree_util.tree_unflatten(treedef, new_p)
         slots = jax.tree_util.tree_unflatten(treedef, new_s)
-        return params, {"step": step + 1, "slots": slots}
+        new_state = {"step": step + 1, "slots": slots}
+        if check:
+            # Skip the whole update on a bad step (AMP-scaler-style guard).
+            keep = lambda new, old: _tmap(
+                lambda a, b: jnp.where(finite, a, b), new, old)
+            params = keep(params, params_in)
+            new_state = keep(new_state, {k: v for k, v in state_in.items()
+                                         if k != "nan_inf_steps"})
+            new_state["nan_inf_steps"] = (
+                state_in.get("nan_inf_steps", jnp.zeros((), jnp.int32))
+                + jnp.where(finite, 0, 1))
+        return params, new_state
 
     def minimize(self, loss_fn, params, state, *args, **kwargs):
         """ref: optimizer.py minimize :641 — returns

@@ -7,6 +7,7 @@ Ref map (reference → here):
   nccl_helper.h rings + gen_nccl_id            → mesh.make_mesh + jax.distributed
   DGC sparse allreduce                         → dgc.sparse_all_reduce
   pserver / distributed_lookup_table           → embedding.ShardedEmbedding
+  SelectedRows grads + PSLib pull/push         → sparse.SparseTable/HostTable
   PipelineTrainer/SectionWorker                → pipeline.make_pipeline_fn
   distributed launch.py                        → launch.py
   LocalSGD (transpiler/collective.py)          → api.local_sgd_sync
@@ -25,7 +26,9 @@ from paddle_tpu.parallel import (
     mesh,
     pipeline,
     ring_attention,
+    sparse,
 )
+from paddle_tpu.parallel.sparse import HostTable, SparseTable
 from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet
 from paddle_tpu.parallel.communicator import (GeoSGD, GradientMerge, LocalSGD,
                                               stack_replicas, unstack_replica)

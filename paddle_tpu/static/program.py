@@ -53,8 +53,23 @@ class Executor:
             self._cache[key] = jax.jit(
                 lambda *a: program.fn(**dict(zip(program.input_names, a))))
         outs = self._cache[key](*[feed[n] for n in program.input_names])
+        from paddle_tpu.core.flags import get_flag
+        if get_flag("check_nan_inf"):
+            # ref flags.cc:44 — validate executor outputs (host-side; the
+            # fetched values are the op-output surface on TPU).
+            from paddle_tpu.core.enforce import check_numerics
+            check_numerics(outs, f"outputs of program '{program.name}'")
         if fetch_list is None:
             return outs
-        if isinstance(outs, dict):
-            return [outs[n] for n in fetch_list]
-        return outs
+        if not isinstance(outs, dict):
+            # Align positional outputs with the program's declared output
+            # names so fetch_list selects by name, matching the reference's
+            # fetch semantics (executor.py:271 fetch-op injection).
+            seq = outs if isinstance(outs, (list, tuple)) else (outs,)
+            enforce(len(seq) == len(program.output_names),
+                    "program returned %d outputs but declares %d names",
+                    len(seq), len(program.output_names))
+            outs = dict(zip(program.output_names, seq))
+        missing = [n for n in fetch_list if n not in outs]
+        enforce(not missing, "unknown fetch names: %s", missing)
+        return [outs[n] for n in fetch_list]

@@ -173,3 +173,57 @@ def test_schedule_in_optimizer():
         loss, params, st, _ = jax.jit(
             lambda p, s: o.minimize(loss_fn, p, s))(params, st)
     assert float(loss) < 5e-3
+
+
+def test_check_nan_inf_flag():
+    """ref flags.cc:44 FLAGS_check_nan_inf: eager raises EnforceError;
+    jitted skips the update and counts the bad step."""
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.core.flags import set_flags
+
+    set_flags({"check_nan_inf": True})
+    try:
+        o = opt.Adam(0.1)
+        params = {"w": jnp.ones(4)}
+        st = o.init(params)
+        assert "nan_inf_steps" in st
+        bad_grads = {"w": jnp.array([1.0, jnp.nan, 1.0, jnp.inf])}
+        good_grads = {"w": jnp.ones(4)}
+
+        # eager: raises naming the bad leaf
+        with pytest.raises(EnforceError, match="nan"):
+            o.apply_gradients(params, bad_grads, st)
+
+        # jitted: skips update, counts
+        step = jax.jit(lambda p, g, s: o.apply_gradients(p, g, s))
+        p2, st2 = step(params, bad_grads, st)
+        np.testing.assert_allclose(np.asarray(p2["w"]), np.ones(4))
+        assert int(st2["nan_inf_steps"]) == 1
+        assert int(st2["step"]) == 0
+        p3, st3 = step(p2, good_grads, st2)
+        assert not np.allclose(np.asarray(p3["w"]), np.ones(4))
+        assert int(st3["nan_inf_steps"]) == 1
+        assert int(st3["step"]) == 1
+
+        # executor fetch path validates outputs host-side
+        from paddle_tpu.static import Executor, program_from_fn
+        prog = program_from_fn(lambda x: {"y": x / x}, ["x"], ["y"])
+        with pytest.raises(EnforceError, match="check_nan_inf"):
+            Executor().run(prog, feed={"x": jnp.zeros(3)},
+                           fetch_list=["y"])
+    finally:
+        set_flags({"check_nan_inf": False})
+
+
+def test_executor_fetch_positional_outputs():
+    """fetch_list must select by name even for tuple-returning programs
+    (reference executor.py fetch semantics)."""
+    from paddle_tpu.core.enforce import EnforceError
+    from paddle_tpu.static import Executor, program_from_fn
+
+    prog = program_from_fn(lambda x: (x + 1, x * 2), ["x"], ["a", "b"])
+    exe = Executor()
+    b, a = exe.run(prog, feed={"x": jnp.asarray(3.0)}, fetch_list=["b", "a"])
+    assert float(b) == 6.0 and float(a) == 4.0
+    with pytest.raises(EnforceError, match="unknown fetch"):
+        exe.run(prog, feed={"x": jnp.asarray(3.0)}, fetch_list=["zzz"])

@@ -1,0 +1,253 @@
+"""Sparse-row embedding gradients + beyond-HBM tables.
+
+Mirrors the reference's SelectedRows semantics tests: sparse optimizer
+updates must equal dense updates for SGD (including duplicate-id merging,
+ref math/selected_rows_functor.cc MergeAdd), and moment-carrying optimizers
+apply lazy-mode row updates (ref adam_op.h sparse branch).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models.ctr import (CTRConfig, DeepFM, ctr_loss,
+                                   make_sparse_deepfm_train_step)
+from paddle_tpu.parallel.sparse import (HostTable, SparseTable, segment_rowsum,
+                                        unique_ids)
+
+
+def test_unique_ids_static_size():
+    ids = jnp.asarray([[5, 3, 5], [3, 3, 9]])
+    uniq, inv, valid = unique_ids(ids)
+    assert uniq.shape == (6,) and inv.shape == ids.shape
+    got = np.asarray(uniq)[np.asarray(valid)]
+    np.testing.assert_array_equal(np.sort(got), [3, 5, 9])
+    # inverse reconstructs the ids
+    np.testing.assert_array_equal(np.asarray(uniq)[np.asarray(inv)],
+                                  np.asarray(ids))
+
+
+def test_segment_rowsum_merges_duplicates():
+    ids = jnp.asarray([1, 4, 1, 1])
+    uniq, inv, valid = unique_ids(ids)
+    cot = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [2.0, 0.0], [4.0, 0.0]])
+    merged = segment_rowsum(cot, inv, uniq.shape[0])
+    m = {int(u): np.asarray(merged[i]) for i, u in enumerate(np.asarray(uniq))
+         if bool(valid[i])}
+    np.testing.assert_allclose(m[1], [7.0, 0.0])
+    np.testing.assert_allclose(m[4], [0.0, 1.0])
+
+
+def _dense_lookup_step(table, ids, cot_fn, opt, opt_state):
+    """Reference dense path: grads via plain take() -> dense [V,D] grad."""
+    def loss(t):
+        emb = jnp.take(t, ids, axis=0)
+        return cot_fn(emb)
+    g = jax.grad(loss)(table)
+    new_t, new_state = opt.apply_gradients(table, g, opt_state)
+    return new_t
+
+
+@pytest.mark.parametrize("dup", [False, True])
+def test_sparse_sgd_matches_dense(dup):
+    """SGD row update == dense update (exact, incl. duplicate merge)."""
+    V, D = 32, 8
+    ids = jnp.asarray([1, 7, 7, 30, 2] if dup else [1, 7, 9, 30, 2])
+    opt = pt.optimizer.SGD(0.1)
+    tbl = SparseTable(V, D, pt.optimizer.SGD(0.1))
+    state = tbl.init(jax.random.key(0))
+    table0 = state["table"]
+
+    def cot_fn(emb):
+        return jnp.sum(jnp.sin(emb) * jnp.arange(
+            emb.size, dtype=emb.dtype).reshape(emb.shape))
+
+    dense_t = _dense_lookup_step(table0, ids, cot_fn, opt,
+                                 opt.init(table0))
+
+    @jax.jit
+    def sparse_step(state):
+        rows, ctx = tbl.pull(state, ids)
+        def loss(r):
+            return cot_fn(tbl.embed(r, ctx))
+        g = jax.grad(loss)(rows)
+        return tbl.push(state, g, ctx)
+
+    new_state = sparse_step(state)
+    np.testing.assert_allclose(np.asarray(new_state["table"]),
+                               np.asarray(dense_t), rtol=1e-6, atol=1e-6)
+
+
+def test_sparse_adam_touches_only_rows():
+    """Lazy-mode semantics: untouched rows (params AND moments) unchanged
+    (ref adam_op.h sparse branch)."""
+    V, D = 16, 4
+    ids = jnp.asarray([3, 5])
+    tbl = SparseTable(V, D, pt.optimizer.Adam(0.05))
+    state = tbl.init(jax.random.key(1))
+    t0 = np.asarray(state["table"])
+
+    @jax.jit
+    def sparse_step(state):
+        rows, ctx = tbl.pull(state, ids)
+        g = jax.grad(lambda r: jnp.sum(tbl.embed(r, ctx) ** 2))(rows)
+        return tbl.push(state, g, ctx)
+
+    st = sparse_step(state)
+    t1 = np.asarray(st["table"])
+    touched = np.zeros(V, bool)
+    touched[[3, 5]] = True
+    assert not np.allclose(t1[touched], t0[touched])
+    np.testing.assert_array_equal(t1[~touched], t0[~touched])
+    for name, slot in st["slots"].items():
+        s = np.asarray(slot)
+        assert np.allclose(s[~touched], 0.0), name
+        assert not np.allclose(s[touched], 0.0), name
+
+
+def test_host_table_matches_sparse_table():
+    """The beyond-HBM host tier applies the same math as the HBM tier."""
+    V, D = 64, 4
+    ids = np.asarray([[4, 9], [4, 60]], np.int32)
+    dev = SparseTable(V, D, pt.optimizer.SGD(0.2))
+    st = dev.init(jax.random.key(2))
+    host = HostTable(V, D, pt.optimizer.SGD(0.2))
+    host.table = np.asarray(st["table"]).copy()
+
+    def cot(emb):
+        return jnp.sum(emb * emb)
+
+    # device step
+    rows, ctx = dev.pull(st, jnp.asarray(ids))
+    g = jax.grad(lambda r: cot(dev.embed(r, ctx)))(rows)
+    st2 = dev.push(st, g, ctx)
+
+    # host step: pull -> device grad on rows -> push
+    hrows, uniq = host.pull(ids)
+    def loss(r):
+        return cot(host.embed_ids(r, uniq, ids))
+    hg = jax.grad(loss)(hrows)
+    host.push(uniq, hg)
+
+    np.testing.assert_allclose(host.table, np.asarray(st2["table"]),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_host_table_beyond_hbm_ctr_training():
+    """CTR flagship trains against a host-resident table larger than a
+    simulated HBM budget (PSLib capability parity, fleet_wrapper.h:76)."""
+    cfg = CTRConfig(num_sparse_fields=4, num_dense_fields=3,
+                    vocab_size=20000, embed_dim=8, hidden=(32, 16))
+    model = DeepFM(cfg, sparse_tables=True)
+    params = model.init(jax.random.key(0))["params"]
+    opt = pt.optimizer.Adam(5e-3)
+    opt_state = opt.init(params)
+
+    # simulated HBM budget: table must exceed it by >= 4x
+    hbm_budget = 512 * 1024  # bytes (simulation)
+    Vtot = cfg.vocab_size * cfg.num_sparse_fields
+    emb_tbl = HostTable(Vtot, cfg.embed_dim, pt.optimizer.SGD(0.1), seed=1)
+    lin_tbl = HostTable(Vtot, 1, pt.optimizer.SGD(0.1), seed=2)
+    assert emb_tbl.nbytes() >= 4 * hbm_budget
+
+    rng = np.random.RandomState(0)
+    B = 32
+    dense_x = rng.rand(B, cfg.num_dense_fields).astype(np.float32)
+    sparse_x = rng.randint(0, cfg.vocab_size,
+                           (B, cfg.num_sparse_fields)).astype(np.int32)
+    labels = rng.randint(0, 2, (B, 1)).astype(np.float32)
+    offsets = np.arange(cfg.num_sparse_fields) * cfg.vocab_size
+    ids = sparse_x + offsets[None, :]
+
+    @jax.jit
+    def grad_step(params, erows, lrows, einv, linv, dense, labels):
+        def loss_fn(p, er, lr_):
+            emb = jnp.take(er, einv, axis=0).reshape(B, cfg.num_sparse_fields,
+                                                     cfg.embed_dim)
+            first = jnp.take(lr_, linv, axis=0).reshape(
+                B, cfg.num_sparse_fields, 1)
+            logits = model.apply({"params": p, "state": {}}, dense, emb,
+                                 first, method="forward_from_emb")
+            return ctr_loss(logits, labels)
+        (loss), grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            params, erows, lrows)
+        return loss, grads
+
+    losses = []
+    # async prefetch of the (constant) batch rows — exercises the PSLib
+    # async pull path
+    emb_tbl.prefetch(ids, tag="step").join()
+    for step in range(12):
+        erows, euniq = emb_tbl.take_prefetched("step")
+        emb_tbl.prefetch(ids, tag="step")
+        lrows, luniq = lin_tbl.pull(ids)
+        einv = jnp.asarray(np.searchsorted(euniq, ids.reshape(-1)))
+        linv = jnp.asarray(np.searchsorted(luniq, ids.reshape(-1)))
+        loss, (gp, ge, gl) = grad_step(params, erows, lrows, einv, linv,
+                                       jnp.asarray(dense_x),
+                                       jnp.asarray(labels))
+        params, opt_state = opt.apply_gradients(params, gp, opt_state)
+        emb_tbl.push(euniq, ge)
+        lin_tbl.push(luniq, gl)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_sparse_deepfm_step_matches_dense_model():
+    """SparseTable DeepFM train step == dense DeepFM train step (SGD)."""
+    cfg = CTRConfig.tiny()
+    dense_model = DeepFM(cfg)
+    sparse_model = DeepFM(cfg, sparse_tables=True)
+    dvars = dense_model.init(jax.random.key(3))
+    dparams = dvars["params"]
+
+    # sparse side: same head params; tables seeded from the dense params
+    sparams = {k: v for k, v in dparams.items()
+               if k not in ("embed", "fm_linear")}
+    Vtot = cfg.vocab_size * cfg.num_sparse_fields
+    emb_tbl = SparseTable(Vtot, cfg.embed_dim, pt.optimizer.SGD(0.1))
+    lin_tbl = SparseTable(Vtot, 1, pt.optimizer.SGD(0.1))
+    emb_st = emb_tbl.init(jax.random.key(4))
+    lin_st = lin_tbl.init(jax.random.key(5))
+    emb_st["table"] = dparams["embed"]["weight"]
+    lin_st["table"] = dparams["fm_linear"]["weight"]
+
+    rng = np.random.RandomState(1)
+    B = 16
+    dense_x = jnp.asarray(rng.rand(B, cfg.num_dense_fields).astype(np.float32))
+    sparse_x = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (B, cfg.num_sparse_fields)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (B, 1)).astype(np.float32))
+
+    opt = pt.optimizer.SGD(0.1)
+    # dense reference step
+    dstate = opt.init(dparams)
+    def dense_loss(p):
+        logits = dense_model.apply({"params": p, "state": {}}, dense_x,
+                                   sparse_x)
+        return ctr_loss(logits, labels)
+    dloss, dgrads = jax.value_and_grad(dense_loss)(dparams)
+    dparams2, _ = opt.apply_gradients(dparams, dgrads, dstate)
+
+    # sparse step
+    sopt_state = opt.init(sparams)
+    step = jax.jit(make_sparse_deepfm_train_step(sparse_model, opt, emb_tbl,
+                                                 lin_tbl))
+    sloss, sparams2, _, emb_st2, lin_st2 = step(
+        sparams, sopt_state, emb_st, lin_st, dense_x, sparse_x, labels)
+
+    np.testing.assert_allclose(float(sloss), float(dloss), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(emb_st2["table"]),
+                               np.asarray(dparams2["embed"]["weight"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lin_st2["table"]),
+                               np.asarray(dparams2["fm_linear"]["weight"]),
+                               rtol=1e-5, atol=1e-6)
+    for k in sparams2:
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            sparams2[k], dparams2[k])

@@ -142,6 +142,26 @@ PJRT_Buffer* ToDevice(const PJRT_Api* api, PJRT_Client* client,
   return args.buffer;
 }
 
+void WritePTPB(const std::string& path,
+               const std::vector<HostTensor>& tensors) {
+  std::ofstream f(path, std::ios::binary);
+  if (!f) Die("cannot write " + path);
+  f.write("PTPB", 4);
+  uint32_t version = 1, n = static_cast<uint32_t>(tensors.size());
+  f.write(reinterpret_cast<const char*>(&version), 4);
+  f.write(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& t : tensors) {
+    uint32_t ndim = static_cast<uint32_t>(t.dims.size());
+    f.write(reinterpret_cast<const char*>(&t.dtype), 4);
+    f.write(reinterpret_cast<const char*>(&ndim), 4);
+    f.write(reinterpret_cast<const char*>(t.dims.data()), 8 * ndim);
+    uint64_t nbytes = t.data.size();
+    f.write(reinterpret_cast<const char*>(&nbytes), 8);
+    f.write(reinterpret_cast<const char*>(t.data.data()),
+            static_cast<std::streamsize>(nbytes));
+  }
+}
+
 }  // namespace
 
 bool FileExists(const std::string& path) {
@@ -150,7 +170,7 @@ bool FileExists(const std::string& path) {
 }
 
 int main(int argc, char** argv) {
-  std::string model_dir, plugin_path;
+  std::string model_dir, plugin_path, dump_outputs;
   int iters = 100, warmup = 10;
   bool train = false;
   for (int i = 1; i < argc; ++i) {
@@ -164,8 +184,10 @@ int main(int argc, char** argv) {
     else if (a == "--iters") iters = atoi(next().c_str());
     else if (a == "--warmup") warmup = atoi(next().c_str());
     else if (a == "--train") train = true;
+    else if (a == "--dump_outputs") dump_outputs = next();
     else Die("unknown flag " + a + " (usage: pt_predictor --model_dir D "
-             "--plugin P [--iters N] [--warmup N] [--train])");
+             "--plugin P [--iters N] [--warmup N] [--train] "
+             "[--dump_outputs F])");
   }
   if (model_dir.empty()) Die("--model_dir is required");
 
@@ -299,7 +321,37 @@ int main(int argc, char** argv) {
     api->PJRT_Event_Destroy(&edargs);
   };
 
+  auto buffer_dtype = [&](PJRT_Buffer* b) -> PJRT_Buffer_Type {
+    PJRT_Buffer_ElementType_Args et;
+    memset(&et, 0, sizeof(et));
+    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    et.buffer = b;
+    CheckErr(api, api->PJRT_Buffer_ElementType(&et), "ElementType");
+    return et.type;
+  };
+
+  auto await_and_free = [&](PJRT_Event* ev) {
+    if (!ev) return;
+    PJRT_Event_Await_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = ev;
+    CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(d2h)");
+    PJRT_Event_Destroy_Args edargs;
+    memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = ev;
+    api->PJRT_Event_Destroy(&edargs);
+  };
+
   auto read_scalar_f32 = [&](PJRT_Buffer* b) -> float {
+    // dtype-checked: an AMP-exported loss could be bf16 — misreading 4 raw
+    // bytes as f32 would report garbage, so fail loudly instead.
+    PJRT_Buffer_Type ty = buffer_dtype(b);
+    if (ty != PJRT_Buffer_Type_F32)
+      Die("train loss output must be f32, got PJRT_Buffer_Type " +
+          std::to_string(static_cast<int>(ty)) +
+          " (cast the loss to float32 before export)");
     float v = 0.0f;
     PJRT_Buffer_ToHostBuffer_Args th;
     memset(&th, 0, sizeof(th));
@@ -308,19 +360,30 @@ int main(int argc, char** argv) {
     th.dst = &v;
     th.dst_size = sizeof(v);
     CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
-    if (th.event) {
-      PJRT_Event_Await_Args eargs;
-      memset(&eargs, 0, sizeof(eargs));
-      eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-      eargs.event = th.event;
-      CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(d2h)");
-      PJRT_Event_Destroy_Args edargs;
-      memset(&edargs, 0, sizeof(edargs));
-      edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-      edargs.event = th.event;
-      api->PJRT_Event_Destroy(&edargs);
-    }
+    await_and_free(th.event);
     return v;
+  };
+
+  auto buffer_to_host = [&](PJRT_Buffer* b) -> HostTensor {
+    HostTensor t;
+    t.dtype = static_cast<uint32_t>(buffer_dtype(b));
+    PJRT_Buffer_Dimensions_Args da;
+    memset(&da, 0, sizeof(da));
+    da.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    da.buffer = b;
+    CheckErr(api, api->PJRT_Buffer_Dimensions(&da), "Dimensions");
+    t.dims.assign(da.dims, da.dims + da.num_dims);
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = b;
+    th.dst = nullptr;  // size query
+    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer(size)");
+    t.data.resize(th.dst_size);
+    th.dst = t.data.data();
+    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
+    await_and_free(th.event);
+    return t;
   };
 
   if (train) {
@@ -349,6 +412,22 @@ int main(int argc, char** argv) {
     printf("{\"mode\": \"train\", \"iters\": %d, \"final_loss\": %.6f, "
            "\"mean_step_ms\": %.3f}\n",
            iters, loss, total_ms / iters);
+    return 0;
+  }
+
+  if (!dump_outputs.empty()) {
+    // one execution, outputs to PTPB — lets tests diff C++ serving output
+    // against the Python forward numerically (ref:
+    // inference/tests/api/ per-model accuracy regressions).
+    execute();
+    std::vector<HostTensor> host_outs;
+    for (auto* b : outputs) {
+      host_outs.push_back(buffer_to_host(b));
+      destroy_buffer(b);
+    }
+    WritePTPB(dump_outputs, host_outs);
+    printf("{\"mode\": \"dump\", \"outputs\": %zu, \"path\": \"%s\"}\n",
+           host_outs.size(), dump_outputs.c_str());
     return 0;
   }
 

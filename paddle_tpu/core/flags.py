@@ -64,6 +64,14 @@ define_flag("host_pinned_staging", True, "Use pinned host staging buffers.")
 define_flag("default_dtype", "float32", "Default floating point dtype.")
 # matmul precision on TPU MXU: 'default' | 'high' | 'highest'
 define_flag("matmul_precision", "default", "jax.lax matmul precision.")
+# conv2d fast backward (physically-transposed dgrad kernels, ~3x on TPU).
+# custom_vjp does not support forward-mode autodiff — disable for jvp/hessian
+define_flag("conv_custom_vjp", True,
+            "Use the TPU-fast custom conv backward (no jvp support).")
+# escape hatch for the Pallas fused layer_norm (ADVICE r1: gate the kernel)
+define_flag("use_pallas_layer_norm", True,
+            "Route layer_norm through the Pallas TPU kernel; False forces "
+            "the XLA twin.")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

@@ -123,3 +123,100 @@ def bucket_for(length, boundaries):
         if length <= b:
             return b
     return boundaries[-1]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class NestedRagged:
+    """Multi-level LoD (ref lod_tensor.h:52 `LoD = vector<Vector<size_t>>`).
+
+    The reference nests sequences-of-sequences (e.g. documents → sentences →
+    words, label_semantic_roles-style models): LoD level 0 groups rows of
+    level 1, whose deltas measure the innermost values. Here each level is a
+    RaggedBatch-style lengths vector:
+
+      values:  [total_innermost, ...] flat concatenation
+      lengths: tuple of int32 vectors, outermost first;
+               lengths[-1] measures rows of `values`, and lengths[k]
+               measures entries of lengths[k+1].
+
+    Example (2 docs; doc0 = 2 sentences of 3,1 words; doc1 = 1 of 2):
+      lengths = ([2, 1], [3, 1, 2]), values.shape[0] == 6.
+    """
+
+    values: jax.Array
+    lengths: tuple
+
+    def tree_flatten(self):
+        return (self.values,) + tuple(self.lengths), len(self.lengths)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], tuple(children[1:]))
+
+    @property
+    def num_levels(self):
+        return len(self.lengths)
+
+    def check(self):
+        for k in range(self.num_levels - 1):
+            enforce(int(jnp.sum(self.lengths[k]))
+                    == int(self.lengths[k + 1].shape[0]),
+                    "level %d lengths must sum to level %d row count",
+                    k, k + 1)
+        enforce(int(jnp.sum(self.lengths[-1])) == int(self.values.shape[0]),
+                "innermost lengths must sum to the value count")
+        return self
+
+    def level(self, k):
+        """RaggedBatch view of level k's rows over the next level's items.
+
+        level(num_levels-1) is the innermost view whose values are the real
+        data; outer levels return lengths-over-lengths views (offsets, as
+        in the reference's multi-level LoD table)."""
+        if k == self.num_levels - 1:
+            return RaggedBatch(self.values, self.lengths[k])
+        return RaggedBatch(self.lengths[k + 1], self.lengths[k])
+
+    def flatten_outer(self):
+        """Drop the outermost level (ref: LoD slicing one level down):
+        sentences stop being grouped by document."""
+        enforce(self.num_levels >= 2, "need >= 2 levels to flatten")
+        return NestedRagged(self.values, tuple(self.lengths[1:]))
+
+    def outer_segment_ids(self):
+        """[total_innermost] outermost-group id per value element — one
+        jnp.repeat chain down the levels (for segment reductions over the
+        outermost grouping, e.g. per-document pooling)."""
+        ids = jnp.arange(self.lengths[0].shape[0], dtype=jnp.int32)
+        for k in range(self.num_levels):
+            total = (int(self.lengths[k + 1].shape[0])
+                     if k + 1 < self.num_levels
+                     else int(self.values.shape[0]))
+            ids = jnp.repeat(ids, self.lengths[k],
+                             total_repeat_length=total)
+        return ids
+
+    @staticmethod
+    def from_parts(values, lengths):
+        """Direct construction: values [total, ...] + per-level lengths
+        (outermost first). Use for feature-valued innermost data."""
+        return NestedRagged(
+            jnp.asarray(values),
+            tuple(jnp.asarray(v, jnp.int32) for v in lengths)).check()
+
+    @staticmethod
+    def from_nested_list(nested, dtype=None):
+        """Host construction from nested python lists of scalars
+        (outermost first), e.g. docs -> sentences -> word ids. For
+        feature-valued leaves use from_parts."""
+        lengths_per_level = []
+        layer = list(nested)
+        while layer and isinstance(layer[0], (list, tuple, np.ndarray)):
+            lengths_per_level.append(
+                np.asarray([len(x) for x in layer], np.int32))
+            layer = [y for x in layer for y in x]
+        enforce(lengths_per_level, "from_nested_list needs nested lists")
+        return NestedRagged(
+            jnp.asarray(np.asarray(layer, dtype=dtype)),
+            tuple(jnp.asarray(v) for v in lengths_per_level)).check()

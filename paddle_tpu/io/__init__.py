@@ -9,6 +9,7 @@ from paddle_tpu.io.checkpoint import (
 from paddle_tpu.io.inference import (
     Predictor,
     load_inference_model,
+    load_program,
     save_inference_model,
     save_train_program,
 )

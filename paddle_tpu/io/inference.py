@@ -37,6 +37,8 @@ def save_inference_model(path, fn, example_args, params):
     hlo_text = lowered.as_text(dialect="stablehlo")
     with open(os.path.join(path, "model.stablehlo"), "w") as f:
         f.write(hlo_text)
+    _write_jax_export(os.path.join(path, "model.jaxexport"), infer_fn,
+                      (params, *example_args))
 
     flat, treedef = jax.tree_util.tree_flatten(params)
     np.savez(os.path.join(path, "params.npz"),
@@ -85,6 +87,8 @@ def save_train_program(path, train_step, state, example_batch):
     lowered = jax.jit(step_flat).lower(*flat, *example_batch)
     with open(os.path.join(path, "model.stablehlo"), "w") as f:
         f.write(lowered.as_text(dialect="stablehlo"))
+    _write_jax_export(os.path.join(path, "model.jaxexport"), step_flat,
+                      (*flat, *example_batch))
     _write_params_bin(os.path.join(path, "params.bin"), flat)
     _write_params_bin(os.path.join(path, "inputs.bin"),
                       [jnp.asarray(a) for a in example_batch])
@@ -139,22 +143,77 @@ def _write_params_bin(path, flat):
             f.write(raw)
 
 
-def load_inference_model(path, fn=None):
-    """Load exported model. With `fn` (the original forward), returns a
-    jitted predictor closure over restored params. Without, returns the
-    raw (stablehlo_text, params_list, signature) for external runtimes
-    (ref: load_inference_model returning program + names)."""
+def _write_jax_export(path, fn, example_args):
+    """Serialize fn as a jax.export artifact lowered for BOTH cpu and tpu,
+    so the same file loads on the serving chip and in CPU CI. This is the
+    parse_from_string side of the ProgramDesc round-trip
+    (ref framework.py:3459): Python can load the artifact back into a
+    runnable program with no access to the original model code."""
+    from jax import export as jexport
+    # export over FLAT leaves so the loader needs no pytree structure
+    flat, treedef = jax.tree_util.tree_flatten(tuple(example_args))
+
+    def flat_fn(*leaves):
+        args = jax.tree_util.tree_unflatten(treedef, leaves)
+        out = fn(*args)
+        return tuple(jax.tree_util.tree_leaves(out)) if not hasattr(
+            out, "shape") else out
+
+    exp = jexport.export(jax.jit(flat_fn), platforms=("cpu", "tpu"))(*flat)
+    with open(path, "wb") as f:
+        f.write(exp.serialize())
+
+
+def load_program(path):
+    """Load a serialized program (model.jaxexport) back into a runnable
+    callable — save→load→run round-trip with no original Python code.
+
+    Ref: framework.py:3459 Program.parse_from_string + io.py:1201
+    load_inference_model. Returns a function of the program's flat inputs
+    (for inference exports: (params_pytree_flattened..., *inputs))."""
+    from jax import export as jexport
+    fp = path if path.endswith(".jaxexport") else os.path.join(
+        path, "model.jaxexport")
+    with open(fp, "rb") as f:
+        exp = jexport.deserialize(f.read())
+
+    def run(*args):
+        return exp.call(*args)
+
+    run.in_avals = exp.in_avals
+    run.out_avals = exp.out_avals
+    return run
+
+
+def load_inference_model(path, raw=False):
+    """Load an exported model. Default: a runnable predictor that executes
+    the serialized program itself via jax.export — the true ProgramDesc
+    round-trip (ref io.py:1201 load_inference_model returns a runnable
+    program, not just bytes; framework.py:3459 parse_from_string). With
+    raw=True: the (stablehlo_text, params_list, signature) triple for
+    external runtimes (the C++ predictor consumes the same artifacts)."""
     with open(os.path.join(path, "signature.json")) as f:
         sig = json.load(f)
     data = np.load(os.path.join(path, "params.npz"))
     flat = [jnp.asarray(data[f"p{i}"]) for i in range(sig["num_params"])]
-    with open(os.path.join(path, "model.stablehlo")) as f:
-        hlo = f.read()
-    if fn is None:
+    if raw:
+        with open(os.path.join(path, "model.stablehlo")) as f:
+            hlo = f.read()
         return hlo, flat, sig
-    raise NotImplementedError(
-        "pass params pytree explicitly; treedef round-trip via "
-        "Predictor")
+    if not os.path.exists(os.path.join(path, "model.jaxexport")):
+        from paddle_tpu.core.enforce import EnforceError
+        raise EnforceError(
+            f"{path} has no model.jaxexport (exported by an older version?) "
+            "— re-export with save_inference_model, or pass raw=True for "
+            "the (stablehlo, params, signature) triple")
+    prog = load_program(path)
+
+    def predictor(*inputs):
+        return prog(*flat, *inputs)
+
+    predictor.signature = sig
+    predictor.params = flat
+    return predictor
 
 
 class Predictor:
@@ -171,3 +230,40 @@ class Predictor:
         return self._jit(self.params, *inputs)
 
     __call__ = run
+
+
+_PJRT_DTYPE_INV = {v: k for k, v in _PJRT_DTYPE.items()}
+
+
+def read_params_bin(path):
+    """Parse a PTPB tensor archive (params.bin / predictor --dump_outputs)
+    back into numpy arrays — the Python side of the C++ serving contract."""
+    import struct
+    out = []
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:4] != b"PTPB":
+        raise ValueError(f"{path}: bad magic")
+    version, n = struct.unpack_from("<II", blob, 4)
+    if version != 1:
+        raise ValueError(f"{path}: unsupported version {version}")
+    off = 12
+    for _ in range(n):
+        code, ndim = struct.unpack_from("<II", blob, off)
+        off += 8
+        dims = struct.unpack_from(f"<{ndim}q", blob, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        raw = blob[off:off + nbytes]
+        off += nbytes
+        if code == 13:  # bf16: widen via uint16 -> float32
+            u = np.frombuffer(raw, np.uint16).astype(np.uint32) << 16
+            arr = u.view(np.float32).reshape(dims)
+        else:
+            dt = _PJRT_DTYPE_INV.get(code)
+            if dt is None:
+                raise ValueError(f"{path}: unknown dtype code {code}")
+            arr = np.frombuffer(raw, dt).reshape(dims)
+        out.append(arr)
+    return out

@@ -26,6 +26,7 @@ from paddle_tpu.ops import (
     control_flow,
     crf,
     detection,
+    graph,
     loss,
     math,
     metrics_ops,
@@ -33,6 +34,7 @@ from paddle_tpu.ops import (
     rnn,
     sequence,
     tensor_ops,
+    text_match,
     vision,
 )
 from paddle_tpu.ops.activations import *  # noqa: F401,F403

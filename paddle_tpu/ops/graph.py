@@ -1,0 +1,114 @@
+"""Tree/graph ops: tree_conv (TBCNN tree-based convolution).
+
+Ref: /root/reference/paddle/fluid/operators/tree_conv_op.{cc,h} +
+operators/math/tree2col.{h,cc}. The reference builds, per sample, a patch
+for every node (DFS to max_depth) with three continuous-binary-tree weights
+per visited node (eta_t/eta_l/eta_r, tree2col.h:34-52), then one GEMM
+patch x Filter.
+
+TPU-first split: the tree walk is irregular, data-dependent host work →
+``tree_patch_coefficients`` precomputes (numpy) a dense coefficient tensor
+coef[b, root, node, 3] from the edge sets once per batch. The device op
+``tree_conv`` is then a single einsum over (coef, features, filter) — the
+whole batch in one MXU contraction instead of per-sample GEMMs. Gradients
+flow through features and filter via autodiff (coef is data, like the
+reference where Col2Tree replays the same structure).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.registry import register_op
+
+
+def _build_adjacency(edges):
+    """edges [E, 2] int (1-indexed parent->child, (0,0)-terminated).
+    Returns (children dict, node_count). Mirrors tree2col.cc
+    construct_tree: rows after the first (0,0) are ignored."""
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        node_count += 1
+        tr.setdefault(u, []).append(v)
+    return tr, node_count + 1
+
+
+def _patch(root, max_depth, tr):
+    """DFS patch of (node, index, pclen, depth) — tree2col.cc
+    construct_patch, iterative stack walk with a visited set."""
+    out = [(root, 1, 1, 0)]
+    stack = [(root, 1, 1, 0)]
+    visited = {root}
+    while stack:
+        node, idx, pclen, depth = stack[-1]
+        end = True
+        kids = tr.get(node, [])
+        for i, v in enumerate(kids):
+            if v not in visited and depth + 1 < max_depth:
+                visited.add(v)
+                stack.append((v, i, len(kids), depth + 1))
+                out.append((v, i + 1, len(kids), depth + 1))
+                end = False
+        if end:
+            stack.pop()
+    return out
+
+
+def tree_patch_coefficients(edge_sets, n_nodes, max_depth):
+    """Host-side tree2col: edge_sets [B, E, 2] (numpy/int) →
+    coef [B, n_nodes, n_nodes, 3] float32 with
+    coef[b, root-1, node-1] = (eta_l, eta_r, eta_t) of node in root's patch.
+    """
+    edge_sets = np.asarray(edge_sets)
+    B = edge_sets.shape[0]
+    coef = np.zeros((B, n_nodes, n_nodes, 3), np.float32)
+    fd = float(max_depth)
+    for b in range(B):
+        tr, node_count = _build_adjacency(edge_sets[b])
+        for root in range(1, node_count + 1):
+            for node, idx, pclen, depth in _patch(root, max_depth, tr):
+                eta_t = (fd - depth) / fd
+                if pclen == 1:
+                    tmp = 0.5
+                else:
+                    tmp = (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * tmp
+                eta_r = (1.0 - eta_t) * (1.0 - tmp)
+                # += : revisits accumulate, matching tree2col.cc's
+                # patch_data[...] += eta * feature
+                coef[b, root - 1, node - 1, 0] += eta_l
+                coef[b, root - 1, node - 1, 1] += eta_r
+                coef[b, root - 1, node - 1, 2] += eta_t
+    return coef
+
+
+@register_op("tree_conv")
+def tree_conv(nodes_vector, coef, filter):
+    """TBCNN convolution (device op).
+
+    nodes_vector: [B, N, F] node embeddings
+    coef:         [B, N, N, 3] from tree_patch_coefficients
+    filter:       [F, 3, O, M] (feature, eta-slot, output_size, num_filters)
+    Returns [B, N, O, M] — out[b, root] = patch(root) @ Filter, zero for
+    roots past the sample's node count (their coef rows are all-zero).
+    """
+    enforce(filter.ndim == 4 and filter.shape[1] == 3,
+            "tree_conv filter must be [F, 3, output_size, num_filters]")
+    # patch[b, r, f, k] = sum_n coef[b,r,n,k] * feat[b,n,f]
+    patch = jnp.einsum("brnk,bnf->brfk", coef, nodes_vector)
+    return jnp.einsum("brfk,fkom->brom", patch, filter)
+
+
+def tree_conv_layer(nodes_vector, edge_set, filter, max_depth):
+    """Convenience wrapper matching the reference layer signature
+    (layers/nn.py tree_conv): host-builds coefficients, then runs the op.
+    edge_set must be concrete (host) data."""
+    n = nodes_vector.shape[1]
+    coef = jnp.asarray(tree_patch_coefficients(np.asarray(edge_set), n,
+                                               max_depth))
+    return tree_conv(nodes_vector, coef, filter)

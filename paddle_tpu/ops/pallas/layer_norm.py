@@ -85,7 +85,10 @@ def _stats_xla(x2d, gamma, beta, epsilon):
 
 
 def _stats(x2d, gamma, beta, epsilon):
-    if on_tpu():
+    # escape hatch (ADVICE r1): PT_FLAGS_use_pallas_layer_norm=0 forces the
+    # XLA twin if the Pallas kernel misbehaves on some shape/hardware
+    from paddle_tpu.core.flags import get_flag
+    if on_tpu() and get_flag("use_pallas_layer_norm"):
         return _stats_pallas(x2d, gamma, beta, epsilon)
     return _stats_xla(x2d, gamma, beta, epsilon)
 

@@ -295,3 +295,34 @@ def add_position_encoding(x, alpha=1.0, beta=1.0):
     if enc.shape[-1] < D:
         enc = jnp.pad(enc, ((0, 0), (0, D - enc.shape[-1])))
     return alpha * x + beta * enc[None]
+
+
+@register_op("ctc_align")
+def ctc_align(tokens, lengths=None, blank=0, merge_repeated=True,
+              padding_value=0):
+    """CTC decode alignment: drop blanks and (optionally) collapse repeats.
+
+    Ref: operators/ctc_align_op.h — for each position in order, keep
+    token iff token != blank and not (merge_repeated and token == previous
+    raw token); prev tracks the RAW stream (so a blank between repeats
+    un-merges them).
+
+    tokens [B, T] int; lengths [B] (None = all T valid).
+    Returns (aligned [B, T] padded with padding_value, out_lengths [B]) —
+    the static-shape twin of the reference's LoD-shrinking output.
+    """
+    B, T = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, tokens.dtype), tokens[:, :-1]], axis=1)
+    in_range = jnp.arange(T)[None, :] < lengths[:, None]
+    keep = (tokens != blank) & in_range
+    if merge_repeated:
+        keep &= tokens != prev
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1     # target slot
+    out = jnp.full((B, T), padding_value, tokens.dtype)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    cols = jnp.where(keep, pos, T)                           # T -> dropped
+    out = out.at[rows, cols].set(tokens, mode="drop")
+    return out, jnp.sum(keep, axis=1).astype(jnp.int32)

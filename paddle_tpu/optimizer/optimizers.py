@@ -31,6 +31,10 @@ class Optimizer:
         self.lr = make_schedule(learning_rate)
         self.regularization = regularization
         self.grad_clip = grad_clip
+        # bound at construction so the state pytree structure is stable for
+        # this instance even if the global flag is toggled mid-run
+        from paddle_tpu.core.flags import get_flag
+        self._check_nan_inf = get_flag("check_nan_inf")
 
     # -- subclass API --
     def slots(self, p):
@@ -47,8 +51,7 @@ class Optimizer:
             "slots": _tmap(lambda p: self.slots(p), params,
                            ),
         }
-        from paddle_tpu.core.flags import get_flag
-        if get_flag("check_nan_inf"):
+        if self._check_nan_inf:
             # ref flags.cc:44 FLAGS_check_nan_inf. Under jit the step can't
             # raise, so bad steps are *skipped* and counted here; eager calls
             # raise EnforceError immediately (see apply_gradients).
@@ -59,13 +62,14 @@ class Optimizer:
         """ref: optimizer.py apply_gradients :557 (clip → regularize →
         per-param update ops).
 
-        With flag check_nan_inf set (ref flags.cc:44): eager calls raise
-        EnforceError on non-finite gradients; traced (jit) calls skip the
-        whole update and increment state['nan_inf_steps'] instead, since
-        device code cannot raise on TPU (no host callbacks on PJRT tunnel).
+        With flag check_nan_inf set at construction (ref flags.cc:44): eager
+        calls raise EnforceError on non-finite gradients; traced (jit) calls
+        skip the whole update and increment state['nan_inf_steps'] instead,
+        since device code cannot raise on TPU (no host callbacks on the PJRT
+        tunnel). The flag is bound in __init__ so the state structure can't
+        change mid-run.
         """
-        from paddle_tpu.core.flags import get_flag
-        check = get_flag("check_nan_inf")
+        check = self._check_nan_inf
         grads_in = grads
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)

@@ -35,29 +35,71 @@ class ExponentialMovingAverage:
 
 
 class ModelAverage:
-    """ref: optimizer.py:2449 — running accumulation of params over a window;
-    apply() yields sum/num for eval."""
+    """ref: optimizer.py:2449 ModelAverage +
+    operators/average_accumulates_op.h — the full reference window policy:
+
+      per update:  num_updates+=1; num_accumulates+=1; sum_1 += p
+      precision:   every 16384 updates, fold sum_1 into sum_2
+      restart:     when num_accumulates >= min_average_window AND
+                   >= min(max_average_window, num_updates*average_window_rate)
+                   -> sum_3 = sum_1+sum_2; sum_1=sum_2=0;
+                      old_num_accumulates = num_accumulates; num_accumulates=0
+      apply():     (sum_1+sum_2+sum_3) / (num_accumulates+old_num_accumulates)
+    """
+
+    _MAX_NUM_ACCUMULATES = 16384  # kMaxNumAccumulates, avg_accumulates_op.h:45
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000):
+        from paddle_tpu.core.enforce import enforce_le
+        enforce_le(min_average_window, max_average_window,
+                   "min_average_window shouldn't be larger than "
+                   "max_average_window")
+        self.rate = average_window_rate
+        self.min_window = min_average_window
         self.max_window = max_average_window
 
     def init(self, params):
-        return {"sum": jax.tree_util.tree_map(jnp.zeros_like, params),
-                "num": jnp.zeros((), jnp.float32)}
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"sum_1": zeros(), "sum_2": zeros(), "sum_3": zeros(),
+                "num_updates": jnp.zeros((), jnp.int64
+                                         if jax.config.jax_enable_x64
+                                         else jnp.int32),
+                "num_accumulates": jnp.zeros((), jnp.int32),
+                "old_num_accumulates": jnp.zeros((), jnp.int32)}
 
     def update(self, st, params):
-        num = st["num"] + 1
-        s = jax.tree_util.tree_map(lambda a, p: a + p, st["sum"], params)
-        # restart window when exceeding max (simplified restart policy)
-        reset = num > self.max_window
-        num = jnp.where(reset, 1.0, num)
-        s = jax.tree_util.tree_map(
-            lambda a, p: jnp.where(reset, p, a), s, params)
-        return {"sum": s, "num": num}
+        tmap = jax.tree_util.tree_map
+        num_updates = st["num_updates"] + 1
+        num_acc = st["num_accumulates"] + 1
+        s1 = tmap(lambda a, p: a + p, st["sum_1"], params)
+        s2, s3 = st["sum_2"], st["sum_3"]
+        # precision fold (avg_accumulates_op.h:88)
+        fold = (num_updates % self._MAX_NUM_ACCUMULATES) == 0
+        s2 = tmap(lambda b, a: jnp.where(fold, b + a, b), s2, s1)
+        s1 = tmap(lambda a: jnp.where(fold, jnp.zeros_like(a), a), s1)
+        # window restart (avg_accumulates_op.h:94)
+        window = jnp.minimum(
+            jnp.asarray(float(self.max_window)),
+            num_updates.astype(jnp.float32) * self.rate)
+        restart = (num_acc >= self.min_window) & \
+            (num_acc.astype(jnp.float32) >= window)
+        s3 = tmap(lambda c, a, b: jnp.where(restart, a + b, c), s3, s1, s2)
+        s1 = tmap(lambda a: jnp.where(restart, jnp.zeros_like(a), a), s1)
+        s2 = tmap(lambda b: jnp.where(restart, jnp.zeros_like(b), b), s2)
+        old_num = jnp.where(restart, num_acc, st["old_num_accumulates"])
+        num_acc = jnp.where(restart, 0, num_acc)
+        return {"sum_1": s1, "sum_2": s2, "sum_3": s3,
+                "num_updates": num_updates, "num_accumulates": num_acc,
+                "old_num_accumulates": old_num}
 
     def apply(self, st):
-        return jax.tree_util.tree_map(lambda a: a / st["num"], st["sum"])
+        denom = (st["num_accumulates"]
+                 + st["old_num_accumulates"]).astype(jnp.float32)
+        denom = jnp.maximum(denom, 1.0)
+        return jax.tree_util.tree_map(
+            lambda a, b, c: (a + b + c) / denom,
+            st["sum_1"], st["sum_2"], st["sum_3"])
 
 
 class Lookahead:

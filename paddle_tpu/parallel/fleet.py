@@ -147,16 +147,33 @@ class Fleet:
 
     def barrier(self, directory=None, tag="fleet", timeout_s=300.0):
         """Worker barrier (ref: fleet_base barrier_worker). In-process
-        single-host: no-op; cross-process: file barrier on a shared dir."""
+        single-host: no-op; cross-process: file barrier on a shared dir.
+
+        The generation counter is derived from this worker's own marker
+        files in the shared directory, not in-memory state: a worker that
+        restarts mid-job resumes at the generation its peers are waiting on
+        instead of resetting to 1 and deadlocking every later barrier."""
         if directory is None or self.worker_num == 1:
             return
+        import os
+        import re
         from paddle_tpu.parallel.heartbeat import barrier_with_timeout
-        # generation counter: barrier files are one-shot per tag, so each
-        # call uses a fresh tag (all workers call in the same order)
+        if self._barrier_gen == 0:
+            # first barrier after (re)start: recover the generation from our
+            # own marker files; later calls just increment the cached value
+            # (no per-sync directory scan)
+            pat = re.compile(re.escape(tag) + r"-(\d+)\." +
+                             re.escape(str(self.worker_index)) + r"$")
+            if os.path.isdir(directory):
+                for name in os.listdir(directory):
+                    m = pat.match(name)
+                    if m:
+                        self._barrier_gen = max(self._barrier_gen,
+                                                int(m.group(1)))
         self._barrier_gen += 1
+        gen = self._barrier_gen
         barrier_with_timeout(directory, self.worker_index, self.worker_num,
-                             timeout_s=timeout_s,
-                             tag=f"{tag}-{self._barrier_gen}")
+                             timeout_s=timeout_s, tag=f"{tag}-{gen}")
 
 
 fleet = Fleet()

@@ -315,3 +315,35 @@ class TestStrategyComposition:
         # single-process worker_num == 1 -> no-op both times
         f.barrier(str(tmp_path))
         f.barrier(str(tmp_path))
+
+    def test_fleet_barrier_generation_survives_restart(self, tmp_path):
+        """A worker that restarts (fresh Fleet, gen reset) must resume at
+        the generation its peers are waiting on (ADVICE r1: persist the
+        generation in the shared dir, not process memory)."""
+        import threading
+
+        def mk(worker):
+            class FakeWorkerFleet(pt.parallel.Fleet):
+                worker_index = worker
+                worker_num = 2
+            f = FakeWorkerFleet()
+            f.init()
+            return f
+
+        f0, f1 = mk(0), mk(1)
+        for _ in range(3):  # advance both to gen 3
+            t = threading.Thread(
+                target=lambda: f1.barrier(str(tmp_path), timeout_s=10))
+            t.start()
+            f0.barrier(str(tmp_path), timeout_s=10)
+            t.join()
+        assert f0._barrier_gen == 3
+
+        f0b = mk(0)  # "restarted" worker 0: in-memory gen lost
+        t = threading.Thread(
+            target=lambda: f1.barrier(str(tmp_path), timeout_s=10))
+        t.start()
+        f0b.barrier(str(tmp_path), timeout_s=10)  # must land on gen 4
+        t.join()
+        assert f0b._barrier_gen == 4
+        assert f1._barrier_gen == 4

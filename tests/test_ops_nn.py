@@ -204,3 +204,70 @@ class TestFC:
         x, w = r((2, 3, 4)), r((12, 5), 1)
         out = F.fc(jnp.asarray(x), jnp.asarray(w))
         assert out.shape == (2, 5)
+
+
+class TestConvCustomVjp:
+    """The physically-transposed dgrad (TPU fast path) must match jax's
+    native conv transpose rule exactly, across layouts/strides/pads."""
+
+    @pytest.mark.parametrize("df", ["NCHW", "NHWC"])
+    @pytest.mark.parametrize("stride,padding,dilation,k", [
+        (1, 0, 1, 1), (1, 1, 1, 3), (2, 1, 1, 3), (2, 3, 1, 7),
+        (1, 2, 2, 3), (2, "SAME", 1, 3), (1, "VALID", 1, 3),
+    ])
+    def test_dgrad_matches_native(self, df, stride, padding, dilation, k):
+        rng = np.random.RandomState(0)
+        B, CI, CO, H = 2, 5, 7, 12
+        x_nchw = rng.rand(B, CI, H, H).astype(np.float32)
+        w_oihw = rng.rand(CO, CI, k, k).astype(np.float32) * 0.2
+        if df == "NCHW":
+            x, w = jnp.asarray(x_nchw), jnp.asarray(w_oihw)
+        else:
+            x = jnp.asarray(x_nchw.transpose(0, 2, 3, 1))
+            w = jnp.asarray(w_oihw.transpose(2, 3, 1, 0))
+
+        def custom(x, w):
+            return jnp.sum(jnp.sin(F.conv2d(
+                x, w, stride=stride, padding=padding, dilation=dilation,
+                data_format=df)))
+
+        def native(x, w):
+            s, d = (stride, stride), (dilation, dilation)
+            if isinstance(padding, str):
+                pad = padding
+            else:
+                pad = [(padding, padding)] * 2
+            dn = jax.lax.conv_dimension_numbers(
+                x.shape, w.shape,
+                (df, "OIHW" if df == "NCHW" else "HWIO", df))
+            out = jax.lax.conv_general_dilated(
+                x, w, window_strides=s, padding=pad, rhs_dilation=d,
+                dimension_numbers=dn)
+            return jnp.sum(jnp.sin(out))
+
+        gx_c, gw_c = jax.grad(custom, argnums=(0, 1))(x, w)
+        gx_n, gw_n = jax.grad(native, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx_c), np.asarray(gx_n),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(gw_c), np.asarray(gw_n),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_conv_custom_vjp_escape_hatch_restores_jvp():
+    """custom_vjp has no forward-mode rule; flag conv_custom_vjp=False
+    must restore jvp/hessian capability through convs."""
+    from paddle_tpu.core.flags import set_flags
+    x = jnp.ones((1, 2, 5, 5))
+    w = jnp.ones((3, 2, 3, 3)) * 0.1
+    with pytest.raises(Exception):
+        jax.jvp(lambda w: F.conv2d(x, w, padding=1), (w,), (w,))
+    set_flags({"conv_custom_vjp": False})
+    try:
+        out, tangent = jax.jvp(lambda w: F.conv2d(x, w, padding=1),
+                               (w,), (w,))
+        assert out.shape == tangent.shape == (1, 3, 5, 5)
+        # grads still correct on the native path
+        g = jax.grad(lambda w: jnp.sum(F.conv2d(x, w, padding=1) ** 2))(w)
+        assert np.isfinite(np.asarray(g)).all()
+    finally:
+        set_flags({"conv_custom_vjp": True})

@@ -1,0 +1,316 @@
+"""Op-surface tail: tree_conv, var_conv_2d, match_matrix_tensor, ctc_align,
+sequence_topk_avg_pooling, fsp_matrix (VERDICT r1 item 10).
+
+Each test checks against a straight-line numpy re-derivation of the
+reference C++ kernel (op_test.py golden-test pattern, SURVEY.md §4), plus a
+numeric-gradient check for the differentiable ones.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.graph import (tree_conv, tree_conv_layer,
+                                  tree_patch_coefficients)
+from paddle_tpu.ops.nn import fsp_matrix
+from paddle_tpu.ops.sequence import ctc_align
+from paddle_tpu.ops.text_match import (match_matrix_tensor,
+                                       sequence_topk_avg_pooling,
+                                       var_conv_2d)
+
+
+def numeric_grad(f, x, eps=1e-3):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestFSPMatrix:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 4, 5).astype(np.float32)
+        y = rng.rand(2, 6, 4, 5).astype(np.float32)
+        out = np.asarray(fsp_matrix(jnp.asarray(x), jnp.asarray(y)))
+        # ref fsp_op.h: batched (C1, HW) @ (HW, C2) / (H*W)
+        for b in range(2):
+            ref = x[b].reshape(3, -1) @ y[b].reshape(6, -1).T / 20.0
+            np.testing.assert_allclose(out[b], ref, rtol=1e-5)
+
+    def test_numeric_grad(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 2, 3, 3).astype(np.float64)
+        y = rng.rand(1, 2, 3, 3).astype(np.float64)
+        w = rng.rand(1, 2, 2)
+
+        def loss_np(xv):
+            o = np.einsum("bchw,bdhw->bcd", xv, y) / 9.0
+            return float((o * w).sum())
+
+        g_num = numeric_grad(loss_np, x)
+        g_ana = jax.grad(lambda xv: jnp.sum(
+            fsp_matrix(xv, jnp.asarray(y)) * jnp.asarray(w)))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g_ana), g_num, rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestCtcAlign:
+    def _ref(self, tokens, lengths, blank, merge):
+        # ctc_align_op.h loop
+        B, T = tokens.shape
+        out = np.zeros_like(tokens)
+        out_len = np.zeros(B, np.int32)
+        for b in range(B):
+            prev, j = -1, 0
+            for i in range(lengths[b]):
+                t = tokens[b, i]
+                if t != blank and not (merge and t == prev):
+                    out[b, j] = t
+                    j += 1
+                prev = t
+            out_len[b] = j
+        return out, out_len
+
+    @pytest.mark.parametrize("merge", [True, False])
+    def test_matches_reference(self, merge):
+        rng = np.random.RandomState(0)
+        tokens = rng.randint(0, 4, (5, 11)).astype(np.int32)
+        lengths = rng.randint(0, 12, (5,)).astype(np.int32)
+        got, got_len = ctc_align(jnp.asarray(tokens), jnp.asarray(lengths),
+                                 blank=0, merge_repeated=merge)
+        ref, ref_len = self._ref(tokens, lengths, 0, merge)
+        np.testing.assert_array_equal(np.asarray(got_len), ref_len)
+        for b in range(5):
+            np.testing.assert_array_equal(
+                np.asarray(got)[b, :ref_len[b]], ref[b, :ref_len[b]])
+            assert np.all(np.asarray(got)[b, ref_len[b]:] == 0)
+
+    def test_blank_unmerges_repeats(self):
+        # classic CTC property: a-blank-a collapses to a,a
+        out, n = ctc_align(jnp.asarray([[1, 0, 1, 1, 2]]), blank=0)
+        assert int(n[0]) == 3
+        np.testing.assert_array_equal(np.asarray(out)[0, :3], [1, 1, 2])
+
+
+class TestMatchMatrixTensor:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(0)
+        B, L, R, D, T = 3, 5, 4, 6, 2
+        x = rng.rand(B, L, D).astype(np.float32)
+        y = rng.rand(B, R, D).astype(np.float32)
+        w = rng.rand(D, T, D).astype(np.float32)
+        x_lens = np.asarray([5, 3, 0], np.int32)
+        y_lens = np.asarray([2, 4, 1], np.int32)
+        out = np.asarray(match_matrix_tensor(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(x_lens), jnp.asarray(y_lens)))
+        assert out.shape == (B, T, L, R)
+        for b in range(B):
+            for t in range(T):
+                for i in range(L):
+                    for j in range(R):
+                        if i < x_lens[b] and j < y_lens[b]:
+                            ref = x[b, i] @ w[:, t, :] @ y[b, j]
+                        else:
+                            ref = 0.0
+                        assert out[b, t, i, j] == pytest.approx(ref,
+                                                                rel=1e-4)
+
+    def test_grad_flows(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.rand(2, 3, 4).astype(np.float32))
+        y = jnp.asarray(rng.rand(2, 3, 4).astype(np.float32))
+        w = jnp.asarray(rng.rand(4, 2, 4).astype(np.float32))
+        lens = jnp.asarray([3, 2])
+        g = jax.grad(lambda w: jnp.sum(
+            match_matrix_tensor(x, y, w, lens, lens) ** 2))(w)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestVarConv2D:
+    def _ref(self, x, row_lens, col_lens, w, stride):
+        # var_conv_2d_op.cc Im2Col + GEMM, re-derived directly
+        B, C, H, W = x.shape
+        O, _, kh, kw = w.shape
+        oh = -(-H // stride)
+        ow = -(-W // stride)
+        out = np.zeros((B, O, oh, ow), np.float32)
+        for b in range(B):
+            h, wd = row_lens[b], col_lens[b]
+            if h == 0 or wd == 0:
+                continue
+            for o in range(O):
+                for yy in range(0, h, stride):
+                    for xx in range(0, wd, stride):
+                        acc = 0.0
+                        for c in range(C):
+                            for ky in range(kh):
+                                for kx in range(kw):
+                                    iy = yy + ky - kh // 2
+                                    ix = xx + kx - kw // 2
+                                    if 0 <= iy < h and 0 <= ix < wd:
+                                        acc += w[o, c, ky, kx] * x[b, c, iy, ix]
+                        out[b, o, yy // stride, xx // stride] = acc
+        return out
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_matches_reference(self, stride):
+        rng = np.random.RandomState(0)
+        B, C, H, W, O, k = 2, 2, 6, 5, 3, 3
+        x = rng.rand(B, C, H, W).astype(np.float32)
+        w = rng.rand(O, C, k, k).astype(np.float32)
+        row_lens = np.asarray([6, 3], np.int32)
+        col_lens = np.asarray([4, 5], np.int32)
+        got = np.asarray(var_conv_2d(
+            jnp.asarray(x), jnp.asarray(row_lens), jnp.asarray(col_lens),
+            jnp.asarray(w), stride=stride))
+        ref = self._ref(x, row_lens, col_lens, w, stride)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestSequenceTopkAvgPooling:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(0)
+        B, C, H, W = 2, 3, 4, 6
+        topks = [1, 3, 5]
+        x = rng.rand(B, C, H, W).astype(np.float32)
+        row_lens = np.asarray([4, 2], np.int32)
+        col_lens = np.asarray([3, 6], np.int32)
+        got = np.asarray(sequence_topk_avg_pooling(
+            jnp.asarray(x), jnp.asarray(row_lens), jnp.asarray(col_lens),
+            topks))
+        assert got.shape == (B, H, C * len(topks))
+        for b in range(B):
+            for r in range(H):
+                for c in range(C):
+                    for ki, k in enumerate(topks):
+                        if r < row_lens[b]:
+                            vals = np.sort(x[b, c, r, :col_lens[b]])[::-1]
+                            ref = vals[:k].sum() / k    # divisor stays k
+                        else:
+                            ref = 0.0
+                        assert got[b, r, c * len(topks) + ki] == \
+                            pytest.approx(ref, rel=1e-4), (b, r, c, k)
+
+    def test_grad_flows(self):
+        x = jnp.asarray(np.random.RandomState(1).rand(1, 2, 3, 4),
+                        jnp.float32)
+        lens = jnp.asarray([3]), jnp.asarray([4])
+        g = jax.grad(lambda x: jnp.sum(sequence_topk_avg_pooling(
+            x, lens[0], lens[1], [2]) ** 2))(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestTreeConv:
+    def test_single_chain_tree_coefficients(self):
+        # tree 1 -> 2 -> 3 (chain), max_depth 2: patch(1) = {1, 2};
+        # patch(2) = {2, 3}; patch(3) = {3}
+        edges = np.asarray([[[1, 2], [2, 3], [0, 0]]], np.int32)
+        coef = tree_patch_coefficients(edges, 4, max_depth=2)
+        fd = 2.0
+        # root node itself: depth 0 -> eta_t = 1, pclen 1 -> eta_l = 0
+        assert coef[0, 0, 0, 2] == pytest.approx(1.0)
+        assert coef[0, 0, 0, 0] == pytest.approx(0.0)
+        # child at depth 1: eta_t = 0.5; only-child -> tmp = 0.5
+        assert coef[0, 0, 1, 2] == pytest.approx(0.5)
+        assert coef[0, 0, 1, 0] == pytest.approx(0.25)
+        assert coef[0, 0, 1, 1] == pytest.approx(0.25)
+        # depth-2 node not in patch (depth+1 < max_depth gate)
+        assert np.all(coef[0, 0, 2] == 0)
+        # node 4 (beyond node_count) has no patch
+        assert np.all(coef[0, 3] == 0)
+
+    def test_matches_reference_math(self):
+        """out[root] = patch @ Filter with interleaved (l, r, t) rows —
+        re-derive the tree2col + GEMM directly."""
+        rng = np.random.RandomState(0)
+        N, F, O, M = 5, 3, 2, 4
+        edges = np.asarray([[[1, 2], [1, 3], [3, 4], [0, 0]]], np.int32)
+        nodes = rng.rand(1, N, F).astype(np.float32)
+        filt = rng.rand(F, 3, O, M).astype(np.float32)
+        coef = tree_patch_coefficients(edges, N, max_depth=3)
+        out = np.asarray(tree_conv(jnp.asarray(nodes), jnp.asarray(coef),
+                                   jnp.asarray(filt)))
+        assert out.shape == (1, N, O, M)
+        # independent reference: patch vector per root then matmul
+        W2 = filt.reshape(F * 3, O * M)  # rows ordered (f, k)
+        for root in range(N):
+            patch = np.zeros(F * 3, np.float32)
+            for node in range(N):
+                for k in range(3):
+                    patch[np.arange(F) * 3 + k] += \
+                        coef[0, root, node, k] * nodes[0, node]
+            # reference flatten_to_2d(Filter, 2) rows are (f, k) pairs with
+            # k fastest — patch above interleaves identically
+            ref = patch.reshape(F, 3).reshape(F * 3) @ W2
+            np.testing.assert_allclose(out[0, root].reshape(-1), ref,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_layer_wrapper_and_grad(self):
+        rng = np.random.RandomState(2)
+        edges = jnp.asarray([[[1, 2], [1, 3], [0, 0]]], jnp.int32)
+        nodes = jnp.asarray(rng.rand(1, 4, 3).astype(np.float32))
+        filt = jnp.asarray(rng.rand(3, 3, 2, 2).astype(np.float32))
+        out = tree_conv_layer(nodes, edges, filt, max_depth=2)
+        assert out.shape == (1, 4, 2, 2)
+        g = jax.grad(lambda f: jnp.sum(
+            tree_conv_layer(nodes, edges, f, max_depth=2) ** 2))(filt)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestNestedRagged:
+    """Multi-level LoD (ref lod_tensor.h:52) — VERDICT r1 missing item 5."""
+
+    def test_levels_and_segments(self):
+        from paddle_tpu.core.ragged import NestedRagged
+        # 2 docs: doc0 = [[1,2,3],[4]], doc1 = [[5,6]]
+        nr = NestedRagged.from_nested_list([[[1, 2, 3], [4]], [[5, 6]]])
+        assert nr.num_levels == 2
+        np.testing.assert_array_equal(np.asarray(nr.lengths[0]), [2, 1])
+        np.testing.assert_array_equal(np.asarray(nr.lengths[1]), [3, 1, 2])
+        np.testing.assert_array_equal(np.asarray(nr.values), [1, 2, 3, 4, 5, 6])
+
+        inner = nr.level(1)      # sentences over words
+        np.testing.assert_array_equal(np.asarray(inner.segment_ids()),
+                                      [0, 0, 0, 1, 2, 2])
+        outer = nr.level(0)      # docs over sentences (lengths-of-lengths)
+        np.testing.assert_array_equal(np.asarray(outer.values), [3, 1, 2])
+
+        np.testing.assert_array_equal(np.asarray(nr.outer_segment_ids()),
+                                      [0, 0, 0, 0, 1, 1])
+
+        flat = nr.flatten_outer()
+        assert flat.num_levels == 1
+        np.testing.assert_array_equal(np.asarray(flat.lengths[0]), [3, 1, 2])
+
+    def test_three_levels_and_padded_roundtrip(self):
+        from paddle_tpu.core.ragged import NestedRagged
+        nested = [  # 2 books -> chapters -> sentences(word ids)
+            [[[1, 2], [3]], [[4, 4, 4]]],
+            [[[9]]],
+        ]
+        nr = NestedRagged.from_nested_list(nested)
+        assert nr.num_levels == 3
+        np.testing.assert_array_equal(np.asarray(nr.lengths[0]), [2, 1])
+        np.testing.assert_array_equal(np.asarray(nr.lengths[1]), [2, 1, 1])
+        np.testing.assert_array_equal(np.asarray(nr.lengths[2]), [2, 1, 3, 1])
+        np.testing.assert_array_equal(np.asarray(nr.outer_segment_ids()),
+                                      [0, 0, 0, 0, 0, 0, 1])
+        # innermost padded view feeds MXU ops
+        dense, mask = nr.level(2).to_padded(max_len=3)
+        assert dense.shape == (4, 3)
+        np.testing.assert_array_equal(np.asarray(mask).sum(1), [2, 1, 3, 1])
+
+    def test_check_rejects_inconsistent(self):
+        from paddle_tpu.core.enforce import EnforceError
+        from paddle_tpu.core.ragged import NestedRagged
+        with pytest.raises(EnforceError):
+            NestedRagged.from_parts(np.zeros(5), ([2, 1], [3, 1, 2]))

@@ -227,3 +227,56 @@ def test_executor_fetch_positional_outputs():
     assert float(b) == 6.0 and float(a) == 4.0
     with pytest.raises(EnforceError, match="unknown fetch"):
         exe.run(prog, feed={"x": jnp.asarray(3.0)}, fetch_list=["zzz"])
+
+
+def test_model_average_reference_window_semantics():
+    """Match the reference kernel exactly (average_accumulates_op.h):
+    restart when num_acc >= min_window and >= min(max_window,
+    num_updates*rate); apply = sums / (num_acc + old_num_acc)."""
+    from paddle_tpu.optimizer.wrappers import ModelAverage
+
+    ma = ModelAverage(average_window_rate=0.5, min_average_window=2,
+                      max_average_window=4)
+    params = {"w": jnp.ones(2)}
+    st = ma.init(params)
+
+    # numpy reference simulation
+    s1 = s2 = s3 = 0.0
+    nu = na = ona = 0
+    for step in range(1, 12):
+        p = float(step)
+        st = jax.jit(ma.update)(st, {"w": jnp.full((2,), p)})
+        nu += 1; na += 1; s1 += p
+        if na >= 2 and na >= min(4, nu * 0.5):
+            s3 = s1 + s2; s1 = 0.0; s2 = 0.0; ona = na; na = 0
+        avg_ref = (s1 + s2 + s3) / max(na + ona, 1)
+        got = float(ma.apply(st)["w"][0])
+        assert got == pytest.approx(avg_ref, rel=1e-6), (step, got, avg_ref)
+        assert int(st["num_accumulates"]) == na
+        assert int(st["old_num_accumulates"]) == ona
+
+    with pytest.raises(Exception, match="min_average_window"):
+        ModelAverage(min_average_window=10, max_average_window=5)
+
+
+def test_check_nan_inf_bound_at_construction():
+    """Toggling the flag after construction must NOT change the state
+    pytree structure of an existing optimizer (stable scan carries)."""
+    from paddle_tpu.core.flags import set_flags
+
+    o_plain = opt.SGD(0.1)
+    set_flags({"check_nan_inf": True})
+    try:
+        o_checked = opt.SGD(0.1)
+    finally:
+        set_flags({"check_nan_inf": False})
+    p = {"w": jnp.ones(2)}
+    assert "nan_inf_steps" not in o_plain.init(p)
+    st = o_checked.init(p)
+    assert "nan_inf_steps" in st
+    # flag is False now, but the instance still checks + keeps structure
+    p2, st2 = o_checked.apply_gradients(p, {"w": jnp.ones(2)}, st)
+    assert "nan_inf_steps" in st2
+    p3, st3 = o_plain.apply_gradients(p, {"w": jnp.ones(2)},
+                                      o_plain.init(p))
+    assert "nan_inf_steps" not in st3

@@ -53,6 +53,7 @@ from paddle_tpu import static
 from paddle_tpu import models
 from paddle_tpu import metrics
 from paddle_tpu import quant
+from paddle_tpu import slim
 from paddle_tpu import profiler
 from paddle_tpu import initializer
 from paddle_tpu.core.random import seed

@@ -33,6 +33,17 @@ class InMemoryDataset:
             yield from self._samples
         return r
 
+    def readers(self, n):
+        """n shard readers (round-robin) for multi-threaded ingestion
+        (ref data_feed.cc: one DataFeed per DeviceWorker thread)."""
+        m = max(n, 1)
+
+        def make(i):
+            def r():
+                yield from self._samples[i::m]
+            return r
+        return [make(i) for i in range(m)]
+
     def __len__(self):
         return len(self._samples)
 

@@ -25,9 +25,11 @@ from paddle_tpu.parallel import (
     launch,
     mesh,
     pipeline,
+    planner,
     ring_attention,
     sparse,
 )
+from paddle_tpu.parallel.planner import DistributionPlan, DistributionPlanner
 from paddle_tpu.parallel.sparse import HostTable, SparseTable
 from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet
 from paddle_tpu.parallel.communicator import (GeoSGD, GradientMerge, LocalSGD,

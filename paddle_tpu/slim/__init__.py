@@ -1,0 +1,19 @@
+"""Model compression (slim): pruning, distillation, search.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/ — quantization
+(already in paddle_tpu/quant/), prune/ (Pruner/StructurePruner +
+Uniform/Sensitive strategies), distillation/ (L2/FSP/SoftLabel distillers),
+nas/+searcher/ (LightNAS over an SAController).
+
+TPU-first notes: pruning during training keeps *static shapes* by zero-mask
+("lazy") pruning — masks fuse into the jitted step and the MXU sees dense
+tiles; physical shrinking ("remove") is an export-time transform. The
+distillers are plain loss terms composed into the student's loss function
+(no graph-surgery passes needed — the captured program IS the graph).
+"""
+
+from paddle_tpu.slim.distill import (Distiller, fsp_loss, l2_loss,
+                                     soft_label_loss)
+from paddle_tpu.slim.nas import LightNAS, SAController, SearchSpace
+from paddle_tpu.slim.prune import (MaskedOptimizer, StructurePruner,
+                                   prune_tree, sensitivity)

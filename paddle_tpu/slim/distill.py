@@ -1,0 +1,59 @@
+"""Knowledge distillation losses.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/distillation/
+distiller.py — L2Distiller (:25, mean-square between student/teacher
+feature maps), FSPDistiller (:108, L2 between FSP matrices of layer pairs,
+_fsp_matrix :191), SoftLabelDistiller (:195, cross entropy between
+temperature-softened teacher and student logits).
+
+TPU-first: the reference implements these as graph-merge passes over two
+ProgramDescs; here teacher and student are plain functions, so a distiller
+is a loss term — compose into the student's loss_fn and jit the whole
+thing (teacher forward under stop_gradient).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.nn import fsp_matrix
+
+
+def l2_loss(student_feat, teacher_feat, weight=1.0):
+    """ref distiller.py L2Distiller: mean((s - t)^2) * weight."""
+    t = jax.lax.stop_gradient(teacher_feat)
+    return weight * jnp.mean(jnp.square(student_feat - t))
+
+
+def fsp_loss(student_pair, teacher_pair, weight=1.0):
+    """ref distiller.py FSPDistiller: L2 between the FSP matrices of a
+    (near, far) feature-map pair from each net. Each pair: ([B,C1,H,W],
+    [B,C2,H,W])."""
+    s = fsp_matrix(*student_pair)
+    t = jax.lax.stop_gradient(fsp_matrix(*teacher_pair))
+    return weight * jnp.mean(jnp.square(s - t))
+
+
+def soft_label_loss(student_logits, teacher_logits, student_temperature=1.0,
+                    teacher_temperature=1.0, weight=1.0):
+    """ref distiller.py SoftLabelDistiller: cross entropy of softened
+    teacher probabilities vs softened student log-probs."""
+    t = jax.nn.softmax(
+        jax.lax.stop_gradient(teacher_logits) / teacher_temperature, axis=-1)
+    logp = jax.nn.log_softmax(student_logits / student_temperature, axis=-1)
+    return weight * jnp.mean(-jnp.sum(t * logp, axis=-1))
+
+
+class Distiller:
+    """Weighted combination of distillation terms + the task loss
+    (ref distillation_strategy.py composing distiller passes)."""
+
+    def __init__(self, terms):
+        """terms: list of zero-arg-composable (fn, weight) where fn takes
+        (student_out, teacher_out) dicts and returns a scalar."""
+        self.terms = list(terms)
+
+    def loss(self, student_out, teacher_out):
+        total = jnp.zeros(())
+        for fn, weight in self.terms:
+            total = total + weight * fn(student_out, teacher_out)
+        return total

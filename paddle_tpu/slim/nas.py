@@ -1,0 +1,117 @@
+"""Architecture search: simulated-annealing controller + LightNAS loop.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/searcher/controller.py
+(SAController :59 — accept better rewards always, worse ones with
+exp(dr/T) probability, geometric temperature decay, single random token
+mutation per step) and nas/light_nas_strategy.py (LightNASStrategy — search
+a token space where each token vector describes an architecture, reward =
+metric under a latency/flops constraint).
+
+TPU-first: the reference runs the controller behind a socket server for
+distributed search; here the controller is in-process and the trial
+evaluator is any callable (typically: build model from tokens, short-train
+jitted, return metric). A constrain_func can reject candidates (e.g. FLOPs
+budget) before paying for evaluation, exactly like the reference.
+"""
+
+import math
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+
+class SearchSpace:
+    """Token-vector search space (ref nas/search_space.py): subclass or
+    construct with range_table + init_tokens + a tokens->model builder."""
+
+    def __init__(self, range_table, init_tokens):
+        enforce(len(range_table) == len(init_tokens),
+                "range_table and init_tokens must align")
+        self.range_table = list(range_table)
+        self.init_tokens = list(init_tokens)
+
+
+class SAController:
+    """Simulated-annealing evolutionary controller (ref controller.py:59)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -float("inf")
+        self._tokens = None
+        self._max_reward = -float("inf")
+        self._best_tokens = None
+        self._iter = 0
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Metropolis accept (ref controller.py:105)."""
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        dr = reward - self._reward
+        if dr > 0 or self._rng.random_sample() <= math.exp(
+                dr / max(temperature, 1e-9)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Mutate one random position (ref controller.py:127); retries
+        through constrain_func when set."""
+        mutable = [i for i, r in enumerate(self._range_table) if r > 1]
+        enforce(mutable, "search space has no mutable positions "
+                         "(all range_table entries are 1)")
+        for _ in range(256):
+            new_tokens = list(self._tokens)
+            index = mutable[int(len(mutable) * self._rng.random_sample())]
+            new_tokens[index] = (
+                new_tokens[index]
+                + self._rng.randint(self._range_table[index] - 1) + 1
+            ) % self._range_table[index]
+            if self._constrain_func is None or self._constrain_func(
+                    new_tokens):
+                return new_tokens
+        return list(self._tokens)
+
+    @property
+    def best(self):
+        return self._best_tokens, self._max_reward
+
+
+class LightNAS:
+    """In-process LightNAS loop (ref nas/light_nas_strategy.py minus the
+    controller server): search the space with SA, evaluating candidates
+    with a user trial function."""
+
+    def __init__(self, space: SearchSpace, eval_fn, constrain_func=None,
+                 controller=None):
+        self.space = space
+        self.eval_fn = eval_fn
+        self.controller = controller or SAController()
+        self.controller.reset(space.range_table, space.init_tokens,
+                              constrain_func)
+
+    def search(self, steps=20):
+        """Run `steps` trials; returns (best_tokens, best_reward)."""
+        tokens = list(self.space.init_tokens)
+        reward = float(self.eval_fn(tokens))
+        self.controller.update(tokens, reward)
+        for _ in range(steps - 1):
+            tokens = self.controller.next_tokens()
+            reward = float(self.eval_fn(tokens))
+            self.controller.update(tokens, reward)
+        return self.controller.best

@@ -1,0 +1,161 @@
+"""Pruning — structured channel pruning + masked training.
+
+Ref: /root/reference/python/paddle/fluid/contrib/slim/prune/pruner.py
+(StructurePruner.cal_pruned_idx :55 l1_norm group sort, prune_tensor :81
+lazy/remove modes) and prune_strategy.py (UniformPruneStrategy :563,
+SensitivePruneStrategy — per-param sensitivity then ratio assignment).
+
+TPU-first: "lazy" pruning (zero masks) is the training-time mode — shapes
+stay static so one compiled step serves the whole schedule, and masks fold
+into the jitted update (MaskedOptimizer re-applies them after each step,
+replacing the reference's scope surgery). "remove" mode physically shrinks
+tensors (numpy, host) for export.
+"""
+
+import re
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+
+
+class StructurePruner:
+    """Group (channel) pruner (ref pruner.py:34).
+
+    pruning_axis: {param-name-or-'*': axis}
+    criterions:   {param-name-or-'*': 'l1_norm' | 'l2_norm'}
+    """
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _lookup(self, table, name):
+        return table[name] if name in table else table["*"]
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indexes of the weakest groups on `axis` (ref pruner.py:55)."""
+        criterion = self._lookup(self.criterions, name)
+        if axis is None:
+            axis = self._lookup(self.pruning_axis, name)
+        param = np.asarray(param)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion == "l1_norm":
+            scores = np.sum(np.abs(param), axis=reduce_dims)
+        elif criterion == "l2_norm":
+            scores = np.sqrt(np.sum(np.square(param), axis=reduce_dims))
+        else:
+            raise ValueError(f"unknown criterion {criterion!r}")
+        return scores.argsort()[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        """lazy=True zeroes the groups (static shape); False removes them
+        (ref pruner.py:81)."""
+        tensor = np.asarray(tensor)
+        mask = np.zeros(tensor.shape[pruned_axis], bool)
+        mask[np.asarray(pruned_idx, int)] = True
+        if lazy:
+            keep = ~mask
+            shape = [1] * tensor.ndim
+            shape[pruned_axis] = tensor.shape[pruned_axis]
+            return tensor * keep.reshape(shape)
+        return np.take(tensor, np.where(~mask)[0], axis=pruned_axis)
+
+    def mask_for(self, name, param, ratio, axis=None):
+        """Boolean keep-mask broadcastable over `param` (True = keep)."""
+        if axis is None:
+            axis = self._lookup(self.pruning_axis, name)
+        idx = self.cal_pruned_idx(name, param, ratio, axis)
+        m = np.ones(np.asarray(param).shape[axis], bool)
+        m[idx] = False
+        shape = [1] * np.asarray(param).ndim
+        shape[axis] = m.shape[0]
+        return jnp.asarray(m.reshape(shape))
+
+
+def _iter_params(params, pattern):
+    rx = re.compile(pattern)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if rx.search(name):
+            yield path, name, leaf
+
+
+def prune_tree(params, ratio, pattern=r"conv.*weight", pruner=None,
+               lazy=True):
+    """Prune every param matching `pattern` by `ratio` (ref
+    UniformPruneStrategy). Returns (new_params, masks {name: keep-mask}).
+    lazy=True zero-masks in place (shapes unchanged, TPU mode)."""
+    pruner = pruner or StructurePruner()
+    masks = {}
+    flat = dict(jax.tree_util.tree_leaves_with_path(params))
+    for path, name, leaf in _iter_params(params, pattern):
+        mask = pruner.mask_for(name, leaf, ratio)
+        masks[name] = mask
+        enforce(lazy, "prune_tree: only lazy (mask) mode operates on "
+                      "pytrees; use pruner.prune_tensor for removal")
+        flat[path] = jnp.asarray(leaf) * mask.astype(leaf.dtype)
+    new_params = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), [flat[p] for p, _ in
+                                               jax.tree_util.tree_leaves_with_path(params)])
+    return new_params, masks
+
+
+def apply_masks(params, masks):
+    """Re-zero masked groups (after an optimizer step)."""
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    out = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if name in masks:
+            leaf = leaf * masks[name].astype(leaf.dtype)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params), out)
+
+
+class MaskedOptimizer:
+    """Optimizer wrapper keeping pruned groups at zero through training
+    (the reference retrains pruned models by zeroing in the scope each
+    step; here the mask application fuses into the jitted update)."""
+
+    def __init__(self, inner, masks):
+        self.inner = inner
+        self.masks = masks
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def apply_gradients(self, params, grads, state):
+        params, state = self.inner.apply_gradients(params, grads, state)
+        return apply_masks(params, self.masks), state
+
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        loss, params, state, aux = self.inner.minimize(
+            loss_fn, params, state, *args, **kwargs)
+        return loss, apply_masks(params, self.masks), state, aux
+
+
+def sensitivity(eval_fn, params, pattern=r"conv.*weight",
+                ratios=(0.1, 0.3, 0.5), pruner=None):
+    """Per-param pruning sensitivity (ref SensitivePruneStrategy):
+    eval_fn(params) -> scalar metric (higher is better); returns
+    {name: {ratio: metric_loss_fraction}}."""
+    pruner = pruner or StructurePruner()
+    base = float(eval_fn(params))
+    out = {}
+    for path, name, leaf in _iter_params(params, pattern):
+        out[name] = {}
+        for ratio in ratios:
+            # anchored exact-name pattern: prune ONLY this param (a bare
+            # substring would co-prune e.g. 'conv1/weight_norm')
+            pruned, _ = prune_tree(params, ratio,
+                                   pattern="^" + re.escape(name) + "$",
+                                   pruner=pruner)
+            m = float(eval_fn(pruned))
+            out[name][float(ratio)] = (base - m) / (abs(base) + 1e-12)
+    return out

@@ -12,4 +12,7 @@ from paddle_tpu.static.program import (
     StaticProgram,
     program_from_fn,
 )
+from paddle_tpu.static.desc import OpDesc, ProgramDesc, program_desc
+from paddle_tpu.static.trainer import (Trainer, TrainerConfig,
+                                       train_from_dataset)
 from paddle_tpu.core.program import Program, flop_estimate

@@ -73,3 +73,12 @@ class Executor:
         missing = [n for n in fetch_list if n not in outs]
         enforce(not missing, "unknown fetch names: %s", missing)
         return [outs[n] for n in fetch_list]
+
+    def train_from_dataset(self, train_step, state, dataset, config=None,
+                           sparse_tables=None, batch_size=None):
+        """Threaded-ingestion training loop (ref executor.py:1107
+        train_from_dataset → TrainerFactory → DeviceWorker threads); see
+        static/trainer.py for the TPU-first design."""
+        from paddle_tpu.static.trainer import train_from_dataset as _tfd
+        return _tfd(train_step, state, dataset, config=config,
+                    sparse_tables=sparse_tables, batch_size=batch_size)

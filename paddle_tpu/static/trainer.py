@@ -1,0 +1,202 @@
+"""Trainer runtime — the train_from_dataset / DeviceWorker successor.
+
+Ref: /root/reference/paddle/fluid/framework/trainer.h:38 (TrainerBase →
+MultiTrainer/DistMultiTrainer), device_worker.h:151 (HogwildWorker),
+:180 (DownpourWorker — PSLib pull sparse → train → push sparse),
+executor.py:1107 train_from_dataset, trainer_desc.py / trainer_factory.py
+(proto-configured trainer descriptors).
+
+TPU-first redesign: the reference spawns N DeviceWorker threads each
+running the op interpreter over a shared DataFeed channel — on TPU the
+device consumes ONE stream (XLA executable, internally parallel), so the
+thread pool moves to the *host side*: N ingestion threads fill a bounded
+channel (the DataFeed successor; can be the C++ dataio reader), one device
+loop dequeues, stages the next batch while the current step runs
+(double-buffer reader parity), and runs the jitted step. DownpourWorker
+parity comes from optional sparse-table pull/push hooks around each step
+(parallel/sparse.HostTable — rows cross PCIe, exactly PSLib's flow).
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+
+import jax
+
+from paddle_tpu.core.enforce import enforce
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """TrainerDesc equivalent (ref trainer_desc.py) — plain dataclass, no
+    proto."""
+    num_ingest_threads: int = 2
+    channel_capacity: int = 8
+    prefetch: bool = True          # stage batch t+1 during step t
+    log_every: int = 0             # 0 = silent
+    max_steps: int = None          # None = drain the dataset
+
+
+class _EndOfData:
+    pass
+
+
+_EOD = _EndOfData()
+
+
+class Trainer:
+    """Run `train_step(state, *batch) -> (loss, state)` over a dataset with
+    threaded host ingestion + device staging.
+
+    dataset: anything with .reader() -> callable yielding batches (tuples
+    of numpy arrays), or a plain iterable factory.
+    sparse_tables: optional list of (table, ids_from_batch) pairs; each
+    step pulls the batch's rows, passes them to the step via trailing args
+    (rows, inv), and pushes the returned row-grads — DownpourWorker's
+    pull/push cycle (device_worker.h:180) with HostTable as the server.
+    """
+
+    def __init__(self, train_step, config=None, sparse_tables=None):
+        self.step_fn = train_step
+        self.cfg = config or TrainerConfig()
+        self.sparse_tables = sparse_tables or []
+        self.history = []
+
+    # -- DataFeed channel (ref data_feed.cc multi-threaded file->channel) --
+    def _start_ingest(self, readers):
+        chan = queue.Queue(maxsize=self.cfg.channel_capacity)
+        counts = {"live": len(readers)}
+        lock = threading.Lock()
+        errors = []
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    chan.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def work(reader):
+            try:
+                for item in reader():
+                    if not put(item):
+                        return  # trainer stopped early (max_steps)
+            except BaseException as e:  # surfaced by train() at drain
+                errors.append(e)
+            finally:
+                with lock:
+                    counts["live"] -= 1
+                    if counts["live"] == 0:
+                        put(_EOD)
+
+        threads = [threading.Thread(target=work, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
+        return chan, stop, errors
+
+    def _split_readers(self, dataset):
+        """One reader per ingest thread: a dataset with .readers(n) gets
+        shard-level parallelism; otherwise a single reader feeds the
+        channel."""
+        n = self.cfg.num_ingest_threads
+        if hasattr(dataset, "readers"):
+            return dataset.readers(n)
+        if hasattr(dataset, "reader"):
+            return [dataset.reader()]
+        return [dataset]  # assume callable yielding items
+
+    def train(self, state, dataset, batch_size=None):
+        """Drain the dataset (or max_steps); returns (state, stats).
+
+        With batch_size set, ingestion threads enqueue SAMPLES and the
+        device loop collates batch_size of them per step off the merged
+        channel (drop_last on the global stream) — per-thread remainders
+        are not lost, matching the reference's shared DataFeed channel.
+        Without it, readers must yield ready batches."""
+        chan, stop, errors = self._start_ingest(
+            self._split_readers(dataset))
+        cfg = self.cfg
+        step = 0
+        t0 = time.perf_counter()
+        loss = None
+
+        def stage(batch):
+            # host->device transfer starts now, overlapping the running step
+            return tuple(jax.device_put(a) for a in batch)
+
+        def next_batch():
+            if batch_size is None:
+                item = chan.get()
+                return None if isinstance(item, _EndOfData) else item
+            from paddle_tpu.data.loader import _collate
+            buf = []
+            while len(buf) < batch_size:
+                item = chan.get()
+                if isinstance(item, _EndOfData):
+                    return None  # drop_last on the merged stream
+                buf.append(item)
+            return _collate(buf)
+
+        try:
+            nxt = next_batch()
+            while nxt is not None:
+                if cfg.max_steps is not None and step >= cfg.max_steps:
+                    break
+                staged = stage(nxt)
+                # prefetch the following batch while this step runs
+                nxt = next_batch() if cfg.prefetch else nxt
+
+                if self.sparse_tables:
+                    state, loss = self._sparse_step(state, staged)
+                else:
+                    loss, state = self.step_fn(state, *staged)
+                step += 1
+                if cfg.log_every and step % cfg.log_every == 0:
+                    lv = float(loss)
+                    self.history.append((step, lv))
+                    print(f"[trainer] step {step} loss {lv:.6f}")
+                if not cfg.prefetch:
+                    nxt = next_batch()
+        finally:
+            stop.set()  # release producers even when step_fn raises
+        if errors:
+            raise RuntimeError(
+                f"ingestion thread failed after {step} steps") from errors[0]
+        wall = time.perf_counter() - t0
+        stats = {"steps": step, "wall_s": wall,
+                 "steps_per_s": step / wall if wall > 0 else 0.0,
+                 "final_loss": float(loss) if loss is not None else None}
+        return state, stats
+
+    def _sparse_step(self, state, batch):
+        """DownpourWorker cycle: pull rows -> step over rows -> push row
+        grads (ref downpour_worker.cc TrainFiles)."""
+        import numpy as np
+
+        pulls = []
+        for table, ids_fn in self.sparse_tables:
+            ids = np.asarray(ids_fn(batch))
+            rows, uniq = table.pull(ids)
+            inv = np.searchsorted(uniq, ids.reshape(-1))
+            pulls.append((table, uniq, rows, jax.numpy.asarray(inv)))
+        extra = []
+        for _, _, rows, inv in pulls:
+            extra += [rows, inv]
+        loss, state, *row_grads = self.step_fn(state, *batch, *extra)
+        enforce(len(row_grads) == len(pulls),
+                "sparse train_step must return one row-grad per table")
+        for (table, uniq, _, _), g in zip(pulls, row_grads):
+            table.push(uniq, g)
+        return state, loss
+
+
+def train_from_dataset(train_step, state, dataset, config=None,
+                       sparse_tables=None, batch_size=None):
+    """Functional one-call form (ref executor.py:1107)."""
+    tr = Trainer(train_step, config, sparse_tables)
+    return tr.train(state, dataset, batch_size=batch_size)

@@ -330,3 +330,89 @@ class TestLaunch:
         port = 20000 + os.getpid() % 10000  # unique per run: no stale-
         ps = launch_mod.launch_local(2, str(script), base_port=port)
         launch_mod.wait_all(ps, timeout=120)
+
+
+class TestDistributionPlanner:
+    """The transpiler-successor planner: plan shardings for an arbitrary
+    captured program (ref distribute_transpiler.py:230; assert-on-plan-text
+    mirrors test_dist_transpiler.py's assert-on-program-text)."""
+
+    def _bert_problem(self):
+        from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                            pretrain_loss)
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64, max_position=32,
+                         dropout=0.0)
+        model = BertForPretraining(cfg)
+        params = model.init(jax.random.key(0))["params"]
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 64, (8, 16), dtype=np.int32))
+        labels = jnp.asarray(rng.randint(0, 64, (8, 16), dtype=np.int32))
+
+        def step_builder(opt):
+            def step(params, opt_state, ids, labels):
+                def loss_fn(p):
+                    mlm, nsp = model.apply({"params": p, "state": {}}, ids)
+                    return pretrain_loss(
+                        mlm, nsp, labels,
+                        jnp.zeros((ids.shape[0],), jnp.int32),
+                        jnp.ones(ids.shape, jnp.float32))
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                params, opt_state = opt.apply_gradients(params, grads,
+                                                        opt_state)
+                return loss, params, opt_state
+            return step
+        return model, params, ids, labels, step_builder
+
+    def test_plan_rules_and_description(self):
+        from paddle_tpu.parallel.planner import DistributionPlanner
+        mesh = pt.parallel.make_mesh({"dp": 2, "tp": 4})
+        model, params, ids, labels, _ = self._bert_problem()
+        planner = DistributionPlanner(mesh, tp_auto=True)
+        plan = planner.plan(params, (ids, labels))
+        desc = plan.describe()
+        assert "tp" in desc
+        # every >=2D param with a tp-divisible dim got a tp axis
+        import json as jsonlib
+        entries = jsonlib.loads(desc)
+        n_tp = sum(1 for e in entries.values() if "tp" in e["spec"])
+        assert n_tp >= 5
+        # inputs shard over dp
+        assert plan.input_specs[0] == jax.sharding.PartitionSpec(
+            "dp", None)
+
+    def test_planned_step_matches_single_device(self):
+        """Transpiled-program equivalence: dp x tp planned training equals
+        single-device training (parallel_executor_test_base pattern)."""
+        from paddle_tpu.parallel.planner import DistributionPlanner
+        model, params, ids, labels, step_builder = self._bert_problem()
+        opt = pt.optimizer.Adam(1e-3)
+        step = step_builder(opt)
+
+        # single-device reference
+        p_ref = params
+        o_ref = opt.init(params)
+        losses_ref = []
+        for _ in range(3):
+            loss, p_ref, o_ref = jax.jit(step)(p_ref, o_ref, ids, labels)
+            losses_ref.append(float(loss))
+
+        mesh = pt.parallel.make_mesh({"dp": 2, "tp": 4})
+        planner = DistributionPlanner(mesh, tp_auto=True)
+        jitted, p, o, plan = planner.compile_step(
+            step, params, opt.init(params), (ids, labels), donate=False)
+        losses = []
+        with mesh:
+            for _ in range(3):
+                loss, p, o = jitted(p, o, ids, labels)
+                losses.append(float(loss))
+        np.testing.assert_allclose(losses, losses_ref, rtol=2e-4)
+
+    def test_fsdp_planning(self):
+        from paddle_tpu.parallel.planner import DistributionPlanner
+        mesh = pt.parallel.make_mesh({"dp": 2, "fsdp": 4})
+        params = {"big": jnp.zeros((64, 16)), "small": jnp.zeros((4,))}
+        planner = DistributionPlanner(mesh, fsdp_min_size=256)
+        plan = planner.plan(params)
+        assert "fsdp" in plan.entries["big"].spec
+        assert plan.entries["small"].spec == (None,)

@@ -70,7 +70,7 @@ def _timed_steps(step_once, steps):
     return max(t2 - t1, 1e-9) / steps, lv
 
 
-def bench_bert(steps, batch, seq):
+def bench_bert(steps, batch, seq, use_flash=False):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
@@ -79,6 +79,8 @@ def bench_bert(steps, batch, seq):
 
     cfg = BertConfig.base()
     cfg.dropout = 0.0  # bench the compute path
+    cfg.use_flash = use_flash
+    cfg.max_position = max(cfg.max_position, seq)
     model = BertForPretraining(cfg)
     variables = model.init(jax.random.key(0))
     params = variables["params"]
@@ -128,6 +130,8 @@ def bench_bert(steps, batch, seq):
         "mfu": round(mfu, 4),
         "step_ms": round(dt * 1e3, 2),
         "loss": loss_v,
+        "flash": bool(use_flash),
+        "seq": seq,
     }
 
 
@@ -195,7 +199,8 @@ def _run_inner(args):
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
     if args.model == "bert":
-        res = bench_bert(args.steps, args.batch or 64, args.seq)
+        res = bench_bert(args.steps, args.batch or 64, args.seq,
+                         use_flash=args.flash)
     else:
         res = bench_resnet(args.steps, args.batch or 128)
     res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
@@ -208,6 +213,8 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--flash", action="store_true",
+                    help="enable the Pallas flash-attention path")
     ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 

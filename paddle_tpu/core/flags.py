@@ -68,6 +68,10 @@ define_flag("matmul_precision", "default", "jax.lax matmul precision.")
 # custom_vjp does not support forward-mode autodiff — disable for jvp/hessian
 define_flag("conv_custom_vjp", True,
             "Use the TPU-fast custom conv backward (no jvp support).")
+# run Pallas kernels through the interpreter — engages the kernels even
+# off-TPU (CPU testing of kernel logic)
+define_flag("pallas_interpret", False,
+            "Run Pallas kernels in interpreter mode (CPU testing).")
 # escape hatch for the Pallas fused layer_norm (ADVICE r1: gate the kernel)
 define_flag("use_pallas_layer_norm", True,
             "Route layer_norm through the Pallas TPU kernel; False forces "

@@ -13,7 +13,13 @@ import jax
 
 
 def on_tpu():
+    """True when device 0 is a TPU — including tunneled PJRT plugins whose
+    platform string is not literally "tpu" (e.g. axon) but whose
+    device_kind is a TPU generation."""
     try:
-        return jax.devices()[0].platform == "tpu"
+        d = jax.devices()[0]
+        if d.platform == "tpu":
+            return True
+        return "tpu" in str(getattr(d, "device_kind", "")).lower()
     except Exception:
         return False

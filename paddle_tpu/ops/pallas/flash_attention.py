@@ -82,7 +82,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k):
+def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
+                             interpret=None):
+    if interpret is None:
+        from paddle_tpu.core.flags import get_flag
+        interpret = get_flag("pallas_interpret")
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bh = b * h
@@ -110,6 +114,7 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        interpret=interpret,
     )(q3, k3, v3)
     return out.reshape(b, h, tq, d)
 
@@ -185,11 +190,15 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
                     block_k=512):
     """Memory-efficient attention. q,k,v: [B, H, T, D].
 
-    On TPU: Pallas online-softmax forward + recompute backward.
+    On TPU: Pallas online-softmax forward + recompute backward. Head dims
+    that are multiples of 64 are supported (Mosaic pads the 64-lane case;
+    BERT-base's D=64 still wins because the [BQ,BK] matmuls dominate).
     Elsewhere: chunked XLA formulation (same math).
     """
+    from paddle_tpu.core.flags import get_flag
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    if on_tpu() and pltpu is not None and q.shape[-1] % 128 == 0 \
+    if (on_tpu() or get_flag("pallas_interpret")) and pltpu is not None \
+            and q.shape[-1] % 64 == 0 \
             and q.shape[2] % 8 == 0 and k.shape[2] % 8 == 0:
         return _flash_core(q, k, v, scale, causal, block_q, block_k)
     return chunked_attention(q, k, v, scale=scale, causal=causal,

@@ -47,7 +47,7 @@ def _pick_block_rows(rows, cols, dtype_bytes, vmem_budget=2 ** 21):
     return max(min(vmem_budget // per_row, rows, 256), 1)
 
 
-def _stats_pallas(x2d, gamma, beta, epsilon):
+def _stats_pallas(x2d, gamma, beta, epsilon, interpret=False):
     R, C = x2d.shape
     br = _pick_block_rows(R, C, x2d.dtype.itemsize)
     kern = functools.partial(_ln_fwd_kernel, epsilon=epsilon)
@@ -69,6 +69,7 @@ def _stats_pallas(x2d, gamma, beta, epsilon):
             jax.ShapeDtypeStruct((R, 1), jnp.float32),
             jax.ShapeDtypeStruct((R, 1), jnp.float32),
         ],
+        interpret=interpret,
     )(x2d, gamma, beta)
 
 
@@ -86,10 +87,14 @@ def _stats_xla(x2d, gamma, beta, epsilon):
 
 def _stats(x2d, gamma, beta, epsilon):
     # escape hatch (ADVICE r1): PT_FLAGS_use_pallas_layer_norm=0 forces the
-    # XLA twin if the Pallas kernel misbehaves on some shape/hardware
+    # XLA twin if the Pallas kernel misbehaves on some shape/hardware;
+    # pallas_interpret engages the kernel off-TPU via the interpreter
     from paddle_tpu.core.flags import get_flag
-    if on_tpu() and get_flag("use_pallas_layer_norm"):
-        return _stats_pallas(x2d, gamma, beta, epsilon)
+    if get_flag("use_pallas_layer_norm"):
+        if on_tpu():
+            return _stats_pallas(x2d, gamma, beta, epsilon)
+        if get_flag("pallas_interpret"):
+            return _stats_pallas(x2d, gamma, beta, epsilon, interpret=True)
     return _stats_xla(x2d, gamma, beta, epsilon)
 
 

@@ -9,7 +9,9 @@ from paddle_tpu.quant.ops import (abs_max_scale, dequantize_from_int,
                                   fake_quant_abs_max, fake_quant_dequant,
                                   moving_average_scale, quantize_to_int,
                                   range_abs_max_scale)
-from paddle_tpu.quant.ptq import calibrate, export_int8, freeze, int8_linear
+from paddle_tpu.quant.ptq import (calibrate, export_int8, freeze,
+                                  int8_linear,
+                                  save_int8_inference_model)
 from paddle_tpu.quant.qat import (QuantConfig, QuantizedConv2D,
                                   QuantizedLinear, quantize_model,
                                   upgrade_variables)
@@ -17,7 +19,8 @@ from paddle_tpu.quant.qat import (QuantConfig, QuantizedConv2D,
 __all__ = [
     "ops", "ptq", "qat", "QuantConfig", "QuantizedConv2D", "QuantizedLinear",
     "quantize_model", "upgrade_variables", "calibrate", "export_int8",
-    "freeze", "int8_linear", "fake_quant_abs_max", "fake_quant_dequant",
+    "freeze", "int8_linear", "save_int8_inference_model",
+    "fake_quant_abs_max", "fake_quant_dequant",
     "abs_max_scale", "moving_average_scale", "range_abs_max_scale",
     "quantize_to_int", "dequantize_from_int",
 ]

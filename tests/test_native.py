@@ -291,3 +291,43 @@ class TestPredictorEndToEnd:
         first = [float(l.split("loss")[1]) for l in r.stderr.splitlines()
                  if l.startswith("iter 1 ")][0]
         assert res["final_loss"] < first, (first, res)
+
+    def test_int8_serving_outputs_match(self, plugin, tmp_path):
+        """int8 artifact (real int8 weights in params.bin) served by the
+        C++ predictor matches the frozen-model Python forward."""
+        import paddle_tpu as pt
+        from paddle_tpu import quant
+        from paddle_tpu.io.inference import read_params_bin
+        from paddle_tpu.nn import layers as L
+        from paddle_tpu.nn.module import Module
+
+        class Net(Module):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = L.Linear(16, 32, act="relu")
+                self.fc2 = L.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(self.fc1(x))
+
+        key = jax.random.key(0)
+        qm = quant.quantize_model(Net(), quant.QuantConfig(
+            activation_quantize_type="abs_max"))
+        qv = quant.upgrade_variables(qm, Net().init(key), key)
+        x = jnp.asarray(np.random.RandomState(0).rand(4, 16), jnp.float32)
+        path = str(tmp_path / "int8")
+        quant.save_int8_inference_model(path, qm, qv, (x,),
+                                        float_model=Net())
+        frozen = quant.freeze(qm, qv)
+        expected = np.asarray(Net().apply(
+            {"params": frozen["params"], "state": {}}, x))
+
+        binary = os.path.join(REPO, "csrc", "build", "pt_predictor")
+        dump = str(tmp_path / "outs.ptpb")
+        r = subprocess.run(
+            [binary, "--model_dir", path, "--plugin", plugin,
+             "--dump_outputs", dump],
+            capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-2000:]
+        outs = read_params_bin(dump)
+        np.testing.assert_allclose(outs[0], expected, rtol=2e-2, atol=2e-2)

@@ -126,3 +126,19 @@ class CheckpointManager:
     def wait(self):
         if self._mgr is not None:
             self._mgr.wait_until_finished()
+
+    def close(self):
+        """Release orbax's async machinery (background checkpoint threads
+        can otherwise outlive the manager and stall interpreter shutdown).
+        The manager is unusable afterwards."""
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -63,9 +63,9 @@ class TransformerLayer(nn.Module):
 
     def forward(self, x, mask=None):
         h = self.attn(x, mask=mask)
-        x = self.ln1(x + self.drop(h))
+        x = self.ln1(self.drop(h), residual=x)   # fused add+LN
         h = self.fc2(A.gelu(self.fc1(x)))
-        x = self.ln2(x + self.drop(h))
+        x = self.ln2(self.drop(h), residual=x)
         return x
 
 

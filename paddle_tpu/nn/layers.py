@@ -190,12 +190,19 @@ class LayerNorm(Module):
         if shift:
             self.param("bias", (n,), I.zeros(), dtype)
 
-    def forward(self, x):
+    def forward(self, x, residual=None):
+        """With `residual`, computes ln(x + residual) in one fused HBM
+        pass (Pallas add+LN kernel on TPU) — the transformer hot path."""
         begin = x.ndim - len(self.shape)
-        return F.layer_norm(
-            x, self.p("scale") if self.has_scale else None,
-            self.p("bias") if self.has_shift else None,
-            begin_norm_axis=begin, epsilon=self.epsilon)
+        scale = self.p("scale") if self.has_scale else None
+        bias = self.p("bias") if self.has_shift else None
+        if residual is not None:
+            from paddle_tpu.ops.pallas.layer_norm import add_layer_norm_fused
+            return add_layer_norm_fused(x, residual, scale, bias,
+                                        begin_norm_axis=begin,
+                                        epsilon=self.epsilon)
+        return F.layer_norm(x, scale, bias, begin_norm_axis=begin,
+                            epsilon=self.epsilon)
 
 
 class RMSNorm(Module):

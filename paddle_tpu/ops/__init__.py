@@ -30,9 +30,11 @@ from paddle_tpu.ops import (
     loss,
     math,
     metrics_ops,
+    nets,
     nn,
     rnn,
     sequence,
+    tail,
     tensor_ops,
     text_match,
     vision,
@@ -43,6 +45,10 @@ from paddle_tpu.ops.tensor_ops import *  # noqa: F401,F403
 from paddle_tpu.ops.nn import *  # noqa: F401,F403
 from paddle_tpu.ops.loss import *  # noqa: F401,F403
 from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY
+from paddle_tpu.ops.tail import register_reference_aliases as _rra
+
+_rra()
+del _rra
 
 
 def list_ops():

@@ -260,3 +260,39 @@ def dice_loss(input, label, epsilon=1e-5):
     inter = jnp.sum(input * label, reduce_dims)
     union = jnp.sum(input, reduce_dims) + jnp.sum(label, reduce_dims)
     return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+
+
+@register_op("hsigmoid")
+def hsigmoid_loss(x, weight, label, num_classes, bias=None):
+    """Hierarchical sigmoid over the default complete binary tree.
+
+    Ref: operators/hierarchical_sigmoid_op.h + math/matrix_bit_code.h
+    SimpleCode — class c encodes as v = c + num_classes; path node weights
+    are rows (v >> (bit+1)) - 1 and the binary targets are v's low bits;
+    loss = sum over the path of BCE-with-logits(x @ w_node + b_node, bit).
+
+    x: [B, D]; weight: [num_classes - 1, D]; label: [B] int;
+    returns per-example loss [B]. Static shapes: every path is padded to
+    max_len = bitlength(2*num_classes - 1) - 1 and masked by the true code
+    length (TPU-first twin of the reference's per-class path lengths).
+    """
+    v = label.astype(jnp.int32) + num_classes                 # [B]
+    max_len = int((2 * num_classes - 1).bit_length() - 1)
+    bits = jnp.arange(max_len)                                # [L]
+    # length = floor(log2(v)), integer-exact (float32 log2 rounds up for
+    # v = 2^k - 1 once k >= 21 — large-vocab corruption)
+    lengths = jnp.sum(
+        (v[:, None] >> jnp.arange(1, max_len + 2)[None, :]) > 0,
+        axis=1).astype(jnp.int32)
+    valid = bits[None, :] < lengths[:, None]                  # [B, L]
+    idx = jnp.clip((v[:, None] >> (bits[None, :] + 1)) - 1, 0,
+                   num_classes - 2)                           # [B, L]
+    target = ((v[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+    w_rows = jnp.take(weight, idx, axis=0)                    # [B, L, D]
+    pre = jnp.einsum("bd,bld->bl", x, w_rows)
+    if bias is not None:
+        pre = pre + jnp.take(bias, idx)
+    # BCE with logits, summed over the valid path
+    per_bit = jnp.maximum(pre, 0) - pre * target + jnp.log1p(
+        jnp.exp(-jnp.abs(pre)))
+    return jnp.sum(jnp.where(valid, per_bit, 0.0), axis=1)

@@ -1,0 +1,73 @@
+"""Composite network helpers (ref: python/paddle/fluid/nets.py).
+
+The reference's nets.py builds small op compositions over fluid.layers:
+simple_img_conv_pool :28, img_conv_group :138, sequence_conv_pool :251,
+glu :319, scaled_dot_product_attention :360 (the last lives in
+ops/attention.py here). Functional versions over the ops library; the
+conv/pool ones take explicit weights (functional core) and also exist as
+Module compositions in models/.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops import nn as F
+from paddle_tpu.ops import sequence as S
+
+
+@register_op("glu")
+def glu(x, axis=-1):
+    """Gated linear unit (ref nets.py:319): split in half along `axis`,
+    a * sigmoid(b)."""
+    import jax
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def _ksize(w, data_format):
+    # NCHW weights are OIHW, NHWC weights are HWIO (ops/nn.py conv2d)
+    return (w.shape[2], w.shape[3]) if data_format == "NCHW" \
+        else (w.shape[0], w.shape[1])
+
+
+@register_op("simple_img_conv_pool")
+def simple_img_conv_pool(x, conv_w, conv_b=None, pool_size=2, pool_stride=2,
+                         pool_type="max", act=None, data_format="NCHW"):
+    """conv2d -> act -> pool2d (ref nets.py:28)."""
+    kh, kw = _ksize(conv_w, data_format)
+    out = F.conv2d(x, conv_w, conv_b,
+                   padding=((kh - 1) // 2, (kw - 1) // 2),
+                   data_format=data_format)
+    if act is not None:
+        from paddle_tpu.ops import activations
+        out = getattr(activations, act)(out)
+    return F.pool2d(out, pool_size, pool_type, pool_stride,
+                    data_format=data_format)
+
+
+@register_op("img_conv_group")
+def img_conv_group(x, conv_weights, conv_biases=None, act="relu",
+                   pool_size=2, pool_stride=2, pool_type="max",
+                   data_format="NCHW"):
+    """N stacked conv+act then one pool (ref nets.py:138, the VGG block)."""
+    from paddle_tpu.ops import activations
+    act_fn = getattr(activations, act)
+    biases = conv_biases or [None] * len(conv_weights)
+    for w, b in zip(conv_weights, biases):
+        kh, kw = _ksize(w, data_format)
+        x = act_fn(F.conv2d(x, w, b, padding=((kh - 1) // 2, (kw - 1) // 2),
+                            data_format=data_format))
+    return F.pool2d(x, pool_size, pool_type, pool_stride,
+                    data_format=data_format)
+
+
+@register_op("sequence_conv_pool")
+def sequence_conv_pool(rb, filter_w, act="tanh", pool_type="max"):
+    """sequence_conv -> act -> sequence_pool (ref nets.py:251; the text-CNN
+    block over ragged sequences)."""
+    from paddle_tpu.core.ragged import RaggedBatch
+    from paddle_tpu.ops import activations
+    out = S.sequence_conv(rb, filter_w)
+    vals = getattr(activations, act)(out.values
+                                     if isinstance(out, RaggedBatch) else out)
+    return S.sequence_pool(RaggedBatch(vals, rb.row_lengths), pool_type)

@@ -1,0 +1,255 @@
+"""Remaining layers/nn.py surface tail — small ops for full API parity.
+
+Ref: /root/reference/python/paddle/fluid/layers/nn.py and the matching
+operators/*.cc. Each op documents its reference and any TPU-first
+reinterpretation (static shapes; PRNG keys explicit). Renamed twins of
+already-present ops are registered as aliases at the bottom.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY, register_op
+
+
+@register_op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    """ref nn.py label_smooth / operators/label_smooth_op.cc:
+    (1-eps)*label + eps*prior (uniform 1/K default)."""
+    k = label.shape[-1]
+    prior = prior_dist if prior_dist is not None else 1.0 / k
+    return (1.0 - epsilon) * label + epsilon * prior
+
+
+@register_op("multiplex")
+def multiplex(inputs, index):
+    """ref operators/multiplex_op.cc: out[i] = inputs[index[i]][i] —
+    row-wise select among candidate tensors."""
+    stacked = jnp.stack(inputs, 0)                  # [N, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)       # [B]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+@register_op("mean_iou")
+def mean_iou(prediction, label, num_classes):
+    """ref operators/metrics? mean_iou_op.cc: per-class IoU averaged over
+    classes present; returns (mean_iou, out_wrong [K], out_correct [K])."""
+    pred = prediction.reshape(-1).astype(jnp.int32)
+    lab = label.reshape(-1).astype(jnp.int32)
+    correct_mask = pred == lab
+    out_correct = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(correct_mask, lab, num_classes)].add(1, mode="drop")
+    # wrong: count each mismatched position under BOTH its pred and label
+    wrong_pred = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(~correct_mask, pred, num_classes)].add(1, mode="drop")
+    wrong_lab = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(~correct_mask, lab, num_classes)].add(1, mode="drop")
+    out_wrong = wrong_pred + wrong_lab
+    # IoU_c = correct_c / (correct_c + wrong_c) — mean_iou_op.h:100
+    union = out_correct + out_wrong
+    present = union > 0
+    iou = jnp.where(present, out_correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    return miou, out_wrong, out_correct
+
+
+@register_op("crop_tensor")
+def crop_tensor(x, shape, offsets=None):
+    """ref operators/crop_tensor_op.cc: static slice of `shape` at
+    `offsets` (zeros default)."""
+    offsets = tuple(offsets) if offsets is not None else (0,) * x.ndim
+    enforce(len(shape) == x.ndim and len(offsets) == x.ndim,
+            "crop_tensor: shape/offsets rank mismatch")
+    return lax.slice(x, offsets,
+                     tuple(o + s for o, s in zip(offsets, shape)))
+
+
+@register_op("crop")
+def crop(x, shape, offsets=None):
+    """ref nn.py crop (older twin of crop_tensor)."""
+    return crop_tensor(x, shape, offsets)
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """ref operators/bilinear_tensor_product_op.cc:
+    out[b, k] = x[b] @ W[k] @ y[b] + bias[k]; W: [K, Dx, Dy]."""
+    out = jnp.einsum("bd,kde,be->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("gather_tree")
+def gather_tree(ids, parents):
+    """ref operators/gather_tree_op.h: beam-search backtrace.
+    ids/parents: [T, B, beam]; walks parents from the last step backwards,
+    emitting the ancestor id chain per final beam."""
+    T = ids.shape[0]
+
+    def step(parent, t):
+        # t runs T-2 .. 0; gather ids/parents at the current parent index
+        idt = jnp.take_along_axis(ids[t], parent, axis=-1)
+        new_parent = jnp.take_along_axis(parents[t], parent, axis=-1)
+        return new_parent, idt
+
+    parent0 = parents[T - 1]
+    init_out = ids[T - 1]
+    _, outs = lax.scan(step, parent0, jnp.arange(T - 2, -1, -1))
+    # outs is [T-1, B, beam] for steps T-2..0 — reverse and append the tail
+    return jnp.concatenate([outs[::-1], init_out[None]], axis=0)
+
+
+def _murmur32(x):
+    """murmur3 32-bit finalizer — explicit uint32 so bucket ids are
+    IDENTICAL regardless of jax_enable_x64 (uint64 would silently
+    canonicalize to uint32 under the default config)."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * np.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * np.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+@register_op("hash")
+def hash_bucket(ids, mod_by, num_hash=1):
+    """ref operators/hash_op.h: num_hash hashes (seeded 0..n-1) of each id
+    row, modulo mod_by. TPU-first: a murmur3-finalizer digest instead of
+    XXH64 — same contract (deterministic int hash family), vectorized, and
+    config-independent (pure uint32 math)."""
+    flat = ids.reshape(ids.shape[0], -1).astype(jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full((flat.shape[0],),
+                     np.uint32((seed * 0x9E3779B9 + 1) & 0xFFFFFFFF),
+                     jnp.uint32)
+        for j in range(flat.shape[1]):  # mix the row like a running digest
+            h = _murmur32(h ^ _murmur32(flat[:, j]))
+        outs.append((h % np.uint32(mod_by)).astype(jnp.int32))
+    return jnp.stack(outs, axis=-1)                 # [rows, num_hash]
+
+
+@register_op("soft_relu")
+def soft_relu(x, threshold=40.0):
+    """ref operators/activation_op.h SoftRelu: log(1 + exp(clip(x)))."""
+    c = jnp.clip(x, -threshold, threshold)
+    return jnp.log1p(jnp.exp(c))
+
+
+@register_op("sampling_id")
+def sampling_id(probs, key):
+    """ref operators/sampling_id_op.cc: sample a column index per row from
+    the given probabilities (PRNG key explicit — TPU counter RNG)."""
+    return jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)),
+                                  axis=-1)
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ref_larger, x, pad_value=0.0):
+    """ref operators/pad_constant_like_op.cc: zero-pad x at the end of each
+    dim up to ref's shape."""
+    pads = [(0, r - s) for r, s in zip(ref_larger.shape, x.shape)]
+    return jnp.pad(x, pads, constant_values=pad_value)
+
+
+@register_op("uniform_random_batch_size_like")
+def uniform_random_batch_size_like(like, key, shape, min=-1.0, max=1.0,
+                                   batch_dim=0, dtype=jnp.float32):
+    """ref nn.py: random tensor whose batch dim copies `like`'s."""
+    shape = list(shape)
+    shape[batch_dim] = like.shape[batch_dim]
+    return jax.random.uniform(key, tuple(shape), dtype, min, max)
+
+
+@register_op("gaussian_random_batch_size_like")
+def gaussian_random_batch_size_like(like, key, shape, mean=0.0, std=1.0,
+                                    batch_dim=0, dtype=jnp.float32):
+    shape = list(shape)
+    shape[batch_dim] = like.shape[batch_dim]
+    return mean + std * jax.random.normal(key, tuple(shape), dtype)
+
+
+@register_op("ctc_greedy_decoder")
+def ctc_greedy_decoder(probs, lengths=None, blank=0):
+    """ref nn.py ctc_greedy_decoder: argmax per frame then CTC collapse
+    (merge repeats, drop blanks). probs: [B, T, C] (padded batch twin of
+    the reference's LoD input). Returns (decoded [B, T], out_lengths)."""
+    from paddle_tpu.ops.sequence import ctc_align
+    tokens = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    return ctc_align(tokens, lengths, blank=blank, merge_repeated=True)
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(rb, new_dim):
+    """ref sequence_ops/sequence_reshape_op.cc: refold each sequence's
+    [len, D] values into [len*D/new_dim, new_dim]; lengths scale by
+    D/new_dim."""
+    from paddle_tpu.core.ragged import RaggedBatch
+    d = rb.values.shape[-1]
+    lengths_np = np.asarray(rb.row_lengths)
+    enforce(bool(((lengths_np * d) % new_dim == 0).all()),
+            "sequence_reshape: every sequence's len*D must divide new_dim "
+            "(per-row, not just the total — ref sequence_reshape_op.cc)")
+    vals = rb.values.reshape(-1, new_dim)
+    lengths = (rb.row_lengths * d) // new_dim
+    return RaggedBatch(vals, lengths)
+
+
+@register_op("lod_reset")
+def lod_reset(rb, new_lengths):
+    """ref operators/lod_reset_op.cc: replace the partition (values
+    unchanged)."""
+    from paddle_tpu.core.ragged import RaggedBatch
+    return RaggedBatch(rb.values, jnp.asarray(new_lengths, jnp.int32))
+
+
+@register_op("random_crop")
+def random_crop(x, key, shape):
+    """ref operators/random_crop_op.cc: random spatial crop to `shape`
+    (per-batch same offset; key explicit)."""
+    offsets = []
+    keys = jax.random.split(key, x.ndim)
+    for i, (full, want) in enumerate(zip(x.shape, shape)):
+        enforce(want <= full, "random_crop: crop larger than input")
+        offsets.append(jax.random.randint(keys[i], (), 0, full - want + 1)
+                       if full > want else jnp.zeros((), jnp.int32))
+    return lax.dynamic_slice(x, offsets, shape)
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss(logits, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """ref operators/teacher_student_sigmoid_loss_op.cc: CTR distillation
+    loss — label < 0 encodes a teacher score via -label; else hard ctr."""
+    x = jnp.clip(logits, soft_max_lower_bound, soft_max_up_bound)
+    # log(1+e^x) - z*x with z = hard label or teacher soft score
+    z = jnp.where(label < 0, -label, label)
+    return jnp.log1p(jnp.exp(x)) - z * x
+
+
+# --- aliases: renamed twins of present ops (reference-name parity).
+# Called from ops/__init__ AFTER every op module has imported, so targets
+# registered later in the import order still resolve.
+def _alias(name, target):
+    if name not in GLOBAL_OP_REGISTRY and target in GLOBAL_OP_REGISTRY:
+        GLOBAL_OP_REGISTRY.register(name, GLOBAL_OP_REGISTRY.get(target),
+                                    alias_of=target)
+
+
+def register_reference_aliases():
+    for name, target in (
+            ("embedding", "lookup_table"),
+            ("topk", "top_k"),
+            ("image_resize", "interpolate"),
+            ("resize_bilinear", "interpolate"),
+            ("resize_nearest", "interpolate"),
+            ("warpctc", "ctc_loss"),
+            ("smooth_l1", "smooth_l1_loss"),
+            ("nce", "nce_loss"),
+            ("cross_entropy2", "cross_entropy"),
+            ("unique", "unique_with_counts")):
+        _alias(name, target)

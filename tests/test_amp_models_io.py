@@ -213,6 +213,7 @@ class TestDataIO:
         restored, step = mgr.restore(state)
         assert step == 3
         np.testing.assert_allclose(np.asarray(restored["w"]), 3.0)
+        mgr.close()  # stop orbax's async threads (CI shutdown hygiene)
 
     def test_inference_export(self, tmp_path):
         m = models.MLP(num_classes=3, in_dim=4)

@@ -318,3 +318,234 @@ class TestMetricsOps:
         labels = np.array([0, 0, 1, 1], np.int64)
         a = MO.auc(jnp.asarray(preds), jnp.asarray(labels))
         assert float(a) > 0.99
+
+
+class TestNets:
+    """Composite nets (ref nets.py — simple_img_conv_pool :28,
+    img_conv_group :138, sequence_conv_pool :251, glu :319)."""
+
+    def test_glu(self):
+        from paddle_tpu.ops.nets import glu
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 8), jnp.float32)
+        out = glu(x)
+        a, b = np.split(np.asarray(x), 2, axis=-1)
+        np.testing.assert_allclose(np.asarray(out),
+                                   a * (1 / (1 + np.exp(-b))), rtol=1e-5)
+
+    def test_simple_img_conv_pool(self):
+        from paddle_tpu.ops.nets import simple_img_conv_pool
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(2, 3, 8, 8), jnp.float32)
+        w = jnp.asarray(rng.rand(4, 3, 3, 3), jnp.float32)
+        out = simple_img_conv_pool(x, w, act="relu")
+        assert out.shape == (2, 4, 4, 4)
+        assert np.all(np.asarray(out) >= 0)
+
+    def test_img_conv_group(self):
+        from paddle_tpu.ops.nets import img_conv_group
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(2, 3, 8, 8), jnp.float32)
+        ws = [jnp.asarray(rng.rand(8, 3, 3, 3), jnp.float32),
+              jnp.asarray(rng.rand(8, 8, 3, 3), jnp.float32)]
+        out = img_conv_group(x, ws)
+        assert out.shape == (2, 8, 4, 4)
+
+    def test_sequence_conv_pool(self):
+        from paddle_tpu.core.ragged import RaggedBatch
+        from paddle_tpu.ops.nets import sequence_conv_pool
+        rng = np.random.RandomState(0)
+        rb = RaggedBatch.from_list([rng.rand(4, 6), rng.rand(2, 6)],
+                                   dtype=np.float32)
+        w = jnp.asarray(rng.rand(18, 5), jnp.float32)
+        out = sequence_conv_pool(rb, w, pool_type="max")
+        assert out.shape == (2, 5)
+
+
+class TestOpTail2:
+    """layers/nn.py remaining surface (ops/tail.py)."""
+
+    def test_label_smooth(self):
+        from paddle_tpu.ops.tail import label_smooth
+        y = jnp.asarray([[0.0, 1.0, 0.0, 0.0]])
+        out = np.asarray(label_smooth(y, epsilon=0.2))
+        np.testing.assert_allclose(out, [[0.05, 0.85, 0.05, 0.05]],
+                                   rtol=1e-6)
+
+    def test_multiplex(self):
+        from paddle_tpu.ops.tail import multiplex
+        a = jnp.asarray([[1.0, 1.0], [2.0, 2.0]])
+        b = jnp.asarray([[9.0, 9.0], [8.0, 8.0]])
+        out = np.asarray(multiplex([a, b], jnp.asarray([[1], [0]])))
+        np.testing.assert_allclose(out, [[9.0, 9.0], [2.0, 2.0]])
+
+    def test_mean_iou_matches_reference_loop(self):
+        from paddle_tpu.ops.tail import mean_iou
+        rng = np.random.RandomState(0)
+        K = 4
+        pred = rng.randint(0, K, (30,))
+        lab = rng.randint(0, K, (30,))
+        miou, wrong, correct = mean_iou(jnp.asarray(pred), jnp.asarray(lab),
+                                        K)
+        # reference loop (mean_iou_op.h:91)
+        w = np.zeros(K, int); c = np.zeros(K, int)
+        for p, l in zip(pred, lab):
+            if p == l:
+                c[p] += 1
+            else:
+                w[l] += 1
+                w[p] += 1
+        denom = w + c
+        valid = (denom > 0).sum()
+        iou = np.where(denom > 0, c / np.maximum(denom, 1), 0.0)
+        np.testing.assert_array_equal(np.asarray(wrong), w)
+        np.testing.assert_array_equal(np.asarray(correct), c)
+        assert float(miou) == pytest.approx(iou.sum() / valid, rel=1e-6)
+
+    def test_crop_and_pad_constant_like(self):
+        from paddle_tpu.ops.tail import crop_tensor, pad_constant_like
+        x = jnp.arange(24.0).reshape(4, 6)
+        c = crop_tensor(x, (2, 3), (1, 2))
+        np.testing.assert_allclose(np.asarray(c), np.asarray(x)[1:3, 2:5])
+        back = pad_constant_like(x, c, pad_value=-1)
+        assert back.shape == x.shape and float(back[3, 5]) == -1
+
+    def test_bilinear_tensor_product(self):
+        from paddle_tpu.ops.tail import bilinear_tensor_product
+        rng = np.random.RandomState(0)
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(2, 4, 5).astype(np.float32)
+        out = np.asarray(bilinear_tensor_product(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)))
+        for b in range(3):
+            for k in range(2):
+                assert out[b, k] == pytest.approx(x[b] @ w[k] @ y[b],
+                                                  rel=1e-5)
+
+    def test_gather_tree_matches_reference_loop(self):
+        from paddle_tpu.ops.tail import gather_tree
+        rng = np.random.RandomState(0)
+        T, B, W = 5, 2, 3
+        ids = rng.randint(0, 9, (T, B, W)).astype(np.int32)
+        parents = rng.randint(0, W, (T, B, W)).astype(np.int32)
+        got = np.asarray(gather_tree(jnp.asarray(ids), jnp.asarray(parents)))
+        ref = np.zeros_like(ids)
+        for b in range(B):                  # gather_tree_op.h:42
+            for w in range(W):
+                ref[T - 1, b, w] = ids[T - 1, b, w]
+                parent = parents[T - 1, b, w]
+                for t in range(T - 2, -1, -1):
+                    ref[t, b, w] = ids[t, b, parent]
+                    parent = parents[t, b, parent]
+        np.testing.assert_array_equal(got, ref)
+
+    def test_hash_deterministic_bucketed(self):
+        from paddle_tpu.ops.tail import hash_bucket
+        ids = jnp.asarray([[1, 2], [1, 2], [3, 4]])
+        out = np.asarray(hash_bucket(ids, mod_by=97, num_hash=3))
+        assert out.shape == (3, 3)
+        np.testing.assert_array_equal(out[0], out[1])  # same row same hash
+        assert not np.array_equal(out[0], out[2])
+        assert (out >= 0).all() and (out < 97).all()
+        # different seeds differ
+        assert len(set(out[0].tolist())) > 1
+
+    def test_ctc_greedy_decoder(self):
+        from paddle_tpu.ops.tail import ctc_greedy_decoder
+        # frames argmax: [1,1,0,2,2] -> collapse -> [1,2]
+        probs = np.zeros((1, 5, 3), np.float32)
+        for t, c in enumerate([1, 1, 0, 2, 2]):
+            probs[0, t, c] = 1.0
+        out, n = ctc_greedy_decoder(jnp.asarray(probs))
+        assert int(n[0]) == 2
+        np.testing.assert_array_equal(np.asarray(out)[0, :2], [1, 2])
+
+    def test_sequence_reshape_and_lod_reset(self):
+        from paddle_tpu.core.ragged import RaggedBatch
+        from paddle_tpu.ops.tail import lod_reset, sequence_reshape
+        rb = RaggedBatch.from_list([np.arange(8).reshape(2, 4),
+                                    np.arange(4).reshape(1, 4)],
+                                   dtype=np.float32)
+        r2 = sequence_reshape(rb, 2)
+        np.testing.assert_array_equal(np.asarray(r2.row_lengths), [4, 2])
+        assert r2.values.shape == (6, 2)
+        r3 = lod_reset(rb, [1, 2])
+        np.testing.assert_array_equal(np.asarray(r3.row_lengths), [1, 2])
+
+    def test_random_ops_and_sampling(self):
+        from paddle_tpu.ops.tail import (gaussian_random_batch_size_like,
+                                         random_crop, sampling_id,
+                                         uniform_random_batch_size_like)
+        key = jax.random.key(0)
+        like = jnp.zeros((5, 2))
+        u = uniform_random_batch_size_like(like, key, (1, 7))
+        assert u.shape == (5, 7)
+        g = gaussian_random_batch_size_like(like, key, (1, 3))
+        assert g.shape == (5, 3)
+        x = jnp.arange(36.0).reshape(6, 6)
+        c = random_crop(x, key, (2, 2))
+        assert c.shape == (2, 2)
+        probs = jnp.asarray([[0.0, 1.0, 0.0]] * 4)
+        s = sampling_id(probs, key)
+        np.testing.assert_array_equal(np.asarray(s), 1)
+
+    def test_soft_relu_and_teacher_student(self):
+        from paddle_tpu.ops.tail import (soft_relu,
+                                         teacher_student_sigmoid_loss)
+        x = jnp.asarray([-100.0, 0.0, 100.0])
+        out = np.asarray(soft_relu(x, threshold=40.0))
+        assert out[0] == pytest.approx(np.log1p(np.exp(-40.0)))
+        assert out[2] == pytest.approx(np.log1p(np.exp(40.0)))
+        l = teacher_student_sigmoid_loss(jnp.asarray([0.5]),
+                                         jnp.asarray([-0.7]))
+        # z = 0.7 (teacher score via negative label)
+        assert float(l[0]) == pytest.approx(np.log1p(np.exp(0.5))
+                                            - 0.7 * 0.5, rel=1e-5)
+
+    def test_aliases_registered(self):
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        for name in ("embedding", "topk", "image_resize", "warpctc",
+                     "smooth_l1", "glu", "hash", "label_smooth"):
+            assert name in R, name
+
+    def test_hsigmoid_matches_reference_loop(self):
+        """hsigmoid vs a direct SimpleCode re-derivation
+        (matrix_bit_code.h:16 calc_index/calc_bit)."""
+        from paddle_tpu.ops.loss import hsigmoid_loss
+        rng = np.random.RandomState(0)
+        B, D, K = 5, 6, 10
+        x = rng.randn(B, D).astype(np.float32)
+        w = rng.randn(K - 1, D).astype(np.float32) * 0.3
+        b = rng.randn(K - 1).astype(np.float32) * 0.1
+        label = rng.randint(0, K, (B,))
+        got = np.asarray(hsigmoid_loss(jnp.asarray(x), jnp.asarray(w),
+                                       jnp.asarray(label), K,
+                                       jnp.asarray(b)))
+        for i in range(B):
+            v = int(label[i]) + K
+            length = v.bit_length() - 1
+            ref = 0.0
+            for bit in range(length):
+                idx = (v >> (bit + 1)) - 1
+                t = (v >> bit) & 1
+                pre = float(x[i] @ w[idx] + b[idx])
+                ref += max(pre, 0) - pre * t + np.log1p(np.exp(-abs(pre)))
+            assert got[i] == pytest.approx(ref, rel=1e-4), i
+
+    def test_hsigmoid_trains(self):
+        from paddle_tpu.ops.loss import hsigmoid_loss
+        import paddle_tpu as pt
+        rng = np.random.RandomState(1)
+        B, D, K = 32, 8, 16
+        x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        label = jnp.asarray(rng.randint(0, K, (B,)))
+        params = {"w": jnp.zeros((K - 1, D)), "b": jnp.zeros((K - 1,))}
+        opt = pt.optimizer.Adam(0.1)
+        st = opt.init(params)
+        losses = []
+        for _ in range(20):
+            loss, params, st, _ = jax.jit(lambda p, s: opt.minimize(
+                lambda q: (jnp.mean(hsigmoid_loss(
+                    x, q["w"], label, K, q["b"])), None), p, s))(params, st)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7

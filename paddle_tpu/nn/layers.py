@@ -321,18 +321,14 @@ class SpectralNorm(Module):
         self.state("v", (w,), I.normal(0, 1), jnp.float32)
 
     def forward(self, weight):
-        wmat = jnp.moveaxis(weight, self.dim, 0).reshape(self.h, self.w)
-        u, v = self.s("u"), self.s("v")
-        for _ in range(self.power_iters):
-            v = wmat.T @ u
-            v = v / (jnp.linalg.norm(v) + self.eps)
-            u = wmat @ v
-            u = u / (jnp.linalg.norm(u) + self.eps)
-        sigma = u @ wmat @ v
+        from paddle_tpu.ops.tail import spectral_norm as _sn_op
+        normed, u, v = _sn_op(weight, self.s("u"), self.s("v"),
+                              dim=self.dim, power_iters=self.power_iters,
+                              eps=self.eps)
         if self.training:
             self.update_state("u", u)
             self.update_state("v", v)
-        return weight / sigma
+        return normed
 
 
 class LSTM(Module):

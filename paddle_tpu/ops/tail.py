@@ -253,3 +253,130 @@ def register_reference_aliases():
             ("cross_entropy2", "cross_entropy"),
             ("unique", "unique_with_counts")):
         _alias(name, target)
+
+
+@register_op("continuous_value_model")
+def continuous_value_model(x, use_cvm=True):
+    """ref operators/cvm_op.h CvmComputeKernel: each row's first two
+    features are (show, click). use_cvm=True: y0=log(show+1),
+    y1=log(click+1)-y0, rest copied. use_cvm=False: drop the two columns."""
+    if not use_cvm:
+        return x[:, 2:]
+    y0 = jnp.log(x[:, :1] + 1.0)
+    y1 = jnp.log(x[:, 1:2] + 1.0) - y0
+    return jnp.concatenate([y0, y1, x[:, 2:]], axis=1)
+
+
+@register_op("adaptive_pool3d")
+def adaptive_pool3d(x, output_size, pool_type="avg"):
+    """ref operators/pool_op.cc adaptive 3-D path; x [N, C, D, H, W];
+    divisible sizes only (static shapes on TPU)."""
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    n, c, d, h, w = x.shape
+    enforce(d % od == 0 and h % oh == 0 and w % ow == 0,
+            "adaptive_pool3d requires divisible sizes on TPU")
+    x6 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    red = (3, 5, 7)
+    return jnp.max(x6, axis=red) if pool_type == "max" \
+        else jnp.mean(x6, axis=red)
+
+
+@register_op("lod_append")
+def lod_append(values, outer_lengths, inner_lengths):
+    """ref lod_reset/lod_append family: build a two-level partition over
+    `values`, returning a NestedRagged (multi-level LoD, lod_tensor.h:52).
+    outer_lengths counts inner groups per outer row; inner_lengths counts
+    value rows per inner group (sums must chain)."""
+    from paddle_tpu.core.ragged import NestedRagged
+    return NestedRagged.from_parts(values, (outer_lengths, inner_lengths))
+
+
+@register_op("image_resize_short")
+def image_resize_short(x, out_short_len, resample="BILINEAR",
+                       data_format="NCHW"):
+    """ref nn.py image_resize_short: scale so the SHORTER edge equals
+    out_short_len, keeping aspect ratio."""
+    from paddle_tpu.ops.nn import interpolate
+    if data_format == "NCHW":
+        h, w = x.shape[2], x.shape[3]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    short = min(h, w)
+    # int(x + 0.5), not banker's rounding (ref nn.py image_resize_short)
+    out_h = int(h * out_short_len / short + 0.5)
+    out_w = int(w * out_short_len / short + 0.5)
+    return interpolate(x, size=(out_h, out_w),
+                       mode=resample.lower(), data_format=data_format)
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Functional spectral normalization (ref operators/spectral_norm_op.cc):
+    returns (w / sigma, new_u, new_v). The nn.SpectralNorm layer carries
+    u/v as mutable state; this is the op-level twin."""
+    h = weight.shape[dim]
+    wmat = jnp.moveaxis(weight, dim, 0).reshape(h, -1)
+    for _ in range(power_iters):
+        v = wmat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wmat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wmat @ v
+    return weight / sigma, u, v
+
+
+@register_op("dynamic_lstmp")
+def dynamic_lstmp(x, h0, c0, w_ih, w_hh, w_proj, b=None, lengths=None,
+                  reverse=False, time_major=False, proj_activation="tanh"):
+    """LSTM with a recurrent projection layer (ref operators/lstmp_op.cc):
+    the hidden state fed back through the recurrence is
+    r_t = proj_act(h_t @ w_proj) (smaller than the cell), the classic LSTMP
+    of speech models. proj_activation defaults to tanh like the reference
+    (lstmp_op.cc SetDefault("tanh")); pass None for identity.
+
+    x [B,T,I]; h0 [B,P]; c0 [B,H]; w_ih [I,4H]; w_hh [P,4H]; w_proj [H,P].
+    Returns (projected outputs [B,T,P], (r, c)).
+    """
+    from paddle_tpu.ops.rnn import _masked_scan, lstm_cell
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    if proj_activation is None:
+        proj_act = lambda z: z
+    else:
+        from paddle_tpu.ops import activations
+        proj_act = getattr(activations, proj_activation)
+
+    def step(carry, x_t):
+        r, c = carry
+        h, c = lstm_cell(x_t, r, c, w_ih, w_hh, b)
+        r = proj_act(h @ w_proj)
+        return (r, c)
+
+    (r, c), outs = _masked_scan(step, x, (h0, c0), lengths, reverse)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, (r, c)
+
+
+@register_op("filter_by_instag")
+def filter_by_instag(x, ins_tags, filter_tags, out_size=None, pad_tag=0):
+    """ref operators/filter_by_instag_op.cc: keep rows whose tag set
+    intersects filter_tags. Static-shape twin: returns (filtered [K, ...]
+    rows compacted to the front with zero padding, keep_mask [B],
+    row_map [K] original indices with K = out_size or B; slots past the
+    kept count map to B). ins_tags rows are padded with `pad_tag`, which
+    never matches (the dense twin of the reference's ragged tag lists)."""
+    B = x.shape[0]
+    K = out_size if out_size is not None else B
+    ftags = jnp.asarray(filter_tags)
+    hit = jnp.any((ins_tags[:, :, None] == ftags[None, None, :])
+                  & (ins_tags[:, :, None] != pad_tag), axis=(1, 2))
+    order = jnp.argsort(~hit, stable=True)            # kept rows first
+    slots = jnp.arange(K)
+    row_map = jnp.where(slots < B, order[jnp.minimum(slots, B - 1)], B)
+    valid = (slots < B) & jnp.take(hit, jnp.minimum(row_map, B - 1))
+    row_map = jnp.where(valid, row_map, B)            # B = "no row"
+    out = jnp.where(valid.reshape((-1,) + (1,) * (x.ndim - 1)),
+                    jnp.take(x, jnp.minimum(row_map, B - 1), axis=0), 0)
+    return out, hit, row_map

@@ -314,3 +314,93 @@ class TestNestedRagged:
         from paddle_tpu.core.ragged import NestedRagged
         with pytest.raises(EnforceError):
             NestedRagged.from_parts(np.zeros(5), ([2, 1], [3, 1, 2]))
+
+
+class TestOpTail3:
+    """Tail batch 2: cvm, adaptive_pool3d, lod_append, resize_short,
+    spectral_norm op, dynamic_lstmp, filter_by_instag."""
+
+    def test_cvm(self):
+        from paddle_tpu.ops.tail import continuous_value_model
+        x = jnp.asarray([[3.0, 1.0, 5.0, 6.0]])
+        y = np.asarray(continuous_value_model(x))
+        assert y[0, 0] == pytest.approx(np.log(4.0))
+        assert y[0, 1] == pytest.approx(np.log(2.0) - np.log(4.0))
+        np.testing.assert_allclose(y[0, 2:], [5.0, 6.0])
+        y2 = continuous_value_model(x, use_cvm=False)
+        assert y2.shape == (1, 2)
+
+    def test_adaptive_pool3d(self):
+        from paddle_tpu.ops.tail import adaptive_pool3d
+        x = jnp.arange(64.0).reshape(1, 1, 4, 4, 4)
+        out = adaptive_pool3d(x, 2, "avg")
+        assert out.shape == (1, 1, 2, 2, 2)
+        ref = np.asarray(x).reshape(1, 1, 2, 2, 2, 2, 2, 2).mean((3, 5, 7))
+        np.testing.assert_allclose(np.asarray(out), ref)
+
+    def test_lod_append(self):
+        from paddle_tpu.core.ragged import RaggedBatch
+        from paddle_tpu.ops.tail import lod_append
+        nr = lod_append(jnp.arange(6.0), jnp.asarray([2, 1]),
+                        jnp.asarray([3, 1, 2]))
+        assert nr.num_levels == 2
+        np.testing.assert_array_equal(np.asarray(nr.outer_segment_ids()),
+                                      [0, 0, 0, 0, 1, 1])
+
+    def test_image_resize_short(self):
+        from paddle_tpu.ops.tail import image_resize_short
+        x = jnp.ones((1, 3, 20, 40))
+        out = image_resize_short(x, 10)
+        assert out.shape == (1, 3, 10, 20)
+
+    def test_spectral_norm_op(self):
+        from paddle_tpu.ops.tail import spectral_norm
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(6, 4).astype(np.float32)) * 3.0
+        u = jnp.asarray(rng.randn(6).astype(np.float32))
+        v = jnp.asarray(rng.randn(4).astype(np.float32))
+        wn, u, v = spectral_norm(w, u, v, power_iters=30)
+        s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+        assert s[0] == pytest.approx(1.0, rel=1e-3)
+
+    def test_dynamic_lstmp_projection(self):
+        from paddle_tpu.ops.tail import dynamic_lstmp
+        rng = np.random.RandomState(0)
+        B, T, I, H, P = 2, 5, 3, 8, 4
+        x = jnp.asarray(rng.randn(B, T, I).astype(np.float32))
+        w_ih = jnp.asarray(rng.randn(I, 4 * H).astype(np.float32)) * 0.2
+        w_hh = jnp.asarray(rng.randn(P, 4 * H).astype(np.float32)) * 0.2
+        w_proj = jnp.asarray(rng.randn(H, P).astype(np.float32)) * 0.3
+        h0 = jnp.zeros((B, P)); c0 = jnp.zeros((B, H))
+        outs, (r, c) = dynamic_lstmp(x, h0, c0, w_ih, w_hh, w_proj)
+        # projection activation is tanh by default (lstmp_op.cc)
+        assert np.abs(np.asarray(outs)).max() <= 1.0
+        assert outs.shape == (B, T, P) and r.shape == (B, P) \
+            and c.shape == (B, H)
+        np.testing.assert_allclose(np.asarray(outs[:, -1]), np.asarray(r),
+                                   rtol=1e-5)
+        # lengths mask freezes state past each row's length
+        outs2, (r2, _) = dynamic_lstmp(x, h0, c0, w_ih, w_hh, w_proj,
+                                       lengths=jnp.asarray([3, 5]))
+        np.testing.assert_allclose(np.asarray(outs2[0, 2]),
+                                   np.asarray(r2[0]), rtol=1e-5)
+
+    def test_filter_by_instag(self):
+        from paddle_tpu.ops.tail import filter_by_instag
+        x = jnp.arange(8.0).reshape(4, 2)
+        tags = jnp.asarray([[1, 0], [2, 0], [3, 2], [4, 0]])
+        out, keep, row_map = filter_by_instag(x, tags, [2])
+        np.testing.assert_array_equal(np.asarray(keep),
+                                      [False, True, True, False])
+        got = np.asarray(out)
+        np.testing.assert_allclose(got[0], [2.0, 3.0])
+        np.testing.assert_allclose(got[1], [4.0, 5.0])
+        np.testing.assert_allclose(got[2:], 0.0)
+        # pad_tag never matches: filter for tag 0 keeps nothing
+        _, keep0, _ = filter_by_instag(x, tags, [0])
+        assert not np.asarray(keep0).any()
+        # out_size > B pads with zero rows and row_map sentinel B
+        out8, _, rm8 = filter_by_instag(x, tags, [2], out_size=8)
+        assert out8.shape == (8, 2)
+        np.testing.assert_allclose(np.asarray(out8)[2:], 0.0)
+        assert np.all(np.asarray(rm8)[2:] == 4)

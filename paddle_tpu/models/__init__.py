@@ -8,7 +8,7 @@
   mnist        smoke-test models                    ref: book recognize_digits
 """
 
-from paddle_tpu.models import (bert, ctr, ernie, mnist, recommender, resnet, seq2seq,
+from paddle_tpu.models import (bert, ctr, ernie, mnist, recommender, resnet, sentiment, seq2seq,
                                tagging, transformer, vision_cls, word2vec)
 from paddle_tpu.models.resnet import ResNet, resnet18, resnet50
 from paddle_tpu.models.seq2seq import AttentionSeq2Seq, Seq2SeqConfig, nmt_loss

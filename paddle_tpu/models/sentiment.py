@@ -1,0 +1,136 @@
+"""Sentiment classification — the book's understand_sentiment fixtures.
+
+Ref: /root/reference/python/paddle/fluid/tests/book/
+test_understand_sentiment.py — three recipes over IMDB: convolution_net
+(text-CNN via sequence_conv_pool), stacked_lstm_net, and a dynamic-RNN
+variant. TPU-first: padded [B, T] batches + length masks instead of LoD;
+the conv net projects centered context windows (sequence_conv's window
+convention over dense batches), the LSTM net stacks masked-scan LSTMs.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import nn
+from paddle_tpu.ops import loss as L
+from paddle_tpu.ops import rnn as R
+
+
+@dataclasses.dataclass
+class SentimentConfig:
+    vocab_size: int = 5149        # imdb word dict size in the book fixture
+    embed_dim: int = 128
+    hidden: int = 128
+    num_classes: int = 2
+    window: int = 3
+
+    @staticmethod
+    def tiny():
+        return SentimentConfig(vocab_size=200, embed_dim=16, hidden=16)
+
+
+class TextCNNSentiment(nn.Module):
+    """convolution_net (ref test_understand_sentiment.py:36): embedding →
+    two context-window conv+pool branches → softmax head."""
+
+    def __init__(self, cfg: SentimentConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.embed_dim,
+                                  weight_init=I.normal(0, 0.1))
+        # window-conv = Linear over the concatenated context window
+        self.conv3 = nn.Linear(3 * cfg.embed_dim, cfg.hidden, act="tanh")
+        self.conv4 = nn.Linear(4 * cfg.embed_dim, cfg.hidden, act="tanh")
+        self.fc = nn.Linear(2 * cfg.hidden, cfg.num_classes)
+
+    def _window_pool(self, emb, mask, width, proj):
+        """Centered width-token window projection then max-pool over time
+        (same window convention as ops/sequence.sequence_conv with
+        context_start=-(width-1)//2; reimplemented over padded [B,T,D]
+        because this model is a dense-batch recipe, not a RaggedBatch op)."""
+        B, T, D = emb.shape
+        start = -((width - 1) // 2)
+        cols = []
+        for k in range(width):
+            off = start + k
+            shifted = jnp.roll(emb, -off, axis=1)
+            pos = jnp.arange(T) + off
+            ok = (pos >= 0)[None, :] & (pos < T)[None, :]
+            cols.append(jnp.where(ok[..., None], shifted, 0.0))
+        win = jnp.concatenate(cols, axis=-1)         # [B, T, width*D]
+        h = proj(win)
+        neg = jnp.asarray(jnp.finfo(h.dtype).min, h.dtype)
+        h = jnp.where(mask[..., None], h, neg)
+        return jnp.max(h, axis=1)
+
+    def forward(self, ids, lengths=None):
+        B, T = ids.shape
+        if lengths is None:
+            lengths = jnp.full((B,), T, jnp.int32)
+        mask = jnp.arange(T)[None, :] < lengths[:, None]
+        emb = self.embed(ids) * mask[..., None]
+        a = self._window_pool(emb, mask, 3, self.conv3)
+        b = self._window_pool(emb, mask, 4, self.conv4)
+        return self.fc(jnp.concatenate([a, b], axis=-1))
+
+
+class _DirLSTM(nn.Module):
+    """One LSTM stack with a fixed scan direction (the book's `is_reverse`
+    flag on dynamic_lstm)."""
+
+    def __init__(self, input_size, hidden_size, reverse=False,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.reverse = reverse
+        self.param("w_ih", (input_size, 4 * hidden_size), I.xavier(), dtype)
+        self.param("w_hh", (hidden_size, 4 * hidden_size), I.xavier(), dtype)
+        self.param("b", (4 * hidden_size,), I.zeros(), dtype)
+
+    def forward(self, x, lengths=None):
+        b = x.shape[0]
+        h0 = jnp.zeros((b, self.hidden_size), x.dtype)
+        c0 = jnp.zeros((b, self.hidden_size), x.dtype)
+        outs, _ = R.lstm(x, h0, c0, self.p("w_ih"), self.p("w_hh"),
+                         self.p("b"), lengths=lengths, reverse=self.reverse)
+        return outs
+
+
+class StackedLSTMSentiment(nn.Module):
+    """stacked_lstm_net (ref test_understand_sentiment.py:62): embedding →
+    stacked (fc + lstm) layers with alternating direction → max-pool head."""
+
+    def __init__(self, cfg: SentimentConfig, stacked_num=3):
+        super().__init__()
+        self.cfg = cfg
+        self.stacked_num = stacked_num
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.embed_dim,
+                                  weight_init=I.normal(0, 0.1))
+        self.fcs = [nn.Linear(cfg.embed_dim if i == 0 else cfg.hidden,
+                              cfg.hidden) for i in range(stacked_num)]
+        self.lstms = [_DirLSTM(cfg.hidden, cfg.hidden, reverse=bool(i % 2))
+                      for i in range(stacked_num)]
+        self.out = nn.Linear(2 * cfg.hidden, cfg.num_classes)
+
+    def forward(self, ids, lengths=None):
+        B, T = ids.shape
+        if lengths is None:
+            lengths = jnp.full((B,), T, jnp.int32)
+        mask = jnp.arange(T)[None, :] < lengths[:, None]
+        h = self.embed(ids) * mask[..., None]
+        for i in range(self.stacked_num):
+            f = self.fcs[i](h)
+            # alternate scan direction per stack (the book's inverse flag)
+            h = self.lstms[i](f, lengths=lengths)
+        neg = jnp.asarray(jnp.finfo(h.dtype).min, h.dtype)
+        masked_h = jnp.where(mask[..., None], h, neg)
+        pooled_h = jnp.max(masked_h, axis=1)
+        masked_f = jnp.where(mask[..., None], f, neg)
+        pooled_f = jnp.max(masked_f, axis=1)
+        return self.out(jnp.concatenate([pooled_h, pooled_f], axis=-1))
+
+
+def sentiment_loss(logits, labels):
+    return jnp.mean(L.softmax_with_cross_entropy(logits, labels))

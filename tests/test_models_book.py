@@ -241,3 +241,54 @@ class TestErnie:
             if l0 is None:
                 l0 = float(loss)
         assert float(loss) < l0
+
+
+class TestSentiment:
+    """understand_sentiment book models (ref tests/book/
+    test_understand_sentiment.py)."""
+
+    def _data(self, cfg):
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(1, cfg.vocab_size, (8, 12)))
+        lengths = jnp.asarray(rng.randint(4, 13, (8,)))
+        labels = jnp.asarray(rng.randint(0, 2, (8, 1)))
+        return ids, lengths, labels
+
+    @pytest.mark.parametrize("cls_name", ["TextCNNSentiment",
+                                          "StackedLSTMSentiment"])
+    def test_trains(self, cls_name):
+        from paddle_tpu.models import sentiment as S
+        cfg = S.SentimentConfig.tiny()
+        model = getattr(S, cls_name)(cfg)
+        params = model.init(jax.random.key(0))["params"]
+        ids, lengths, labels = self._data(cfg)
+        opt = pt.optimizer.Adam(5e-3)
+        st = opt.init(params)
+
+        def loss_fn(p):
+            logits = model.apply({"params": p, "state": {}}, ids, lengths)
+            return S.sentiment_loss(logits, labels), None
+
+        step = jax.jit(lambda p, s: opt.minimize(lambda q: loss_fn(q), p, s))
+        l0 = None
+        for _ in range(12):
+            loss, params, st, _ = step(params, st)
+            if l0 is None:
+                l0 = float(loss)
+        assert float(loss) < l0
+
+    def test_padding_invariance(self):
+        """Masked models must ignore pad tokens entirely."""
+        from paddle_tpu.models import sentiment as S
+        cfg = S.SentimentConfig.tiny()
+        model = S.TextCNNSentiment(cfg)
+        v = model.init(jax.random.key(1))
+        rng = np.random.RandomState(2)
+        ids = rng.randint(1, cfg.vocab_size, (2, 10)).astype(np.int32)
+        lengths = jnp.asarray([6, 10])
+        ids2 = ids.copy()
+        ids2[0, 6:] = 7  # change padding content only
+        o1 = model.apply(v, jnp.asarray(ids), lengths)
+        o2 = model.apply(v, jnp.asarray(ids2), lengths)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   atol=1e-6)

@@ -1,0 +1,59 @@
+"""Plan + run a dp x tp distributed BERT step on an 8-device mesh.
+
+On CPU:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         JAX_PLATFORMS=cpu python examples/distributed_dp_tp.py
+On a TPU pod slice the same code runs over the real mesh.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.models.bert import BertConfig, BertForPretraining, \
+    pretrain_loss
+from paddle_tpu.parallel import DistributionPlanner
+
+
+def main():
+    n = len(jax.devices())
+    tp = 2 if n % 2 == 0 else 1
+    mesh = pt.parallel.make_mesh({"dp": n // tp, "tp": tp})
+    print(f"mesh: {dict(mesh.shape)}")
+
+    cfg = BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                     num_heads=4, intermediate_size=128, max_position=64,
+                     dropout=0.0)
+    model = BertForPretraining(cfg)
+    params = model.init(jax.random.key(0))["params"]
+    opt = pt.optimizer.Adam(1e-3)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 512, (16, 32), dtype=np.int32))
+    labels = jnp.asarray(rng.randint(0, 512, (16, 32), dtype=np.int32))
+
+    def step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            mlm, nsp = model.apply({"params": p, "state": {}}, ids)
+            return pretrain_loss(mlm, nsp, labels,
+                                 jnp.zeros((ids.shape[0],), jnp.int32),
+                                 jnp.ones(ids.shape, jnp.float32))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.apply_gradients(params, grads, opt_state)
+        return loss, params, opt_state
+
+    planner = DistributionPlanner(mesh, tp_auto=True)
+    jitted, p, o, plan = planner.compile_step(step, params, opt.init(params),
+                                              (ids, labels), donate=False)
+    print("plan (first entries):")
+    for line in plan.describe().splitlines()[:12]:
+        print(" ", line)
+    with mesh:
+        for i in range(3):
+            loss, p, o = jitted(p, o, ids, labels)
+            print(f"step {i} loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ keeps the reference's sorted-table text report.
 """
 
 import contextlib
+import os
 import time
 from collections import defaultdict
 
@@ -83,3 +84,60 @@ class EventRecorder:
                          f"{r['avg_ms']:>12.3f}{r['min_ms']:>12.3f}"
                          f"{r['max_ms']:>12.3f}")
         return "\n".join(lines)
+
+
+def trace_op_table(trace_dir, device_filter="TPU", top=30, steps=1):
+    """Aggregate a jax.profiler trace into a per-op duration table.
+
+    Ref: the reference's EnableProfiler/DisableProfiler sorted event tables
+    (platform/profiler.h:166, profiler.cc) and tools/timeline.py — here the
+    source is the XPlane chrome-trace JSON that jax.profiler writes.
+
+    trace_dir: the directory passed to jax.profiler.trace / pt.profiler.
+    device_filter: substring of the process/device lane name to aggregate
+    ("TPU" for device ops; use "CPU" on the host platform).
+    steps: divide totals by this to report per-step time.
+
+    Returns a list of {"name", "total_us", "per_step_us", "count"} sorted
+    by time, truncated to `top` (None = all).
+    """
+    import collections
+    import glob
+    import gzip
+    import json
+
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not files:
+        raise FileNotFoundError(
+            f"no trace.json.gz under {trace_dir}/plugins/profile/")
+    with gzip.open(files[-1]) as f:
+        data = json.load(f)
+    ev = data.get("traceEvents", [])
+    lanes = {e["pid"]: e["args"].get("name", "")
+             for e in ev if e.get("ph") == "M"
+             and e.get("name") == "process_name"}
+    dur = collections.Counter()
+    cnt = collections.Counter()
+    for e in ev:
+        if e.get("ph") != "X":
+            continue
+        if device_filter not in lanes.get(e.get("pid"), ""):
+            continue
+        dur[e["name"]] += e.get("dur", 0)
+        cnt[e["name"]] += 1
+    rows = [{"name": n, "total_us": d, "per_step_us": d / max(steps, 1),
+             "count": cnt[n]} for n, d in dur.most_common(top)]
+    return rows
+
+
+def print_op_table(trace_dir, **kw):
+    """Human-readable twin of trace_op_table (the reference's profiler
+    report print)."""
+    rows = trace_op_table(trace_dir, **kw)
+    width = max((len(r["name"]) for r in rows), default=10)
+    print(f"{'op':<{width}}  {'total_us':>12}  {'per_step':>10}  {'count':>6}")
+    for r in rows:
+        print(f"{r['name']:<{width}}  {r['total_us']:>12.0f}  "
+              f"{r['per_step_us']:>10.1f}  {r['count']:>6d}")
+    return rows

@@ -292,3 +292,32 @@ class TestSentiment:
         o2 = model.apply(v, jnp.asarray(ids2), lengths)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    atol=1e-6)
+
+
+def test_examples_run(tmp_path):
+    """The examples/ scripts are living documentation — keep them running."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "examples", "train_resnet.py"),
+         "--steps", "4", "--batch", "8", "--ckpt", str(tmp_path / "ck")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "checkpoint saved" in r.stdout
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "train_ctr_sparse.py"),
+         "--steps", "3", "--batch", "16"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-1500:]
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "distributed_dp_tp.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "plan (first entries):" in r.stdout

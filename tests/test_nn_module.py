@@ -112,3 +112,26 @@ def test_spectral_norm():
     wn, new_state = m.apply(v, w, training=True)
     s = np.linalg.svd(np.asarray(wn), compute_uv=False)
     assert s[0] < 1.5  # roughly unit spectral norm after 1 power iter
+
+
+def test_profiler_trace_op_table(tmp_path):
+    """trace_op_table aggregates a real jax.profiler trace (the reference's
+    EnableProfiler sorted-table role, platform/profiler.h:166)."""
+    import paddle_tpu as pt
+
+    @jax.jit
+    def f(a, b):
+        return jnp.sin(a @ b).sum()
+
+    a = jnp.ones((128, 128))
+    float(f(a, a))
+    with jax.profiler.trace(str(tmp_path)):
+        for _ in range(3):
+            r = f(a, a)
+        float(r)
+    rows = pt.profiler.trace_op_table(str(tmp_path), device_filter="CPU",
+                                      steps=3, top=10)
+    assert rows and all(r["total_us"] >= 0 for r in rows)
+    printed = pt.profiler.print_op_table(str(tmp_path),
+                                         device_filter="CPU", top=5)
+    assert len(printed) <= 5

@@ -194,3 +194,117 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Detection mean average precision (ref metrics.py:805 DetectionMAP +
+    operators/detection/detection_map_op.cc).
+
+    Host-side accumulator over per-image results:
+        update(detections, gt_labels, gt_boxes, gt_difficult=None)
+    detections: [M, 6] rows of (class_label, score, xmin, ymin, xmax, ymax)
+    gt_labels:  [N] int class per ground-truth box
+    gt_boxes:   [N, 4] (xmin, ymin, xmax, ymax)
+    eval() -> mAP over classes with ground truth, via '11point' or
+    'integral' AP (the reference's two ap_version modes).
+    """
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 background_label=0, name=None):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.class_num = class_num
+        self.thresh = overlap_threshold
+        self.eval_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.background = background_label
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, is_tp) + count of (non-difficult) GTs
+        self._scored = {c: [] for c in range(self.class_num)}
+        self._npos = np.zeros(self.class_num, np.int64)
+
+    @staticmethod
+    def _iou(box, boxes):
+        x1 = np.maximum(box[0], boxes[:, 0])
+        y1 = np.maximum(box[1], boxes[:, 1])
+        x2 = np.minimum(box[2], boxes[:, 2])
+        y2 = np.minimum(box[3], boxes[:, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / np.maximum(a + b - inter, 1e-12)
+
+    def update(self, detections, gt_labels, gt_boxes, gt_difficult=None):
+        det = np.asarray(detections, np.float64).reshape(-1, 6)
+        gl = np.asarray(gt_labels).reshape(-1).astype(int)
+        gb = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        diff = (np.zeros(len(gl), bool) if gt_difficult is None
+                else np.asarray(gt_difficult).reshape(-1).astype(bool))
+        for c in np.unique(gl):
+            # out-of-range labels (e.g. -1 padding) are not ground truth
+            if c == self.background or c < 0 or c >= self.class_num:
+                continue
+            sel = gl == c
+            self._npos[c] += int((~diff[sel]).sum()) if not \
+                self.eval_difficult else int(sel.sum())
+        for c in range(self.class_num):
+            if c == self.background:
+                continue
+            dc = det[det[:, 0] == c]
+            gsel = gl == c
+            gboxes = gb[gsel]
+            gdiff = diff[gsel]
+            taken = np.zeros(len(gboxes), bool)
+            # match high-score first (detection_map_op.cc sorts by score)
+            for row in dc[np.argsort(-dc[:, 1])]:
+                score, box = row[1], row[2:6]
+                if len(gboxes) == 0:
+                    self._scored[c].append((score, False))
+                    continue
+                ious = self._iou(box, gboxes)
+                j = int(np.argmax(ious))
+                # strict >, matching detection_map_op.cc:395
+                if ious[j] > self.thresh:
+                    if not self.eval_difficult and gdiff[j]:
+                        continue  # difficult GT ignored entirely
+                    if not taken[j]:
+                        taken[j] = True
+                        self._scored[c].append((score, True))
+                    else:
+                        self._scored[c].append((score, False))
+                else:
+                    self._scored[c].append((score, False))
+
+    def _ap(self, scored, npos):
+        # reference CalcMAP averages only classes that have BOTH ground
+        # truth and detections (detection_map_op.h: labels absent from the
+        # true-positive map are skipped, count not incremented)
+        if npos == 0 or not scored:
+            return None
+        arr = sorted(scored, key=lambda t: -t[0])
+        tp = np.cumsum([1 if t else 0 for _, t in arr])
+        fp = np.cumsum([0 if t else 1 for _, t in arr])
+        recall = tp / npos
+        precision = tp / np.maximum(tp + fp, 1)
+        if self.ap_version == "11point":
+            ap = 0.0
+            for r in np.linspace(0, 1, 11):
+                p = precision[recall >= r]
+                ap += (p.max() if len(p) else 0.0) / 11.0
+            return float(ap)
+        # integral: sum precision at each true-positive hit / npos
+        ap = 0.0
+        for p, (_, is_tp) in zip(precision, arr):
+            if is_tp:
+                ap += p
+        return float(ap / npos)
+
+    def eval(self):
+        aps = [self._ap(self._scored[c], self._npos[c])
+               for c in range(self.class_num) if c != self.background]
+        aps = [a for a in aps if a is not None]
+        return float(np.mean(aps)) if aps else 0.0

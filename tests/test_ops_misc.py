@@ -549,3 +549,58 @@ class TestOpTail2:
                     x, q["w"], label, K, q["b"])), None), p, s))(params, st)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.7
+
+
+class TestDetectionMAP:
+    def test_perfect_detections(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP(class_num=3, ap_version="integral")
+        det = [[1, 0.9, 0, 0, 10, 10], [2, 0.8, 20, 20, 30, 30]]
+        m.update(det, [1, 2], [[0, 0, 10, 10], [20, 20, 30, 30]])
+        assert m.eval() == pytest.approx(1.0)
+
+    def test_mixed_and_11point(self):
+        from paddle_tpu.metrics import DetectionMAP
+        # one class: TP at 0.9, FP at 0.8, one missed GT
+        for ver, expected in (("integral", 0.5), ("11point", None)):
+            m = DetectionMAP(class_num=2, ap_version=ver)
+            m.update([[1, 0.9, 0, 0, 10, 10],
+                      [1, 0.8, 50, 50, 60, 60]],
+                     [1, 1],
+                     [[0, 0, 10, 10], [100, 100, 110, 110]])
+            got = m.eval()
+            if ver == "integral":
+                # AP = precision at the single TP (1.0) / npos (2) = 0.5
+                assert got == pytest.approx(0.5)
+            else:
+                # 11point: p_max = 1.0 for r in {0, .1, ..., .5}; 0 above
+                assert got == pytest.approx(6 / 11, rel=1e-6)
+
+    def test_duplicate_detection_is_fp(self):
+        from paddle_tpu.metrics import DetectionMAP
+        m = DetectionMAP(class_num=2)
+        m.update([[1, 0.9, 0, 0, 10, 10], [1, 0.85, 1, 1, 10, 10]],
+                 [1], [[0, 0, 10, 10]])
+        # second hit on the same GT counts as FP (taken-flag semantics)
+        assert m.eval() == pytest.approx(1.0)  # integral: TP first, ap=1/1
+        m2 = DetectionMAP(class_num=2, ap_version="11point")
+        m2.update([[1, 0.9, 0, 0, 10, 10], [1, 0.85, 1, 1, 10, 10]],
+                  [1], [[0, 0, 10, 10]])
+        assert m2.eval() == pytest.approx(1.0)
+
+    def test_reference_edge_semantics(self):
+        from paddle_tpu.metrics import DetectionMAP
+        # class with GT but no detections is EXCLUDED from the mean
+        m = DetectionMAP(class_num=3)
+        m.update([[1, 0.9, 0, 0, 10, 10]], [1, 2],
+                 [[0, 0, 10, 10], [50, 50, 60, 60]])
+        assert m.eval() == pytest.approx(1.0)
+        # -1-padded GT labels are ignored, not wrapped into class_num-1
+        m2 = DetectionMAP(class_num=3)
+        m2.update([[2, 0.9, 0, 0, 10, 10]], [2, -1, -1],
+                  [[0, 0, 10, 10], [0, 0, 1, 1], [0, 0, 1, 1]])
+        assert m2.eval() == pytest.approx(1.0)
+        # IoU exactly == threshold is a FALSE positive (strict >)
+        m3 = DetectionMAP(class_num=2, overlap_threshold=0.5)
+        m3.update([[1, 0.9, 0, 0, 10, 10]], [1], [[0, 0, 10, 20]])
+        assert m3.eval() == pytest.approx(0.0)

@@ -3,7 +3,7 @@ framework/data_feed.cc)."""
 
 from paddle_tpu.data.loader import DataLoader, batch, shuffle
 from paddle_tpu.data.dataset import (
-    InMemoryDataset,
+    FileDataset, InMemoryDataset,
     synthetic_ctr,
     synthetic_images,
     synthetic_mnist,

@@ -81,3 +81,45 @@ def synthetic_ctr(n, num_sparse=26, num_dense=13, vocab=10000, seed=0):
         sparse = rng.randint(vocab, size=(num_sparse,)).astype(np.int32)
         label = rng.randint(2, size=(1,)).astype(np.float32)
         yield (dense, sparse, label)
+
+
+class FileDataset:
+    """File-backed dataset over the native (C++) record reader — the
+    DataFeed/Dataset successor for real file ingestion (ref data_feed.cc
+    MultiSlotDataFeed reading file lists into channels; dataset.py
+    QueueDataset).
+
+    samples are numpy-record blobs (data/native.numpy_records); readers(n)
+    shards the FILE LIST across ingestion threads like the reference
+    assigns filelists to DataFeed instances.
+    """
+
+    def __init__(self, files, num_threads=2, decode=None):
+        from paddle_tpu.core.enforce import enforce
+        from paddle_tpu.data import native
+        enforce(len(list(files)) > 0, "FileDataset needs at least one file")
+        self._native = native
+        self.files = list(files)
+        self.num_threads = num_threads
+        self.decode = decode or native.unpack_numpy_record
+
+    def _read(self, files, num_threads):
+        rd = self._native.NativeRecordReader(files, num_threads=num_threads)
+        try:
+            for rec in rd:
+                yield self.decode(rec)
+        finally:
+            rd.close()  # release C++ reader threads + ring on any exit
+
+    def reader(self):
+        return lambda: self._read(self.files, self.num_threads)
+
+    def readers(self, n):
+        """min(n, len(files)) shard readers; each shard's native reader
+        uses `num_threads` internal threads (total native threads =
+        shards x num_threads)."""
+        m = max(min(n, len(self.files)), 1)
+        return [
+            (lambda i=i: self._read(self.files[i::m], self.num_threads))
+            for i in range(m)
+        ]

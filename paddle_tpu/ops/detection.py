@@ -62,7 +62,7 @@ def box_clip(boxes, im_shape):
 # ------------------------------------------------------------------ box_coder
 @register_op("box_coder")
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0):
+              box_normalized=True, axis=0, box_clip=None):
     """Encode/decode boxes against priors. ref: detection/box_coder_op.{cc,h}.
 
     encode_center_size: target [N,4] x prior [M,4] -> [N,M,4]
@@ -103,8 +103,13 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
         v = var[:, None, :]
     cx = v[..., 0] * t[..., 0] * pw_ + pcx_
     cy = v[..., 1] * t[..., 1] * ph_ + pcy_
-    w = jnp.exp(v[..., 2] * t[..., 2]) * pw_
-    h = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+    dw = v[..., 2] * t[..., 2]
+    dh = v[..., 3] * t[..., 3]
+    if box_clip is not None:  # ref box_decoder_and_assign_op.h bbox_clip
+        dw = jnp.minimum(dw, box_clip)
+        dh = jnp.minimum(dh, box_clip)
+    w = jnp.exp(dw) * pw_
+    h = jnp.exp(dh) * ph_
     return jnp.stack([cx - w * 0.5, cy - h * 0.5,
                       cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
 
@@ -720,3 +725,175 @@ def distribute_fpn_proposals(rois, min_level=2, max_level=5, refer_level=4,
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
     mask = jax.nn.one_hot(lvl - min_level, max_level - min_level + 1)
     return lvl, mask
+
+
+@register_op("box_decoder_and_assign")
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135):
+    """ref: detection/box_decoder_and_assign_op.h — per-class box decode
+    (Cascade R-CNN style) then assign each ROI the decoded box of its
+    best non-background class (falling back to the prior when background
+    wins).
+
+    prior_box [R, 4]; prior_box_var [4]; target_box [R, C*4] per-class
+    deltas; box_score [R, C] (class 0 = background).
+    Returns (decode_box [R, C*4], assign_box [R, 4]).
+    """
+    R = prior_box.shape[0]
+    C = box_score.shape[1]
+    decode = box_coder(prior_box, prior_box_var,
+                       target_box.reshape(R, C, 4),
+                       code_type="decode_center_size", box_normalized=False,
+                       axis=1, box_clip=box_clip)                  # [R,C,4]
+    # best NON-background class (j > 0); background keeps the prior
+    fg_scores = box_score[:, 1:]
+    has_fg = C > 1
+    if has_fg:
+        best = jnp.argmax(fg_scores, axis=1) + 1                   # [R]
+        assigned = jnp.take_along_axis(
+            decode, best[:, None, None].repeat(4, axis=2), axis=1)[:, 0]
+        # reference assigns the prior only when no class j>0 exists;
+        # with C>1 argmax always yields some j>0 (max_score > -1)
+        assign = assigned
+    else:
+        assign = prior_box
+    return decode.reshape(R, C * 4), assign
+
+
+@register_op("rpn_target_assign")
+def rpn_target_assign(key, anchors, gt_boxes, gt_valid=None,
+                      rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3):
+    """Anchor target assignment for RPN training (ref:
+    detection/rpn_target_assign_op.cc).
+
+    Rules (single image):
+      * positive: IoU(anchor, some GT) >= rpn_positive_overlap, OR the
+        anchor is the best-overlap anchor of a GT;
+      * negative: max IoU < rpn_negative_overlap and not positive;
+      * subsample randomly to rpn_batch_size_per_im with at most
+        fg_fraction positives; the rest ignored.
+
+    TPU-first static redesign: instead of gathered index lists (dynamic
+    sizes), returns per-anchor dense outputs:
+      labels [A] int32: 1 fg, 0 bg, -1 ignore (after subsampling)
+      bbox_targets [A, 4]: encode_center_size deltas to the matched GT
+        (zeros for non-positive anchors)
+    anchors [A, 4]; gt_boxes [G, 4] (zero-padded rows allowed with
+    gt_valid [G] mask); key: PRNG key for the random subsample.
+    """
+    A = anchors.shape[0]
+    G = gt_boxes.shape[0]
+    if gt_valid is None:
+        gt_valid = jnp.ones((G,), bool)
+    iou = iou_similarity(anchors, gt_boxes, box_normalized=False)  # [A,G]
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    max_iou = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+
+    pos = max_iou >= rpn_positive_overlap
+    # every anchor tied (within 1e-5) with a valid gt's best overlap is
+    # positive regardless of threshold (ref ScoreAssign
+    # rpn_target_assign_op.cc:188 epsilon tie rule — no scatter, so padded
+    # gts cannot clobber real ones)
+    gt_max = jnp.max(iou, axis=0)                                   # [G]
+    tie = (iou >= gt_max[None, :] - 1e-5) & gt_valid[None, :] & \
+        (gt_max[None, :] > -1.0)
+    pos = pos | jnp.any(tie, axis=1)
+    # anchors below the negative threshold are background — including on
+    # images whose gt rows are all padding (max_iou == -1)
+    neg = (max_iou < rpn_negative_overlap) & ~pos
+
+    # random subsample via per-anchor random ranks (the static twin of the
+    # reference's ReservoirSampling)
+    r1, r2 = jax.random.split(key)
+    fg_cap = int(rpn_batch_size_per_im * rpn_fg_fraction)
+    pos_rand = jnp.where(pos, jax.random.uniform(r1, (A,)), 2.0)
+    pos_rank = jnp.argsort(jnp.argsort(pos_rand))
+    pos_sel = pos & (pos_rank < fg_cap)
+    n_pos = jnp.sum(pos_sel)
+    neg_cap = rpn_batch_size_per_im - n_pos
+    neg_rand = jnp.where(neg, jax.random.uniform(r2, (A,)), 2.0)
+    neg_rank = jnp.argsort(jnp.argsort(neg_rand))
+    neg_sel = neg & (neg_rank < neg_cap)
+
+    labels = jnp.where(pos_sel, 1, jnp.where(neg_sel, 0, -1)).astype(
+        jnp.int32)
+    matched = jnp.take(gt_boxes, argmax_gt, axis=0)                 # [A,4]
+    deltas = _encode_center_size(anchors, matched)
+    bbox_targets = jnp.where(pos_sel[:, None], deltas, 0.0)
+    return labels, bbox_targets
+
+
+def _encode_center_size(anchors, gts, eps=1e-8):
+    """encode_center_size deltas (box_coder_op.h convention, +1 sizes)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = gts[:, 2] - gts[:, 0] + 1
+    gh = gts[:, 3] - gts[:, 1] + 1
+    gcx = gts[:, 0] + gw / 2
+    gcy = gts[:, 1] + gh / 2
+    return jnp.stack([
+        (gcx - acx) / jnp.maximum(aw, eps),
+        (gcy - acy) / jnp.maximum(ah, eps),
+        jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)),
+        jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)),
+    ], axis=1)
+
+
+@register_op("generate_proposal_labels")
+def generate_proposal_labels(key, rois, gt_classes, gt_boxes, gt_valid=None,
+                             batch_size_per_im=512, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, class_num=81,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2)):
+    """RoI sampling + classification/regression targets for the RCNN head
+    (ref: detection/generate_proposal_labels_op.cc SampleRoisForOneImage).
+
+    Rules: fg if max IoU >= fg_thresh (capped at fg_fraction of the
+    batch); bg if bg_thresh_lo <= max IoU < bg_thresh_hi; targets are
+    encode_center_size deltas to the matched GT, laid out per-class
+    (zeros elsewhere) as the head expects.
+
+    TPU-first static redesign (dense masks, no gathered lists):
+      labels [R] int32: class id for sampled fg, 0 for sampled bg,
+        -1 ignored
+      bbox_targets [R, class_num * 4]
+      fg_mask / bg_mask [R] bool
+    """
+    R = rois.shape[0]
+    G = gt_boxes.shape[0]
+    if gt_valid is None:
+        gt_valid = jnp.ones((G,), bool)
+    iou = iou_similarity(rois, gt_boxes, box_normalized=False)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    max_iou = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+
+    fg = max_iou >= fg_thresh
+    bg = (max_iou < bg_thresh_hi) & (max_iou >= bg_thresh_lo) & ~fg
+
+    r1, r2 = jax.random.split(key)
+    fg_cap = int(batch_size_per_im * fg_fraction)
+    fg_rand = jnp.where(fg, jax.random.uniform(r1, (R,)), 2.0)
+    fg_sel = fg & (jnp.argsort(jnp.argsort(fg_rand)) < fg_cap)
+    bg_cap = batch_size_per_im - jnp.sum(fg_sel)
+    bg_rand = jnp.where(bg, jax.random.uniform(r2, (R,)), 2.0)
+    bg_sel = bg & (jnp.argsort(jnp.argsort(bg_rand)) < bg_cap)
+
+    cls = jnp.take(gt_classes.astype(jnp.int32), argmax_gt)
+    labels = jnp.where(fg_sel, cls, jnp.where(bg_sel, 0, -1)).astype(
+        jnp.int32)
+    matched = jnp.take(gt_boxes, argmax_gt, axis=0)
+    # ref BoxToDelta divides by bbox_reg_weights
+    # (generate_proposal_labels_op.cc:314; Python default [.1,.1,.2,.2])
+    deltas = _encode_center_size(rois, matched) / jnp.asarray(
+        bbox_reg_weights, rois.dtype)                 # [R, 4]
+    # per-class layout: write the 4 deltas into the label's slot
+    tgt = jnp.zeros((R, class_num, 4), deltas.dtype)
+    safe_cls = jnp.clip(cls, 0, class_num - 1)
+    tgt = tgt.at[jnp.arange(R), safe_cls].set(
+        jnp.where(fg_sel[:, None], deltas, 0.0))
+    return labels, tgt.reshape(R, class_num * 4), fg_sel, bg_sel

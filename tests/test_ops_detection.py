@@ -340,3 +340,128 @@ class TestProposalsMatching:
         lvl, mask = D.distribute_fpn_proposals(rois, 2, 5, 4, 224)
         np.testing.assert_array_equal(np.asarray(lvl), [2, 4, 5])
         assert mask.shape == (3, 4)
+
+
+class TestRpnTargetAssign:
+    def test_threshold_and_best_anchor_rules(self):
+        from paddle_tpu.ops.detection import rpn_target_assign
+        anchors = jnp.asarray([
+            [0, 0, 10, 10],     # IoU 1.0 with gt0 -> positive
+            [100, 100, 110, 110],  # far from all -> negative
+            [0, 0, 7, 10],      # partial overlap with gt0
+            [200, 200, 204, 204],  # best anchor for gt1 (small IoU)
+        ], jnp.float32)
+        gts = jnp.asarray([[0, 0, 10, 10], [199, 199, 210, 210]],
+                          jnp.float32)
+        labels, targets = rpn_target_assign(
+            jax.random.key(0), anchors, gts,
+            rpn_batch_size_per_im=8, rpn_fg_fraction=0.5)
+        l = np.asarray(labels)
+        assert l[0] == 1           # IoU 1.0
+        assert l[1] == 0           # clear negative
+        assert l[3] == 1           # best anchor of gt1 despite low IoU
+        # positive targets encode toward the matched gt; negatives zero
+        t = np.asarray(targets)
+        assert np.allclose(t[0], 0.0, atol=1e-6)  # perfect match -> ~0
+        assert np.allclose(t[1], 0.0)
+        assert not np.allclose(t[3], 0.0)
+
+    def test_subsample_caps(self):
+        from paddle_tpu.ops.detection import rpn_target_assign
+        rng = np.random.RandomState(0)
+        # many positives: anchors == one gt
+        anchors = jnp.asarray(np.tile([[0, 0, 10, 10]], (100, 1)),
+                              jnp.float32)
+        gts = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        labels, _ = rpn_target_assign(jax.random.key(1), anchors, gts,
+                                      rpn_batch_size_per_im=32,
+                                      rpn_fg_fraction=0.25)
+        l = np.asarray(labels)
+        assert (l == 1).sum() == 8          # fg cap = 32 * 0.25
+        assert (l == 0).sum() == 0          # no negatives here
+        assert (l == -1).sum() == 92
+
+    def test_padded_gt_rows_ignored(self):
+        from paddle_tpu.ops.detection import rpn_target_assign
+        anchors = jnp.asarray([[0, 0, 4, 4], [50, 50, 60, 60]], jnp.float32)
+        gts = jnp.asarray([[50, 50, 60, 60], [0, 0, 0, 0]], jnp.float32)
+        labels, _ = rpn_target_assign(
+            jax.random.key(2), anchors, gts,
+            gt_valid=jnp.asarray([True, False]),
+            rpn_batch_size_per_im=2, rpn_negative_overlap=0.3)
+        l = np.asarray(labels)
+        assert l[1] == 1            # matches the real gt
+        assert l[0] == 0            # padded gt can't make it positive
+
+
+class TestBoxDecoderAndAssign:
+    def test_decode_and_assign(self):
+        from paddle_tpu.ops.detection import box_decoder_and_assign
+        prior = jnp.asarray([[0, 0, 9, 9]], jnp.float32)   # w=h=10
+        var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+        # two classes (bg + 1 fg); fg deltas zero -> decode == prior
+        tb = jnp.zeros((1, 8), jnp.float32)
+        score = jnp.asarray([[0.3, 0.7]], jnp.float32)
+        decode, assign = box_decoder_and_assign(prior, var, tb, score)
+        assert decode.shape == (1, 8) and assign.shape == (1, 4)
+        np.testing.assert_allclose(np.asarray(assign[0]), [0, 0, 9, 9],
+                                   atol=1e-5)
+        # nonzero dx shifts the assigned box
+        tb2 = tb.at[0, 4].set(1.0)  # class-1 dx
+        _, assign2 = box_decoder_and_assign(prior, var, tb2, score)
+        assert float(assign2[0, 0]) == pytest.approx(1.0, abs=1e-5)
+
+
+class TestGenerateProposalLabels:
+    def test_sampling_and_targets(self):
+        from paddle_tpu.ops.detection import generate_proposal_labels
+        rois = jnp.asarray([
+            [0, 0, 10, 10],      # IoU 1.0 with gt0 (class 3) -> fg
+            [0, 0, 5, 10],       # IoU ~0.5 boundary
+            [40, 40, 50, 50],    # no overlap -> bg
+        ], jnp.float32)
+        gt_cls = jnp.asarray([3])
+        gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        labels, tgt, fg, bg = generate_proposal_labels(
+            jax.random.key(0), rois, gt_cls, gt,
+            batch_size_per_im=4, fg_fraction=0.5, class_num=5)
+        l = np.asarray(labels)
+        assert l[0] == 3 and l[2] == 0
+        t = np.asarray(tgt).reshape(3, 5, 4)
+        assert np.allclose(t[0, 3], 0.0, atol=1e-6)  # perfect match
+        assert np.allclose(t[0, :3], 0.0) and np.allclose(t[0, 4:], 0.0)
+        assert np.allclose(t[2], 0.0)                # bg rows zero
+
+    def test_fg_cap(self):
+        from paddle_tpu.ops.detection import generate_proposal_labels
+        rois = jnp.asarray(np.tile([[0, 0, 10, 10]], (50, 1)), jnp.float32)
+        labels, _, fg, _ = generate_proposal_labels(
+            jax.random.key(1), rois, jnp.asarray([2]),
+            jnp.asarray([[0, 0, 10, 10]], jnp.float32),
+            batch_size_per_im=16, fg_fraction=0.25, class_num=4)
+        assert int(np.asarray(fg).sum()) == 4
+
+    def test_reg_weights_and_no_gt_image(self):
+        from paddle_tpu.ops.detection import (generate_proposal_labels,
+                                              rpn_target_assign)
+        rois = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        gt = jnp.asarray([[2, 2, 12, 12]], jnp.float32)
+        _, t1, _, _ = generate_proposal_labels(
+            jax.random.key(0), rois, jnp.asarray([1]), gt,
+            batch_size_per_im=2, class_num=2,
+            bbox_reg_weights=(0.1, 0.1, 0.2, 0.2))
+        _, t2, _, _ = generate_proposal_labels(
+            jax.random.key(0), rois, jnp.asarray([1]), gt,
+            batch_size_per_im=2, class_num=2,
+            bbox_reg_weights=(1.0, 1.0, 1.0, 1.0))
+        a, b = np.asarray(t1).reshape(2, 4)[1], np.asarray(t2).reshape(2, 4)[1]
+        np.testing.assert_allclose(a[:2], b[:2] / 0.1, rtol=1e-5)
+
+        # all-padded image still yields a full negative batch
+        anchors = jnp.asarray([[0, 0, 4, 4], [9, 9, 12, 12]], jnp.float32)
+        labels, _ = rpn_target_assign(
+            jax.random.key(1), anchors,
+            jnp.zeros((2, 4), jnp.float32),
+            gt_valid=jnp.asarray([False, False]),
+            rpn_batch_size_per_im=2)
+        assert (np.asarray(labels) == 0).all()

@@ -62,7 +62,7 @@ def box_clip(boxes, im_shape):
 # ------------------------------------------------------------------ box_coder
 @register_op("box_coder")
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0, box_clip=None):
+              box_normalized=True, axis=0, bbox_clip=None):
     """Encode/decode boxes against priors. ref: detection/box_coder_op.{cc,h}.
 
     encode_center_size: target [N,4] x prior [M,4] -> [N,M,4]
@@ -105,9 +105,9 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     cy = v[..., 1] * t[..., 1] * ph_ + pcy_
     dw = v[..., 2] * t[..., 2]
     dh = v[..., 3] * t[..., 3]
-    if box_clip is not None:  # ref box_decoder_and_assign_op.h bbox_clip
-        dw = jnp.minimum(dw, box_clip)
-        dh = jnp.minimum(dh, box_clip)
+    if bbox_clip is not None:  # ref box_decoder_and_assign_op.h bbox_clip
+        dw = jnp.minimum(dw, bbox_clip)
+        dh = jnp.minimum(dh, bbox_clip)
     w = jnp.exp(dw) * pw_
     h = jnp.exp(dh) * ph_
     return jnp.stack([cx - w * 0.5, cy - h * 0.5,
@@ -729,7 +729,7 @@ def distribute_fpn_proposals(rois, min_level=2, max_level=5, refer_level=4,
 
 @register_op("box_decoder_and_assign")
 def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
-                           box_clip=4.135):
+                           bbox_clip=4.135):
     """ref: detection/box_decoder_and_assign_op.h — per-class box decode
     (Cascade R-CNN style) then assign each ROI the decoded box of its
     best non-background class (falling back to the prior when background
@@ -744,7 +744,7 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
     decode = box_coder(prior_box, prior_box_var,
                        target_box.reshape(R, C, 4),
                        code_type="decode_center_size", box_normalized=False,
-                       axis=1, box_clip=box_clip)                  # [R,C,4]
+                       axis=1, bbox_clip=bbox_clip)                # [R,C,4]
     # best NON-background class (j > 0); background keeps the prior
     fg_scores = box_score[:, 1:]
     has_fg = C > 1
@@ -868,7 +868,10 @@ def generate_proposal_labels(key, rois, gt_classes, gt_boxes, gt_valid=None,
     if gt_valid is None:
         gt_valid = jnp.ones((G,), bool)
     iou = iou_similarity(rois, gt_boxes, box_normalized=False)
-    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    # padded gt columns mask to 0.0 (not -1): a gt-free image then has
+    # max_iou 0 and still yields background samples, matching the
+    # reference's [bg_thresh_lo, bg_thresh_hi) rule
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
     max_iou = jnp.max(iou, axis=1)
     argmax_gt = jnp.argmax(iou, axis=1)
 
@@ -897,3 +900,96 @@ def generate_proposal_labels(key, rois, gt_classes, gt_boxes, gt_valid=None,
     tgt = tgt.at[jnp.arange(R), safe_cls].set(
         jnp.where(fg_sel[:, None], deltas, 0.0))
     return labels, tgt.reshape(R, class_num * 4), fg_sel, bg_sel
+
+
+@register_op("roi_perspective_transform")
+def roi_perspective_transform(x, rois, roi_batch_idx, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Perspective-warp quadrilateral ROIs to a fixed grid (ref:
+    detection/roi_perspective_transform_op.cc — the OCR text-region op).
+
+    x: [N, C, H, W]; rois: [R, 8] quad corners (x0,y0,...,x3,y3 clockwise
+    from top-left); roi_batch_idx: [R] image index per roi.
+    Returns (out [R, C, th, tw], mask [R, 1, th, tw]) — mask 0 where the
+    source coordinate falls outside the image (out is 0 there), matching
+    the reference's out-of-range handling; the exact point-in-quad edge
+    test is subsumed by the transform (interior grid points map inside
+    the quad by construction).
+    """
+    th, tw = int(transformed_height), int(transformed_width)
+    N, C, H, W = x.shape
+    q = rois.reshape(-1, 4, 2) * spatial_scale
+    rx, ry = q[..., 0], q[..., 1]                      # [R, 4]
+
+    # --- per-roi transform matrix (get_transform_matrix, vectorized) ---
+    def lengths(a, b):
+        return jnp.sqrt(jnp.sum((q[:, a] - q[:, b]) ** 2, axis=-1))
+    est_w = (lengths(0, 1) + lengths(2, 3)) / 2.0
+    est_h = (lengths(1, 2) + lengths(3, 0)) / 2.0
+    nh = jnp.asarray(float(max(2, th)), x.dtype)
+    nw = jnp.clip(jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-5))
+                  .astype(jnp.int32) + 1, 2, tw).astype(x.dtype)
+    dx1 = rx[:, 1] - rx[:, 2]
+    dx2 = rx[:, 3] - rx[:, 2]
+    dx3 = rx[:, 0] - rx[:, 1] + rx[:, 2] - rx[:, 3]
+    dy1 = ry[:, 1] - ry[:, 2]
+    dy2 = ry[:, 3] - ry[:, 2]
+    dy3 = ry[:, 0] - ry[:, 1] + ry[:, 2] - ry[:, 3]
+    den = dx1 * dy2 - dx2 * dy1 + 1e-5
+    m6 = (dx3 * dy2 - dx2 * dy3) / den / (nw - 1)
+    m7 = (dx1 * dy3 - dx3 * dy1) / den / (nh - 1)
+    m3 = (ry[:, 1] - ry[:, 0] + m6 * (nw - 1) * ry[:, 1]) / (nw - 1)
+    m4 = (ry[:, 3] - ry[:, 0] + m7 * (nh - 1) * ry[:, 3]) / (nh - 1)
+    m5 = ry[:, 0]
+    m0 = (rx[:, 1] - rx[:, 0] + m6 * (nw - 1) * rx[:, 1]) / (nw - 1)
+    m1 = (rx[:, 3] - rx[:, 0] + m7 * (nh - 1) * rx[:, 3]) / (nh - 1)
+    m2 = rx[:, 0]
+
+    # --- source coords for the output grid (get_source_coords) ---
+    ow = jnp.arange(tw, dtype=x.dtype)[None, None, :]   # [1, 1, tw]
+    oh = jnp.arange(th, dtype=x.dtype)[None, :, None]   # [1, th, 1]
+    u = m0[:, None, None] * ow + m1[:, None, None] * oh + m2[:, None, None]
+    v = m3[:, None, None] * ow + m4[:, None, None] * oh + m5[:, None, None]
+    w_ = m6[:, None, None] * ow + m7[:, None, None] * oh + 1.0
+    in_w = u / w_                                       # [R, th, tw]
+    in_h = v / w_
+
+    # validity: inside the image AND inside the quad's mapped region —
+    # columns beyond the per-roi normalized width nw extrapolate past the
+    # quad (the reference's in_quad test), and w must stay positive
+    col = jnp.arange(tw, dtype=x.dtype)[None, None, :]
+    valid = ((in_w >= -0.5) & (in_w <= W - 0.5)
+             & (in_h >= -0.5) & (in_h <= H - 0.5)
+             & (col <= nw[:, None, None] - 1) & (w_ > 1e-6))
+
+    # --- bilinear sample; border-clamp coords like the reference
+    # (roi_perspective_transform_op.cc:197 clamps before interpolating,
+    # so edge samples get the full border pixel, not an attenuated one) ---
+    feats = jnp.take(x, roi_batch_idx.astype(jnp.int32), axis=0)  # [R,C,H,W]
+    in_w_c = jnp.clip(in_w, 0.0, W - 1.0)
+    in_h_c = jnp.clip(in_h, 0.0, H - 1.0)
+    x0 = jnp.floor(in_w_c)
+    y0 = jnp.floor(in_h_c)
+    fx = in_w_c - x0
+    fy = in_h_c - y0
+
+    # gather via flat indexing (vectorized, no per-tap loops over R)
+    def gather(yi, xi):
+        flat = feats.reshape(-1, C, H * W)
+        idx = (yi * W + xi).reshape(rois.shape[0], 1, -1)
+        out = jnp.take_along_axis(flat, idx.repeat(C, 1), axis=2)
+        return out.reshape(rois.shape[0], C, th, tw)
+
+    acc = jnp.zeros((rois.shape[0], C, th, tw), x.dtype)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yy = y0 + dy
+            xx = x0 + dx
+            ok = (xx >= 0) & (xx < W) & (yy >= 0) & (yy < H)
+            wgt = ((fx if dx else 1 - fx) * (fy if dy else 1 - fy))
+            wgt = jnp.where(ok, wgt, 0.0)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            acc = acc + gather(yi, xi) * wgt[:, None]
+    out = jnp.where(valid[:, None], acc, 0.0)
+    return out, valid[:, None].astype(x.dtype)

@@ -465,3 +465,63 @@ class TestGenerateProposalLabels:
             gt_valid=jnp.asarray([False, False]),
             rpn_batch_size_per_im=2)
         assert (np.asarray(labels) == 0).all()
+
+
+class TestRoiPerspectiveTransform:
+    def test_identity_axis_aligned_quad(self):
+        from paddle_tpu.ops.detection import roi_perspective_transform
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(1, 2, 6, 8), jnp.float32)
+        # quad = the full image rectangle, output size == input size
+        rois = jnp.asarray([[0, 0, 7, 0, 7, 5, 0, 5]], jnp.float32)
+        out, mask = roi_perspective_transform(x, rois, jnp.asarray([0]),
+                                              transformed_height=6,
+                                              transformed_width=8)
+        assert out.shape == (1, 2, 6, 8)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(x[0]),
+                                   rtol=1e-4, atol=1e-5)
+        assert np.asarray(mask).min() == 1.0
+
+    def test_out_of_image_masked(self):
+        from paddle_tpu.ops.detection import roi_perspective_transform
+        x = jnp.ones((1, 1, 4, 4), jnp.float32)
+        # quad partially beyond the image
+        rois = jnp.asarray([[2, 2, 9, 2, 9, 9, 2, 9]], jnp.float32)
+        out, mask = roi_perspective_transform(x, rois, jnp.asarray([0]),
+                                              transformed_height=4,
+                                              transformed_width=4)
+        m = np.asarray(mask[0, 0])
+        assert m[0, 0] == 1.0 and m[-1, -1] == 0.0
+        assert float(out[0, 0, -1, -1]) == 0.0
+
+    def test_batch_index_selects_image(self):
+        from paddle_tpu.ops.detection import roi_perspective_transform
+        x = jnp.stack([jnp.zeros((1, 4, 4)), jnp.ones((1, 4, 4))])
+        rois = jnp.asarray([[0, 0, 3, 0, 3, 3, 0, 3]], jnp.float32)
+        out, _ = roi_perspective_transform(x, rois, jnp.asarray([1]),
+                                           transformed_height=4,
+                                           transformed_width=4)
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_narrow_quad_columns_masked(self):
+        # columns beyond the per-roi normalized width are outside the quad
+        from paddle_tpu.ops.detection import roi_perspective_transform
+        x = jnp.ones((1, 1, 16, 16), jnp.float32)
+        rois = jnp.asarray([[0, 0, 3, 0, 3, 15, 0, 15]], jnp.float32)
+        out, mask = roi_perspective_transform(x, rois, jnp.asarray([0]),
+                                              transformed_height=16,
+                                              transformed_width=16)
+        m = np.asarray(mask[0, 0])
+        assert m[:, 0].min() == 1.0       # quad interior valid
+        assert m[:, -1].max() == 0.0      # far columns masked out
+
+    def test_no_gt_image_gives_background(self):
+        from paddle_tpu.ops.detection import generate_proposal_labels
+        rois = jnp.asarray([[0, 0, 4, 4], [8, 8, 12, 12]], jnp.float32)
+        labels, _, fg, bg = generate_proposal_labels(
+            jax.random.key(0), rois, jnp.asarray([1]),
+            jnp.zeros((1, 4), jnp.float32),
+            gt_valid=jnp.asarray([False]),
+            batch_size_per_im=2, class_num=3)
+        assert (np.asarray(labels) == 0).all()
+        assert np.asarray(bg).all() and not np.asarray(fg).any()

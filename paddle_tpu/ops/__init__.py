@@ -28,6 +28,7 @@ from paddle_tpu.ops import (
     detection,
     graph,
     loss,
+    mask,
     math,
     metrics_ops,
     nets,

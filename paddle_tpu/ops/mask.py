@@ -1,0 +1,94 @@
+"""Instance-mask target ops (Mask R-CNN training).
+
+Ref: /root/reference/paddle/fluid/operators/detection/
+generate_mask_labels_op.cc + mask_util.cc (Poly2Mask — COCO-style polygon
+rasterization; Polys2MaskWrtBox — rasterize a gt's polygon parts into an
+M x M grid over a box).
+
+TPU-first split: polygons are ragged HOST data, so rasterization is a
+numpy op (like the reference's CPU-only kernel); the produced dense
+[R, M, M] targets feed the jitted mask head. The rasterizer uses even-odd
+crossing counts at pixel centers (sub-pixel boundary handling differs from
+COCO's 5x-upsampled RLE by at most the boundary pixels).
+"""
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("poly2mask")
+def poly2mask(poly_xy, h, w):
+    """Rasterize one polygon (flat [x0, y0, x1, y1, ...]) into a uint8
+    [h, w] mask — even-odd rule at pixel centers (ref mask_util.cc
+    Poly2Mask capability)."""
+    pts = np.asarray(poly_xy, np.float64).reshape(-1, 2)
+    enforce(len(pts) >= 3, "polygon needs >= 3 points")
+    ys = np.arange(h) + 0.5
+    xs = np.arange(w) + 0.5
+    x0 = pts[:, 0]
+    y0 = pts[:, 1]
+    x1 = np.roll(x0, -1)
+    y1 = np.roll(y0, -1)
+    mask = np.zeros((h, w), np.uint8)
+    for row, yc in enumerate(ys):
+        # edges crossing this scanline
+        cross = (y0 <= yc) != (y1 <= yc)
+        if not cross.any():
+            continue
+        xi = x0[cross] + (yc - y0[cross]) * (x1[cross] - x0[cross]) \
+            / (y1[cross] - y0[cross])
+        inside = (xi[None, :] <= xs[:, None]).sum(axis=1) % 2 == 1
+        mask[row] = inside
+    return mask
+
+
+@register_op("polys_to_mask_wrt_box")
+def polys_to_mask_wrt_box(polygons, box, resolution):
+    """Rasterize a gt's polygon parts into an M x M grid over `box`
+    (ref mask_util.cc Polys2MaskWrtBox: scale each part into the box frame,
+    union the parts)."""
+    x0, y0, x1, y1 = [float(v) for v in box]
+    w = max(x1 - x0, 1.0)
+    h = max(y1 - y0, 1.0)
+    out = np.zeros((resolution, resolution), np.uint8)
+    for part in polygons:
+        p = np.asarray(part, np.float64).reshape(-1, 2).copy()
+        p[:, 0] = (p[:, 0] - x0) * resolution / w
+        p[:, 1] = (p[:, 1] - y0) * resolution / h
+        out |= poly2mask(p.reshape(-1), resolution, resolution)
+    return out
+
+
+@register_op("generate_mask_labels")
+def generate_mask_labels(rois, labels, gt_boxes, gt_polys, resolution=14):
+    """Mask targets for sampled fg rois (ref generate_mask_labels_op.cc).
+
+    rois [R, 4]; labels [R] (output of generate_proposal_labels: class id
+    for fg, 0 bg, -1 ignore); gt_boxes [G, 4]; gt_polys: list of G
+    polygon-part lists. Returns float32 [R, resolution, resolution] with
+    mask targets for fg rois and -1 (ignore) elsewhere — the dense static
+    twin of the reference's gathered mask_rois/mask_int32.
+    """
+    rois = np.asarray(rois, np.float64)
+    labels = np.asarray(labels).astype(int)
+    gtb = np.asarray(gt_boxes, np.float64)
+    R = rois.shape[0]
+    out = np.full((R, resolution, resolution), -1.0, np.float32)
+    # match rois to gts with the SAME +1 IoU convention as the label
+    # sampler (iou_similarity box_normalized=False), so the mask comes
+    # from the gt whose class the roi was labeled with
+    from paddle_tpu.ops.detection import iou_similarity
+    iou = np.asarray(iou_similarity(rois.astype(np.float32),
+                                    gtb.astype(np.float32),
+                                    box_normalized=False))  # [R, G]
+    for r in range(R):
+        if labels[r] <= 0:
+            continue
+        g = int(np.argmax(iou[r]))
+        if iou[r, g] <= 0:
+            continue  # label/gt mismatch from the caller: keep -1 ignore
+        out[r] = polys_to_mask_wrt_box(gt_polys[g], rois[r],
+                                       resolution).astype(np.float32)
+    return out

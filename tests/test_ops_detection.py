@@ -525,3 +525,42 @@ class TestRoiPerspectiveTransform:
             batch_size_per_im=2, class_num=3)
         assert (np.asarray(labels) == 0).all()
         assert np.asarray(bg).all() and not np.asarray(fg).any()
+
+
+class TestMaskLabels:
+    def test_poly2mask_square(self):
+        from paddle_tpu.ops.mask import poly2mask
+        # unit-aligned square covering columns 2..5, rows 1..4
+        m = poly2mask([2, 1, 6, 1, 6, 5, 2, 5], 8, 8)
+        ref = np.zeros((8, 8), np.uint8)
+        ref[1:5, 2:6] = 1
+        np.testing.assert_array_equal(m, ref)
+
+    def test_polys_to_mask_wrt_box(self):
+        from paddle_tpu.ops.mask import polys_to_mask_wrt_box
+        # polygon == left half of the box -> left half of the grid
+        box = [10, 10, 30, 30]
+        poly = [10, 10, 20, 10, 20, 30, 10, 30]
+        m = polys_to_mask_wrt_box([poly], box, resolution=8)
+        np.testing.assert_array_equal(m[:, :4], 1)
+        np.testing.assert_array_equal(m[:, 4:], 0)
+
+    def test_generate_mask_labels(self):
+        from paddle_tpu.ops.mask import generate_mask_labels
+        rois = [[0, 0, 10, 10], [20, 20, 30, 30]]
+        labels = [3, 0]                      # roi0 fg, roi1 bg
+        gt_boxes = [[0, 0, 10, 10]]
+        gt_polys = [[[0, 0, 10, 0, 10, 10, 0, 10]]]  # full box
+        t = generate_mask_labels(rois, labels, gt_boxes, gt_polys,
+                                 resolution=6)
+        assert t.shape == (2, 6, 6)
+        np.testing.assert_array_equal(t[0], 1.0)   # fg roi: full mask
+        np.testing.assert_array_equal(t[1], -1.0)  # bg roi: ignore
+
+    def test_disjoint_fg_roi_stays_ignore(self):
+        from paddle_tpu.ops.mask import generate_mask_labels
+        t = generate_mask_labels([[100, 100, 110, 110]], [3],
+                                 [[0, 0, 10, 10]],
+                                 [[[0, 0, 10, 0, 10, 10, 0, 10]]],
+                                 resolution=4)
+        np.testing.assert_array_equal(t[0], -1.0)

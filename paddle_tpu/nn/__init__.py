@@ -6,14 +6,18 @@ nn.py modules). See nn/module.py for the programming model.
 
 from paddle_tpu.nn.module import Module, ModuleList, Sequential
 from paddle_tpu.nn.layers import (
+    FC,
+    NCE,
     BatchNorm,
     BilinearTensorProduct,
     Conv2D,
     Conv2DTranspose,
+    Conv3D,
     Dropout,
     Embedding,
     GRU,
     GroupNorm,
+    GRUUnit,
     LSTM,
     LayerNorm,
     Linear,
@@ -21,8 +25,11 @@ from paddle_tpu.nn.layers import (
     Pool2D,
     PRelu,
     RMSNorm,
+    RowConv,
+    SequenceConv,
     SpectralNorm,
     SyncBatchNorm,
+    TreeConv,
 )
 
 Layer = Module  # reference naming alias (dygraph.Layer)

@@ -451,3 +451,156 @@ class MultiHeadAttention(Module):
             num_heads=self.num_heads, mask=mask, causal=causal, kv=kv,
             dropout_rate=self.dropout_rate if self.training else 0.0,
             dropout_key=key, use_flash=self.use_flash)
+
+
+class FC(Linear):
+    """ref: dygraph/nn.py FC — Linear with num_flatten_dims semantics."""
+
+    def __init__(self, in_features, out_features, num_flatten_dims=1, **kw):
+        super().__init__(in_features, out_features, **kw)
+        self.num_flatten_dims = num_flatten_dims
+
+    def forward(self, x):
+        out = F.fc(x, self.p("weight"),
+                   self.p("bias") if self.has_bias else None,
+                   num_flatten_dims=self.num_flatten_dims)
+        return _act(self.act, out)
+
+
+class Conv3D(Module):
+    """ref: dygraph/nn.py Conv3D — weight OIDHW."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias=True, act=None,
+                 weight_init=None, dtype=jnp.float32):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.dilation, self.groups = \
+            stride, padding, dilation, groups
+        self.act = act
+        self.has_bias = bias
+        self.param("weight", (out_channels, in_channels // groups) + k,
+                   weight_init or I.msra(), dtype)
+        if bias:
+            self.param("bias", (out_channels,), I.zeros(), dtype)
+
+    def forward(self, x):
+        out = F.conv3d(x, self.p("weight"),
+                       self.p("bias") if self.has_bias else None,
+                       self.stride, self.padding, self.dilation, self.groups)
+        return _act(self.act, out)
+
+
+class GRUUnit(Module):
+    """ref: dygraph/nn.py GRUUnit — one GRU step over [B, I] + [B, H];
+    origin_mode as in gru_unit_op.h (False default, h' = z*n + (1-z)*h)."""
+
+    def __init__(self, input_size, hidden_size, bias=True,
+                 origin_mode=False, dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.has_bias = bias
+        self.origin_mode = origin_mode
+        self.param("w_ih", (input_size, 3 * hidden_size), I.xavier(), dtype)
+        self.param("w_hh", (hidden_size, 3 * hidden_size), I.xavier(), dtype)
+        if bias:
+            self.param("b_ih", (3 * hidden_size,), I.zeros(), dtype)
+            self.param("b_hh", (3 * hidden_size,), I.zeros(), dtype)
+
+    def forward(self, x, h):
+        return R.gru_cell(x, h, self.p("w_ih"), self.p("w_hh"),
+                          self.p("b_ih") if self.has_bias else None,
+                          self.p("b_hh") if self.has_bias else None,
+                          origin_mode=self.origin_mode)
+
+
+class NCE(Module):
+    """ref: dygraph/nn.py NCE — noise-contrastive estimation head."""
+
+    def __init__(self, dim, num_total_classes, num_neg_samples=10,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.param("weight", (num_total_classes, dim), I.xavier(), dtype)
+        self.param("bias", (num_total_classes,), I.zeros(), dtype)
+
+    def forward(self, input, label):
+        from paddle_tpu.ops import loss as L_
+        key = self.rng("nce")
+        return L_.nce_loss(key, input, label, self.p("weight"),
+                           self.p("bias"), self.num_total_classes,
+                           self.num_neg_samples)
+
+
+class SequenceConv(Module):
+    """ref: dygraph/nn.py SequenceConv — context-window conv over a
+    RaggedBatch."""
+
+    def __init__(self, in_dim, out_dim, context_length=3, context_start=-1,
+                 bias=True, act=None, dtype=jnp.float32):
+        super().__init__()
+        self.context_length = context_length
+        self.context_start = context_start
+        self.act = act
+        self.has_bias = bias
+        self.param("filter", (context_length * in_dim, out_dim),
+                   I.xavier(), dtype)
+        if bias:
+            self.param("bias", (out_dim,), I.zeros(), dtype)
+
+    def forward(self, rb, max_len=None):
+        from paddle_tpu.core.ragged import RaggedBatch
+        from paddle_tpu.ops import sequence as S
+        out = S.sequence_conv(rb, self.p("filter"), self.context_start,
+                              self.context_length,
+                              self.p("bias") if self.has_bias else None,
+                              max_len=max_len)
+        if self.act is not None:
+            out = RaggedBatch(_act(self.act, out.values), out.row_lengths)
+        return out
+
+
+class RowConv(Module):
+    """ref: dygraph/nn.py RowConv — lookahead conv over a RaggedBatch."""
+
+    def __init__(self, dim, future_context=2, dtype=jnp.float32):
+        super().__init__()
+        self.param("filter", (future_context + 1, dim), I.xavier(), dtype)
+
+    def forward(self, rb, max_len=None):
+        from paddle_tpu.ops import sequence as S
+        return S.row_conv(rb, self.p("filter"), max_len=max_len)
+
+
+class TreeConv(Module):
+    """ref: dygraph/nn.py TreeConv — TBCNN over (nodes, edges), with the
+    reference's optional [num_filters] bias."""
+
+    def __init__(self, feature_size, output_size, num_filters, max_depth=2,
+                 act=None, bias=True, dtype=jnp.float32):
+        super().__init__()
+        self.max_depth = max_depth
+        self.act = act
+        self.has_bias = bias
+        self.param("filter", (feature_size, 3, output_size, num_filters),
+                   I.xavier(), dtype)
+        if bias:
+            self.param("bias", (num_filters,), I.zeros(), dtype)
+
+    def build_coef(self, edge_set, n_nodes):
+        """Host-side tree2col using THIS layer's max_depth — use this so
+        the coefficient depth can't drift from the layer config."""
+        import numpy as np
+        from paddle_tpu.ops.graph import tree_patch_coefficients
+        return tree_patch_coefficients(np.asarray(edge_set), n_nodes,
+                                       self.max_depth)
+
+    def forward(self, nodes_vector, coef):
+        """coef from self.build_coef(edge_set) (host-built)."""
+        from paddle_tpu.ops.graph import tree_conv
+        out = tree_conv(nodes_vector, coef, self.p("filter"))
+        if self.has_bias:
+            out = out + self.p("bias")
+        return _act(self.act, out)

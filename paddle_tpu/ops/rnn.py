@@ -37,9 +37,12 @@ def lstm_cell(x, h, c, w_ih, w_hh, b=None, forget_bias=0.0):
 
 
 @register_op("gru_cell")
-def gru_cell(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
+def gru_cell(x, h, w_ih, w_hh, b_ih=None, b_hh=None, origin_mode=False):
     """One GRU step. x:[B,I], h:[B,H], w_ih:[I,3H], w_hh:[H,3H].
-    Gate order r,z,n (ref: operators/math/gru_compute.cc)."""
+    Gate order r,z,n (ref: operators/math/gru_compute.cc).
+
+    origin_mode matches gru_unit_op.h: False (the reference default) gives
+    h' = z*n + (1-z)*h; True gives h' = (1-z)*n + z*h."""
     gi = x @ w_ih
     gh = h @ w_hh
     if b_ih is not None:
@@ -51,7 +54,9 @@ def gru_cell(x, h, w_ih, w_hh, b_ih=None, b_hh=None):
     r = jax.nn.sigmoid(i_r + h_r)
     z = jax.nn.sigmoid(i_z + h_z)
     n = jnp.tanh(i_n + r * h_n)
-    return (1.0 - z) * n + z * h
+    if origin_mode:
+        return (1.0 - z) * n + z * h
+    return z * n + (1.0 - z) * h
 
 
 def _masked_scan(cell_step, xs, init, lengths, reverse=False):

@@ -135,3 +135,72 @@ def test_profiler_trace_op_table(tmp_path):
     printed = pt.profiler.print_op_table(str(tmp_path),
                                          device_filter="CPU", top=5)
     assert len(printed) <= 5
+
+
+class TestDygraphLayerParity:
+    """Round-2 layer classes completing the dygraph/nn.py surface."""
+
+    def test_fc_flatten_dims(self):
+        fc = pt.nn.FC(12, 5, num_flatten_dims=2)
+        v = fc.init(jax.random.key(0))
+        out = fc.apply(v, jnp.ones((2, 3, 4, 3)))
+        assert out.shape == (2, 3, 5)
+
+    def test_conv3d_layer(self):
+        c = pt.nn.Conv3D(2, 4, 3, padding=1)
+        v = c.init(jax.random.key(0))
+        out = c.apply(v, jnp.ones((1, 2, 5, 6, 7)))
+        assert out.shape == (1, 4, 5, 6, 7)
+
+    def test_gru_unit(self):
+        g = pt.nn.GRUUnit(3, 6)
+        v = g.init(jax.random.key(0))
+        h = g.apply(v, jnp.ones((2, 3)), jnp.zeros((2, 6)))
+        assert h.shape == (2, 6)
+
+    def test_nce_layer_trains(self):
+        n = pt.nn.NCE(dim=8, num_total_classes=50, num_neg_samples=5)
+        v = n.init(jax.random.key(0))
+        x = jnp.ones((4, 8))
+        y = jnp.asarray([[1], [2], [3], [4]])
+        loss = n.apply(v, x, y, rngs={"nce": jax.random.key(1)})
+        assert np.isfinite(float(jnp.mean(loss)))
+        g = jax.grad(lambda p: jnp.mean(n.apply(
+            {"params": p, "state": {}}, x, y,
+            rngs={"nce": jax.random.key(1)})))(v["params"])
+        assert np.isfinite(np.asarray(g["weight"]).sum())
+
+    def test_sequence_conv_and_row_conv_layers(self):
+        from paddle_tpu.core.ragged import RaggedBatch
+        rng = np.random.RandomState(0)
+        rb = RaggedBatch.from_list([rng.rand(4, 6), rng.rand(2, 6)],
+                                   dtype=np.float32)
+        sc = pt.nn.SequenceConv(6, 5, act="tanh")
+        v = sc.init(jax.random.key(0))
+        out = sc.apply(v, rb)
+        assert out.values.shape == (6, 5)
+        assert np.abs(np.asarray(out.values)).max() <= 1.0
+        rc = pt.nn.RowConv(6, future_context=2)
+        v2 = rc.init(jax.random.key(1))
+        out2 = rc.apply(v2, rb)
+        assert out2.values.shape == (6, 6)
+
+    def test_tree_conv_layer(self):
+        tc = pt.nn.TreeConv(feature_size=3, output_size=2, num_filters=4,
+                            act="relu")
+        v = tc.init(jax.random.key(0))
+        coef = jnp.asarray(tc.build_coef([[[1, 2], [1, 3], [0, 0]]], 4))
+        out = tc.apply(v, jnp.ones((1, 4, 3)), coef)
+        assert out.shape == (1, 4, 2, 4)
+        assert np.asarray(out).min() >= 0
+        assert "bias" in v["params"]  # reference optional bias present
+
+    def test_gru_unit_origin_mode(self):
+        # reference default (origin_mode=False): h' = z*n + (1-z)*h
+        g0 = pt.nn.GRUUnit(2, 4, origin_mode=False)
+        g1 = pt.nn.GRUUnit(2, 4, origin_mode=True)
+        v = g0.init(jax.random.key(3))
+        x = jnp.ones((1, 2)); h = jnp.full((1, 4), 0.5)
+        h0 = g0.apply(v, x, h)
+        h1 = g1.apply(v, x, h)
+        assert not np.allclose(np.asarray(h0), np.asarray(h1))

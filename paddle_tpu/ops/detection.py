@@ -272,7 +272,7 @@ def nms(boxes, scores, iou_threshold=0.3, score_threshold=-jnp.inf,
 @register_op("multiclass_nms")
 def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                    keep_top_k=100, nms_threshold=0.3, background_label=-1,
-                   box_normalized=True):
+                   box_normalized=True, return_index=False):
     """Multi-class NMS, static-shape output.
 
     bboxes: [N, 4] (shared across classes) or [N, C, 4]; scores: [C, N].
@@ -306,10 +306,14 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
     top_scores, top = lax.top_k(flat_scores, k)
     valid = top_scores > -jnp.inf
     sel_label = flat_labels[top]
-    sel_box = bboxes[flat_idx[top], sel_label]
+    sel_idx = flat_idx[top]
+    sel_box = bboxes[sel_idx, sel_label]
     out = jnp.concatenate([sel_label[:, None].astype(bboxes.dtype),
                            top_scores[:, None], sel_box], axis=-1)
     out = jnp.where(valid[:, None], out, -1.0)
+    if return_index:
+        return out, jnp.where(valid, sel_idx, -1).astype(jnp.int32), \
+            valid.sum()
     return out, valid.sum()
 
 
@@ -760,6 +764,24 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
     return decode.reshape(R, C * 4), assign
 
 
+def _match_anchors(anchors, gt_boxes, gt_valid, pos_threshold,
+                   neg_threshold):
+    """Shared anchor->gt matching core (threshold + epsilon-tie best-anchor
+    rule, ref ScoreAssign rpn_target_assign_op.cc:188): returns
+    (pos, neg, argmax_gt, max_iou)."""
+    iou = iou_similarity(anchors, gt_boxes, box_normalized=False)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    max_iou = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+    pos = max_iou >= pos_threshold
+    gt_max = jnp.max(iou, axis=0)
+    tie = (iou >= gt_max[None, :] - 1e-5) & gt_valid[None, :] & \
+        (gt_max[None, :] > -1.0)
+    pos = pos | jnp.any(tie, axis=1)
+    neg = (max_iou < neg_threshold) & ~pos
+    return pos, neg, argmax_gt, max_iou
+
+
 @register_op("rpn_target_assign")
 def rpn_target_assign(key, anchors, gt_boxes, gt_valid=None,
                       rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
@@ -786,23 +808,9 @@ def rpn_target_assign(key, anchors, gt_boxes, gt_valid=None,
     G = gt_boxes.shape[0]
     if gt_valid is None:
         gt_valid = jnp.ones((G,), bool)
-    iou = iou_similarity(anchors, gt_boxes, box_normalized=False)  # [A,G]
-    iou = jnp.where(gt_valid[None, :], iou, -1.0)
-    max_iou = jnp.max(iou, axis=1)
-    argmax_gt = jnp.argmax(iou, axis=1)
-
-    pos = max_iou >= rpn_positive_overlap
-    # every anchor tied (within 1e-5) with a valid gt's best overlap is
-    # positive regardless of threshold (ref ScoreAssign
-    # rpn_target_assign_op.cc:188 epsilon tie rule — no scatter, so padded
-    # gts cannot clobber real ones)
-    gt_max = jnp.max(iou, axis=0)                                   # [G]
-    tie = (iou >= gt_max[None, :] - 1e-5) & gt_valid[None, :] & \
-        (gt_max[None, :] > -1.0)
-    pos = pos | jnp.any(tie, axis=1)
-    # anchors below the negative threshold are background — including on
-    # images whose gt rows are all padding (max_iou == -1)
-    neg = (max_iou < rpn_negative_overlap) & ~pos
+    pos, neg, argmax_gt, _ = _match_anchors(
+        anchors, gt_boxes, gt_valid, rpn_positive_overlap,
+        rpn_negative_overlap)
 
     # random subsample via per-anchor random ranks (the static twin of the
     # reference's ReservoirSampling)
@@ -993,3 +1001,61 @@ def roi_perspective_transform(x, rois, roi_batch_idx, transformed_height,
             acc = acc + gather(yi, xi) * wgt[:, None]
     out = jnp.where(valid[:, None], acc, 0.0)
     return out, valid[:, None].astype(x.dtype)
+
+
+@register_op("multiclass_nms2")
+def multiclass_nms2(bboxes, scores, score_threshold=0.0, nms_top_k=400,
+                    keep_top_k=100, nms_threshold=0.3, background_label=-1,
+                    box_normalized=True):
+    """multiclass_nms that ALSO returns the kept boxes' input indices
+    (ref: layers/detection.py multiclass_nms2 / multiclass_nms2 op —
+    the index output feeds mask heads). Index layout matches the
+    reference: row index into the [N] box axis, -1 for padding."""
+    # the NMS pipeline already knows each kept row's source index
+    # (flat_idx[top]); expose it instead of reconstructing by coordinates
+    return multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, background_label,
+                          box_normalized, return_index=True)
+
+
+@register_op("detection_output")
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     nms_threshold=0.3, nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, background_label=0):
+    """SSD post-processing (ref layers/detection.py detection_output):
+    decode predicted deltas against priors, then multiclass NMS.
+
+    loc [N, 4] deltas; scores [N, C] class probabilities;
+    prior_box [N, 4]; prior_box_var [N, 4] or [4].
+    Returns (out [keep_top_k, 6], count) like multiclass_nms.
+    """
+    # [N,1,4] deltas against per-row priors (axis=1) -> 1:1 decode
+    decoded = box_coder(prior_box, prior_box_var, loc[:, None, :],
+                        code_type="decode_center_size", axis=1)
+    decoded = decoded.reshape(-1, 4)               # [N, 4]
+    return multiclass_nms(decoded, scores.T, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, background_label)
+
+
+@register_op("retinanet_target_assign")
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, gt_valid=None,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """Anchor targets for RetinaNet (ref:
+    detection/retinanet_target_assign_op.cc): like rpn_target_assign but
+    with NO subsampling (focal loss consumes every anchor) and per-anchor
+    CLASS labels rather than binary objectness.
+
+    Returns (cls_labels [A] int32: gt class for fg, 0 bg, -1 ignore;
+    bbox_targets [A, 4]; fg_mask [A]).
+    """
+    G = gt_boxes.shape[0]
+    if gt_valid is None:
+        gt_valid = jnp.ones((G,), bool)
+    pos, neg, argmax_gt, _ = _match_anchors(
+        anchors, gt_boxes, gt_valid, positive_overlap, negative_overlap)
+    cls = jnp.take(gt_labels.astype(jnp.int32), argmax_gt)
+    labels = jnp.where(pos, cls, jnp.where(neg, 0, -1)).astype(jnp.int32)
+    matched = jnp.take(gt_boxes, argmax_gt, axis=0)
+    deltas = _encode_center_size(anchors, matched)
+    bbox_targets = jnp.where(pos[:, None], deltas, 0.0)
+    return labels, bbox_targets, pos

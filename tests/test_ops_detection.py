@@ -564,3 +564,56 @@ class TestMaskLabels:
                                  [[[0, 0, 10, 0, 10, 10, 0, 10]]],
                                  resolution=4)
         np.testing.assert_array_equal(t[0], -1.0)
+
+
+class TestDetectionComposites:
+    def test_detection_output_pipeline(self):
+        from paddle_tpu.ops.detection import detection_output
+        priors = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30]], jnp.float32)
+        var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+        loc = jnp.zeros((2, 4), jnp.float32)     # decode == priors
+        scores = jnp.asarray([[0.1, 0.9], [0.2, 0.8]], jnp.float32)
+        out, count = detection_output(loc, scores, priors, var,
+                                      keep_top_k=5)
+        assert int(count) == 2
+        o = np.asarray(out)
+        assert set(o[:2, 0].astype(int)) == {1}
+        # decoded boxes come back as the priors themselves
+        got = {tuple(np.round(r[2:6]).astype(int)) for r in o[:2]}
+        assert (0, 0, 10, 10) in got and (20, 20, 30, 30) in got
+
+    def test_multiclass_nms2_indices(self):
+        from paddle_tpu.ops.detection import multiclass_nms2
+        boxes = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30],
+                             [40, 40, 50, 50]], jnp.float32)
+        scores = jnp.asarray([[0.9, 0.05, 0.8]], jnp.float32)  # 1 class
+        out, idx, count = multiclass_nms2(boxes, scores,
+                                          score_threshold=0.1,
+                                          keep_top_k=4)
+        assert int(count) == 2
+        kept = set(np.asarray(idx)[:2].tolist())
+        assert kept == {0, 2}
+        assert (np.asarray(idx)[2:] == -1).all()
+
+    def test_retinanet_target_assign_no_subsample(self):
+        from paddle_tpu.ops.detection import retinanet_target_assign
+        anchors = jnp.asarray([[0, 0, 10, 10], [100, 100, 110, 110],
+                               [0, 0, 9, 10]], jnp.float32)
+        gts = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        labels, tgts, fg = retinanet_target_assign(
+            anchors, gts, jnp.asarray([7]))
+        l = np.asarray(labels)
+        assert l[0] == 7            # fg carries the gt CLASS
+        assert l[1] == 0            # bg
+        assert np.asarray(fg).sum() >= 1
+        assert np.allclose(np.asarray(tgts)[1], 0.0)
+
+    def test_nms2_duplicate_boxes_true_index(self):
+        from paddle_tpu.ops.detection import multiclass_nms2
+        # duplicate coords: index must be the KEPT (higher-score) row
+        boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10]], jnp.float32)
+        scores = jnp.asarray([[0.5, 0.9]], jnp.float32)
+        out, idx, count = multiclass_nms2(boxes, scores,
+                                          score_threshold=0.1)
+        assert int(count) == 1
+        assert int(np.asarray(idx)[0]) == 1

@@ -76,6 +76,11 @@ define_flag("pallas_interpret", False,
 define_flag("use_pallas_layer_norm", True,
             "Route layer_norm through the Pallas TPU kernel; False forces "
             "the XLA twin.")
+# flash-attention backward: Pallas dq/dkv kernels (flash-attn-2 style) vs
+# the recompute-based chunked-XLA fallback
+define_flag("flash_pallas_bwd", True,
+            "Use the Pallas flash-attention backward kernels; False falls "
+            "back to recompute via the chunked XLA formulation.")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

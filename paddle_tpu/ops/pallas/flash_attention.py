@@ -11,8 +11,11 @@ innermost (sequential on TPU), carrying the online-softmax state (running
 max m, running sum l, unnormalized accumulator acc) in VMEM scratch across
 kv steps. fp32 accumulation regardless of input dtype.
 
-Backward: recompute-based (jax.checkpoint over the chunked XLA formulation)
-— trades FLOPs for HBM bandwidth the same way flash-attn-2 does.
+Backward: Pallas dq / dkv kernels (flash-attention-2 style — forward saves
+the per-row logsumexp, backward recomputes probabilities block-wise from
+q,k and lse, never materializing the full score matrix). A recompute-based
+fallback (jax.checkpoint over the chunked XLA formulation) remains behind
+`flash_pallas_bwd=False` as the escape hatch.
 """
 
 import functools
@@ -31,7 +34,7 @@ from paddle_tpu.ops.pallas import on_tpu
 NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                *, scale, causal, block_q, block_k, causal_offset=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -79,11 +82,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
 def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
-                             interpret=None):
+                             interpret=None, return_lse=False):
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
@@ -99,7 +104,7 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
                                causal_offset=tk - tq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -107,8 +112,14 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -116,7 +127,189 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(b, h, tq, d)
+    out = out.reshape(b, h, tq, d)
+    if return_lse:
+        return out, lse.reshape(b, h, tq, 1)
+    return out
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+                      dq_scr, *, scale, causal, block_q, block_k,
+                      causal_offset=0):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)             # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)             # [BK, D]
+        v = v_ref[0].astype(jnp.float32)             # [BK, D]
+        do = do_ref[0].astype(jnp.float32)           # [BQ, D]
+        lse = lse_ref[0]                             # [BQ, 1]
+        delta = dlt_ref[0]                           # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            # mask p (not s) so fully-masked rows — whose saved lse is the
+            # NEG_INF sentinel — can't overflow exp()
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + causal_offset
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)
+        else:
+            p = jnp.exp(s - lse)                     # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + causal_offset)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                       block_q, block_k, causal_offset=0):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _step():
+        q = q_ref[0].astype(jnp.float32)             # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)             # [BK, D]
+        v = v_ref[0].astype(jnp.float32)             # [BK, D]
+        do = do_ref[0].astype(jnp.float32)           # [BQ, D]
+        lse = lse_ref[0]                             # [BQ, 1]
+        delta = dlt_ref[0]                           # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + causal_offset
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)
+        else:
+            p = jnp.exp(s - lse)                     # [BQ, BK]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BK, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [BK, D]
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 + causal_offset >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
+                             block_q, block_k, interpret=None):
+    if interpret is None:
+        from paddle_tpu.core.flags import get_flag
+        interpret = get_flag("pallas_interpret")
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [B, H, Tq, 1]
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+    do3 = do.reshape(bh, tq, d)
+    lse3 = lse.reshape(bh, tq, 1)
+    dlt3 = delta.reshape(bh, tq, 1)
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    nq = pl.cdiv(tq, block_q)
+    nk = pl.cdiv(tk, block_k)
+    offset = tk - tq
+    q_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          causal_offset=offset),
+        grid=(bh, nq, nk),
+        in_specs=q_specs,
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, dlt3)
+    kv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          causal_offset=offset),
+        grid=(bh, nk, nq),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, dlt3)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 def chunked_attention(q, k, v, scale=None, causal=False, chunk_size=512):
@@ -172,12 +365,17 @@ def _flash_core(q, k, v, scale, causal, block_q, block_k):
 
 
 def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k):
-    out = _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q,
+                                        block_k, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    from paddle_tpu.core.flags import get_flag
+    if get_flag("flash_pallas_bwd"):
+        return _flash_attention_bwd_tpu(q, k, v, out, lse, g, scale, causal,
+                                        block_q, block_k)
     _, vjp = jax.vjp(lambda q_, k_, v_: chunked_attention(
         q_, k_, v_, scale=scale, causal=causal, chunk_size=block_k), q, k, v)
     return vjp(g)

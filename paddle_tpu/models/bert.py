@@ -31,7 +31,7 @@ class BertConfig:
     max_position: int = 512
     type_vocab_size: int = 2
     dropout: float = 0.1
-    use_flash: bool = False
+    use_flash: bool = True
 
     @staticmethod
     def base():

@@ -28,18 +28,57 @@ def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
     """
     scale = scale if scale is not None else 1.0 / jnp.sqrt(q.shape[-1])
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    keep = None
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
-        cm = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        scores = jnp.where(cm, scores, -1e9)
+        keep = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
     if mask is not None:
-        scores = jnp.where(mask.astype(bool), scores, -1e9)
+        keep = mask.astype(bool) if keep is None else keep & mask.astype(bool)
+    if keep is not None:
+        scores = jnp.where(keep, scores, -1e9)
     probs = jax.nn.softmax(scores, axis=-1)
+    if keep is not None:
+        # fully-masked rows are defined as exactly zero output — the same
+        # semantics as the flash/chunked paths (a plain softmax would emit
+        # the uniform mean-of-v artifact instead)
+        any_keep = jnp.any(jnp.broadcast_to(keep, scores.shape), -1,
+                           keepdims=True)
+        probs = jnp.where(any_keep, probs, 0.0)
     if dropout_rate > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate,
                                     probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
+def _as_key_padding_mask(mask, batch, tk):
+    """Reduce a broadcastable attention mask to key-padding form [B, Tk]
+    when its per-head and per-query dims are 1 (the padded-batch case the
+    reference feeds through the fused path's eltwise-add bias input).
+    Returns None for masks that genuinely vary per query/head."""
+    if mask is None:
+        return None
+    m = mask
+    if m.ndim == 4 and m.shape[1] == 1 and m.shape[2] == 1:
+        m = m[:, 0, 0, :]
+    elif m.ndim == 3 and m.shape[0] == 1 and m.shape[1] == 1:
+        # a 3D mask's leading dim broadcasts against the HEAD axis in the
+        # dense path, so only the fully-degenerate [1,1,Tk] is unambiguous
+        m = m[:, 0, :]
+    elif m.ndim == 2 and m.shape[0] == 1:
+        # [1, Tk] broadcasts identically under both interpretations; a
+        # [B, Tk] 2D mask would broadcast as [Tq, Tk] per-query in the
+        # dense path, so it must NOT be reduced to key-padding form
+        pass
+    else:
+        return None
+    if m.shape[-1] != tk:
+        return None
+    if m.shape[0] == 1 and batch > 1:
+        m = jnp.broadcast_to(m, (batch, tk))
+    elif m.shape[0] != batch:
+        return None
+    return m.astype(bool)
 
 
 @register_op("multihead_attention")
@@ -62,12 +101,14 @@ def multihead_attention(x, wq, wk, wv, wo, bq=None, bk=None, bv=None, bo=None,
     q = proj(x, wq, bq)
     k = proj(kv, wk, bk)
     v = proj(kv, wv, bv)
-    # flash path supports no arbitrary mask / attention dropout — fall back
-    # to the XLA path rather than silently dropping them
-    if use_flash and mask is None and (dropout_rate == 0.0
-                                       or dropout_key is None):
+    # flash path handles key-padding masks ([B,1,1,Tk]-style) natively;
+    # only an arbitrary per-query mask or attention dropout falls back to
+    # the XLA path
+    kv_mask = _as_key_padding_mask(mask, b, k.shape[2])
+    no_dropout = dropout_rate == 0.0 or dropout_key is None
+    if use_flash and (mask is None or kv_mask is not None) and no_dropout:
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
-        ctx = flash_attention(q, k, v, causal=causal)
+        ctx = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask)
     else:
         ctx = scaled_dot_product_attention(q, k, v, mask=mask, causal=causal,
                                            dropout_rate=dropout_rate,

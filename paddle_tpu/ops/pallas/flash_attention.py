@@ -11,14 +11,28 @@ innermost (sequential on TPU), carrying the online-softmax state (running
 max m, running sum l, unnormalized accumulator acc) in VMEM scratch across
 kv steps. fp32 accumulation regardless of input dtype.
 
-Backward: Pallas dq / dkv kernels (flash-attention-2 style — forward saves
-the per-row logsumexp, backward recomputes probabilities block-wise from
-q,k and lse, never materializing the full score matrix). A recompute-based
-fallback (jax.checkpoint over the chunked XLA formulation) remains behind
-`flash_pallas_bwd=False` as the escape hatch.
+Masking: `kv_mask` [B, Tk] (True = attend) covers the padded-batch case —
+the mask the reference's fused multihead path handles via the eltwise-add
+bias input (multihead_matmul_fuse_pass). Tail blocks (T not divisible by
+the block size) are masked by absolute position inside the kernels, and
+probabilities (not just scores) are masked so a fully-masked row yields
+exactly zero output and zero gradients in both the Pallas and chunked
+paths.
+
+Backward: Pallas dq / dkv kernels by default (flash-attention-2 style —
+the forward saves the per-row logsumexp, the backward recomputes
+probabilities block-wise from q,k and lse, never materializing the full
+score matrix). A recompute-based fallback (jax.checkpoint over the chunked
+XLA formulation) remains behind the `flash_pallas_bwd=False` flag as the
+escape hatch.
+
+lse/delta are carried as [B*H, Tq] with block (1, block_q) so the lane
+dimension is block_q (a [block_q, 1] layout would pad the single lane to
+128 and waste VMEM/bandwidth).
 """
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -33,9 +47,71 @@ from paddle_tpu.ops.pallas import on_tpu
 
 NEG_INF = -1e30
 
+logger = logging.getLogger("paddle_tpu.flash")
+_fallback_logged = set()
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-               *, scale, causal, block_q, block_k, causal_offset=0):
+
+def _log_fallback(reason):
+    """One-time notice when the Pallas fast path is refused — so a user
+    benchmarking "flash" knows they are measuring the chunked fallback."""
+    if reason not in _fallback_logged:
+        _fallback_logged.add(reason)
+        logger.warning("flash_attention: Pallas path refused (%s); "
+                       "using chunked XLA fallback", reason)
+
+
+def _block_valid(qi, ki, *, block_q, block_k, tq, tk, causal, causal_offset,
+                 mask_row):
+    """[BQ, BK] validity for this tile: tail rows/cols past the true
+    sequence end, the causal triangle, and the kv padding mask. Returns
+    None when every position is valid (no masking work needed)."""
+    valid = None
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def _and(a, b):
+        return b if a is None else a & b
+
+    if tq % block_q:
+        valid = _and(valid, q_pos < tq)
+    if tk % block_k:
+        valid = _and(valid, k_pos < tk)
+    if causal:
+        valid = _and(valid, q_pos + causal_offset >= k_pos)
+    if mask_row is not None:
+        valid = _and(valid, mask_row > 0)      # (1, BK) broadcasts over rows
+    return valid
+
+
+def _tail_zero(x, idx, block, t):
+    """Zero the rows of a loaded [block, D] tile that lie past the true
+    sequence end t. Pallas pads out-of-bounds block regions with undefined
+    values (NaN in interpret mode) and 0 * NaN = NaN, so masking the
+    probabilities alone is not enough — the operands themselves must be
+    clean before they enter a matmul. Static no-op when block divides t."""
+    if t % block == 0:
+        return x
+    rows = idx * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    return jnp.where(rows < t, x, 0.0)
+
+
+def _tail_zero_row(x, idx, block, t):
+    """Same for a (1, block) lane-major tile (lse/delta)."""
+    if t % block == 0:
+        return x
+    cols = idx * block + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+    return jnp.where(cols < t, x, 0.0)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
+               causal_offset, tq, tk, has_mask):
+    if has_mask:
+        mask_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        mask_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -47,24 +123,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)            # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)            # [BK, D]
-        v = v_ref[0].astype(jnp.float32)            # [BK, D]
+        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BQ, BK]
-        if causal:
-            # bottom-right aligned (matches scaled_dot_product_attention's
-            # tril(k=tk-tq)): query i may attend keys <= i + (tk - tq)
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + causal_offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                             tq=tq, tk=tk, causal=causal,
+                             causal_offset=causal_offset,
+                             mask_row=mask_ref[...] if has_mask else None)
+        if valid is not None:
+            s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:]                            # [BQ, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                       # [BQ, BK]
+        if valid is not None:
+            # mask p, not just s: in a fully-masked row m_new stays at the
+            # NEG_INF sentinel and exp(s - m_new) = exp(0) = 1 — without
+            # this, masked positions would contribute weight 1 each
+            p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)              # [BQ, 1]
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -73,7 +152,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_scr[:] = m_new
 
     if causal:
-        # skip fully-masked kv blocks above the diagonal
+        # skip kv blocks entirely above the diagonal — sound with or
+        # without a kv mask (a skipped block contributes p == 0 exactly)
         @pl.when(ki * block_k <= qi * block_q + block_q - 1 + causal_offset)
         def _():
             _step()
@@ -82,13 +162,17 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:] + jnp.log(l)
+        l = l_scr[:]
+        l_safe = jnp.maximum(l, 1e-30)
+        # fully-masked rows (l == 0): define the output as exactly zero in
+        # every path (chunked_attention matches)
+        o_ref[0] = jnp.where(l > 0, acc_scr[:] / l_safe, 0.0).astype(
+            o_ref.dtype)
+        lse_ref[...] = jnp.transpose(m_scr[:] + jnp.log(l_safe), (1, 0))
 
 
 def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
-                             interpret=None, return_lse=False):
+                             kv_mask=None, interpret=None, return_lse=False):
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
@@ -101,24 +185,32 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
+    has_mask = kv_mask is not None
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               causal_offset=tk - tq)
+                               causal_offset=tk - tq, tq=tq, tk=tk,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+    ]
+    operands = [q3, k3, v3]
+    if has_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, block_k), lambda bhi, qi, ki: (bhi // h, ki)))
+        operands.append(kv_mask.astype(jnp.int32))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -126,16 +218,33 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*operands)
     out = out.reshape(b, h, tq, d)
     if return_lse:
-        return out, lse.reshape(b, h, tq, 1)
+        return out, lse.reshape(b, h, tq)
     return out
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
-                      dq_scr, *, scale, causal, block_q, block_k,
-                      causal_offset=0):
+def _bwd_p(s, lse_row, valid):
+    """exp(s - lse) with masking. lse arrives as (1, BQ) — lane-major —
+    and is transposed to a column for the row-broadcast. Masked entries are
+    exact zeros; for fully-masked rows lse is the ~-1e30 sentinel and the
+    where() discards the overflowed exp."""
+    lse_col = jnp.transpose(lse_row, (1, 0))         # [BQ, 1]
+    p = jnp.exp(s - lse_col)
+    if valid is not None:
+        p = jnp.where(valid, p, 0.0)
+    return p
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
+                      scale, causal, block_q, block_k, causal_offset, tq, tk,
+                      has_mask):
+    if has_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        mask_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -145,29 +254,25 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)             # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)             # [BK, D]
-        v = v_ref[0].astype(jnp.float32)             # [BK, D]
-        do = do_ref[0].astype(jnp.float32)           # [BQ, D]
-        lse = lse_ref[0]                             # [BQ, 1]
-        delta = dlt_ref[0]                           # [BQ, 1]
+        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
+        do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
+        lse = _tail_zero_row(lse_ref[...], qi, block_q, tq)
+        dlt = _tail_zero_row(dlt_ref[...], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            # mask p (not s) so fully-masked rows — whose saved lse is the
-            # NEG_INF sentinel — can't overflow exp()
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + causal_offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)
-        else:
-            p = jnp.exp(s - lse)                     # [BQ, BK]
+        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                             tq=tq, tk=tk, causal=causal,
+                             causal_offset=causal_offset,
+                             mask_row=mask_ref[...] if has_mask else None)
+        p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BQ, BK]
-        ds = p * (dp - delta) * scale
+        delta_col = jnp.transpose(dlt, (1, 0))
+        ds = p * (dp - delta_col) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -184,9 +289,14 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
-                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                       block_q, block_k, causal_offset=0):
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
+                       scale, causal, block_q, block_k, causal_offset, tq, tk,
+                       has_mask):
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        mask_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -197,30 +307,28 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _step():
-        q = q_ref[0].astype(jnp.float32)             # [BQ, D]
-        k = k_ref[0].astype(jnp.float32)             # [BK, D]
-        v = v_ref[0].astype(jnp.float32)             # [BK, D]
-        do = do_ref[0].astype(jnp.float32)           # [BQ, D]
-        lse = lse_ref[0]                             # [BQ, 1]
-        delta = dlt_ref[0]                           # [BQ, 1]
+        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
+        do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
+        lse = _tail_zero_row(lse_ref[...], qi, block_q, tq)
+        dlt = _tail_zero_row(dlt_ref[...], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + causal_offset
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(q_pos >= k_pos, jnp.exp(s - lse), 0.0)
-        else:
-            p = jnp.exp(s - lse)                     # [BQ, BK]
+        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                             tq=tq, tk=tk, causal=causal,
+                             causal_offset=causal_offset,
+                             mask_row=mask_ref[...] if has_mask else None)
+        p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BK, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BQ, BK]
-        ds = p * (dp - delta) * scale
+        delta_col = jnp.transpose(dlt, (1, 0))
+        ds = p * (dp - delta_col) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [BK, D]
@@ -239,7 +347,7 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
 
 
 def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
-                             block_q, block_k, interpret=None):
+                             block_q, block_k, kv_mask=None, interpret=None):
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
@@ -248,30 +356,38 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
     bh = b * h
     # delta_i = rowsum(dO_i * O_i) — cheap elementwise, XLA fuses it
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)          # [B, H, Tq, 1]
+                    axis=-1)                         # [B, H, Tq]
     q3 = q.reshape(bh, tq, d)
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
     do3 = do.reshape(bh, tq, d)
-    lse3 = lse.reshape(bh, tq, 1)
-    dlt3 = delta.reshape(bh, tq, 1)
+    lse2 = lse.reshape(bh, tq)
+    dlt2 = delta.reshape(bh, tq)
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
     nq = pl.cdiv(tq, block_q)
     nk = pl.cdiv(tk, block_k)
     offset = tk - tq
+    has_mask = kv_mask is not None
+    mask_i32 = kv_mask.astype(jnp.int32) if has_mask else None
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, causal_offset=offset, tq=tq, tk=tk,
+                  has_mask=has_mask)
     q_specs = [
         pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
+        pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
     ]
+    q_ops = [q3, k3, v3, do3, lse2, dlt2]
+    if has_mask:
+        q_specs.append(pl.BlockSpec(
+            (1, block_k), lambda bhi, qi, ki: (bhi // h, ki)))
+        q_ops.append(mask_i32)
     dq = pl.pallas_call(
-        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_offset=offset),
+        functools.partial(_fa_bwd_dq_kernel, **common),
         grid=(bh, nq, nk),
         in_specs=q_specs,
         out_specs=pl.BlockSpec((1, block_q, d),
@@ -279,19 +395,22 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, dlt3)
+    )(*q_ops)
     kv_specs = [
         pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+        pl.BlockSpec((1, block_q), lambda bhi, ki, qi: (bhi, qi)),
+        pl.BlockSpec((1, block_q), lambda bhi, ki, qi: (bhi, qi)),
     ]
+    kv_ops = [q3, k3, v3, do3, lse2, dlt2]
+    if has_mask:
+        kv_specs.append(pl.BlockSpec(
+            (1, block_k), lambda bhi, ki, qi: (bhi // h, ki)))
+        kv_ops.append(mask_i32)
     dk, dv = pl.pallas_call(
-        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_offset=offset),
+        functools.partial(_fa_bwd_dkv_kernel, **common),
         grid=(bh, nk, nq),
         in_specs=kv_specs,
         out_specs=[
@@ -307,15 +426,18 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, dlt3)
+    )(*kv_ops)
     return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
             dv.reshape(b, h, tk, d))
 
 
-def chunked_attention(q, k, v, scale=None, causal=False, chunk_size=512):
+def chunked_attention(q, k, v, scale=None, causal=False, kv_mask=None,
+                      chunk_size=512):
     """Flash-style attention in pure XLA: lax.scan over KV chunks with online
     softmax. O(T) memory, differentiable, runs anywhere. Used as the CPU/
-    fallback path and as the recompute backward for the Pallas forward."""
+    fallback path and as the recompute backward for the Pallas forward.
+    Same semantics as the Pallas path: kv_mask [B, Tk] (True = attend);
+    fully-masked rows yield exactly zero output."""
     scale = scale if scale is not None else 1.0 / jnp.sqrt(q.shape[-1])
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -327,23 +449,32 @@ def chunked_attention(q, k, v, scale=None, causal=False, chunk_size=512):
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     kc = k.reshape(b, h, nchunks, chunk, d).transpose(2, 0, 1, 3, 4)
     vc = v.reshape(b, h, nchunks, chunk, d).transpose(2, 0, 1, 3, 4)
+    if kv_mask is not None:
+        mc = jnp.pad(kv_mask.astype(bool), ((0, 0), (0, pad)),
+                     constant_values=False)
+        mc = mc.reshape(b, nchunks, chunk).transpose(1, 0, 2)  # [N, B, C]
     qf = q.astype(jnp.float32)
     # bottom-right aligned causal (matches scaled_dot_product_attention)
     q_pos = jnp.arange(tq) + (tk - tq)
 
     def step(carry, inp):
         m, l, acc = carry
-        kb, vb, ci = inp
+        if kv_mask is not None:
+            kb, vb, ci, mb = inp
+        else:
+            kb, vb, ci = inp
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
         k_pos = ci * chunk + jnp.arange(chunk)
-        valid = k_pos < tk
+        valid = jnp.broadcast_to((k_pos < tk)[None, None, None, :], s.shape)
         if causal:
-            valid = valid[None, :] & (q_pos[:, None] >= k_pos[None, :])
-            s = jnp.where(valid[None, None], s, NEG_INF)
-        else:
-            s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])[None, None]
+        if kv_mask is not None:
+            valid = valid & mb[:, None, None, :]
+        s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, -1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # mask p, not just s: in a fully-masked row m_new stays NEG_INF and
+        # exp(s - m_new) = 1 — identical semantics to the Pallas kernel
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, -1, keepdims=True)
         acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
@@ -353,51 +484,73 @@ def chunked_attention(q, k, v, scale=None, causal=False, chunk_size=512):
     m0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(
-        jax.checkpoint(step), (m0, l0, acc0),
-        (kc, vc, jnp.arange(nchunks)))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    xs = (kc, vc, jnp.arange(nchunks))
+    if kv_mask is not None:
+        xs = xs + (mc,)
+    (m, l, acc), _ = jax.lax.scan(jax.checkpoint(step), (m0, l0, acc0), xs)
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    return out.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, scale, causal, block_q, block_k):
-    return _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_core(q, k, v, mask, scale, causal, block_q, block_k, has_mask):
+    return _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
+                                    kv_mask=mask if has_mask else None)
 
 
-def _flash_core_fwd(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q,
-                                        block_k, return_lse=True)
-    return out, (q, k, v, out, lse)
+def _flash_core_fwd(q, k, v, mask, scale, causal, block_q, block_k, has_mask):
+    out, lse = _flash_attention_fwd_tpu(
+        q, k, v, scale, causal, block_q, block_k,
+        kv_mask=mask if has_mask else None, return_lse=True)
+    return out, (q, k, v, mask, out, lse)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
+def _flash_core_bwd(scale, causal, block_q, block_k, has_mask, res, g):
+    q, k, v, mask, out, lse = res
+    kv_mask = mask if has_mask else None
     from paddle_tpu.core.flags import get_flag
     if get_flag("flash_pallas_bwd"):
-        return _flash_attention_bwd_tpu(q, k, v, out, lse, g, scale, causal,
-                                        block_q, block_k)
-    _, vjp = jax.vjp(lambda q_, k_, v_: chunked_attention(
-        q_, k_, v_, scale=scale, causal=causal, chunk_size=block_k), q, k, v)
-    return vjp(g)
+        dq, dk, dv = _flash_attention_bwd_tpu(
+            q, k, v, out, lse, g, scale, causal, block_q, block_k,
+            kv_mask=kv_mask)
+    else:
+        _, vjp = jax.vjp(lambda q_, k_, v_: chunked_attention(
+            q_, k_, v_, scale=scale, causal=causal, kv_mask=kv_mask,
+            chunk_size=block_k), q, k, v)
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(mask)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
-                    block_k=512):
-    """Memory-efficient attention. q,k,v: [B, H, T, D].
+def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
+                    block_q=512, block_k=512):
+    """Memory-efficient attention. q,k,v: [B, H, T, D]; kv_mask: [B, Tk]
+    bool/0-1, True = attend (the key-padding mask of a padded batch).
 
-    On TPU: Pallas online-softmax forward + recompute backward. Head dims
-    that are multiples of 64 are supported (Mosaic pads the 64-lane case;
+    On TPU: Pallas online-softmax forward + Pallas dq/dkv backward
+    (flash-attention-2 recomputation from the saved logsumexp; set the
+    `flash_pallas_bwd=False` flag to fall back to a jax.checkpoint
+    recompute over the chunked XLA formulation). Head dims that are
+    multiples of 64 are supported (Mosaic pads the 64-lane case;
     BERT-base's D=64 still wins because the [BQ,BK] matmuls dominate).
-    Elsewhere: chunked XLA formulation (same math).
+    Elsewhere: chunked XLA formulation (same math, same semantics).
     """
     from paddle_tpu.core.flags import get_flag
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    if (on_tpu() or get_flag("pallas_interpret")) and pltpu is not None \
-            and q.shape[-1] % 64 == 0 \
-            and q.shape[2] % 8 == 0 and k.shape[2] % 8 == 0:
-        return _flash_core(q, k, v, scale, causal, block_q, block_k)
+    if (on_tpu() or get_flag("pallas_interpret")) and pltpu is not None:
+        if q.shape[-1] % 64 == 0 and q.shape[2] % 8 == 0 \
+                and k.shape[2] % 8 == 0:
+            if kv_mask is None:
+                # dummy float operand keeps the custom_vjp signature static;
+                # has_mask=False drops it before the pallas_call
+                mask = jnp.zeros((1, 1), jnp.float32)
+                return _flash_core(q, k, v, mask, scale, causal, block_q,
+                                   block_k, False)
+            return _flash_core(q, k, v, kv_mask.astype(jnp.float32), scale,
+                               causal, block_q, block_k, True)
+        _log_fallback(f"D={q.shape[-1]} not a multiple of 64 or "
+                      f"T={q.shape[2]}/{k.shape[2]} not a multiple of 8")
     return chunked_attention(q, k, v, scale=scale, causal=causal,
-                             chunk_size=block_k)
+                             kv_mask=kv_mask, chunk_size=block_k)

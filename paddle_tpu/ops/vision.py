@@ -13,6 +13,7 @@ conv_general_dilated so XLA can tile onto the MXU; no per-pixel scalar loops.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from paddle_tpu.core.registry import register_op
@@ -334,6 +335,151 @@ def psroi_pool(x, rois, roi_batch_ids, output_channels, pooled_height,
             s = jnp.sum(vals * m[:, None], axis=(2, 3))        # [R,oc]
             out = out.at[:, :, i, j].set(s / cnt[:, None])
     return out
+
+
+@register_op("prroi_pool")
+def prroi_pool(x, rois, roi_batch_ids, pooled_height=1, pooled_width=1,
+               spatial_scale=1.0):
+    """Precise RoI pooling (PrRoIPool, arXiv:1807.11590) — each output bin
+    is the exact integral of the bilinearly-interpolated feature map over
+    the (continuous) bin window divided by the bin area; no sampling-grid
+    or coordinate quantization anywhere. ref: operators/prroi_pool_op.{cc,h}.
+
+    TPU design: the reference walks every pixel segment per bin with
+    PrRoIPoolingMatCalculation; here the separable closed-form integral of
+    the hat (bilinear) basis turns each bin into coefficient vectors over H
+    and W and the whole op into one einsum — static shapes, MXU-friendly,
+    and exact (it is an integral, not a sample sum).
+
+    x: [B,C,H,W]; rois: [R,4] (x1,y1,x2,y2 image coords);
+    roi_batch_ids: [R] int -> [R,C,ph,pw].
+    """
+    B, C, H, W = x.shape
+    ph, pw = pooled_height, pooled_width
+
+    def hat_integral(a, b, n):
+        """∫_a^b max(0, 1-|t-j|) dt for every integer pixel j in [0,n).
+        a,b: [P,1] window bounds per bin -> [P,n]. Pixels outside [0,n)
+        contribute zero (the reference's PrRoIPoolingGetData OOB = 0)."""
+        j = jnp.arange(n, dtype=x.dtype)[None, :]
+        lo = jnp.clip(a, j - 1.0, j)
+        hi = jnp.clip(b, j - 1.0, j)
+        left = ((hi - (j - 1.0)) ** 2 - (lo - (j - 1.0)) ** 2) * 0.5
+        lo2 = jnp.clip(a, j, j + 1.0)
+        hi2 = jnp.clip(b, j, j + 1.0)
+        right = ((j + 1.0 - lo2) ** 2 - (j + 1.0 - hi2) ** 2) * 0.5
+        return left + right
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = roi * spatial_scale
+        roi_w = jnp.maximum(x2 - x1, 0.0)
+        roi_h = jnp.maximum(y2 - y1, 0.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        win_size = jnp.maximum(bin_w * bin_h, 0.0)
+        pi = jnp.arange(ph, dtype=x.dtype)[:, None]
+        pj = jnp.arange(pw, dtype=x.dtype)[:, None]
+        cy = hat_integral(y1 + pi * bin_h, y1 + (pi + 1.0) * bin_h, H)
+        cx = hat_integral(x1 + pj * bin_w, x1 + (pj + 1.0) * bin_w, W)
+        out = jnp.einsum("chw,ph,qw->cpq", x[bidx], cy, cx)
+        return jnp.where(win_size > 0.0,
+                         out / jnp.maximum(win_size, 1e-30), 0.0)
+
+    return jax.vmap(one_roi)(rois.astype(x.dtype), roi_batch_ids)
+
+
+@register_op("deformable_psroi_pool")
+def deformable_psroi_pool(x, rois, roi_batch_ids, trans=None, output_dim=1,
+                          group_size=(1, 1), pooled_height=1, pooled_width=1,
+                          part_size=(1, 1), sample_per_part=1,
+                          spatial_scale=1.0, trans_std=0.1, no_trans=False):
+    """Deformable position-sensitive RoI pooling (Deformable ConvNets):
+    each bin samples a SxS grid from its dedicated channel group, shifted
+    by learned normalized offsets. ref:
+    operators/deformable_psroi_pooling_op.{cc,h,cu}.
+
+    x: [B, output_dim*gh*gw, H, W]; rois: [R,4]; roi_batch_ids: [R] int;
+    trans: [R, 2*num_classes, part_h, part_w] (channel = class*2 + {x:0,y:1})
+    -> (out [R, output_dim, ph, pw], top_count [R, output_dim, ph, pw]).
+
+    TPU design: the per-sample scalar loop becomes a static [ph,pw,S,S]
+    sample grid gathered in one vectorized bilinear pass per roi (vmap),
+    with the bin->channel-group mapping as an advanced-indexing gather.
+    """
+    B, C, H, W = x.shape
+    gh, gw = group_size
+    part_h, part_w = part_size
+    ph, pw = pooled_height, pooled_width
+    S = sample_per_part
+    no_trans = no_trans or trans is None
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    channels_each = output_dim // num_classes
+    dt = x.dtype
+
+    # static bin -> group / part mappings
+    ghi = np.clip(np.floor(np.arange(ph) * gh / ph), 0, gh - 1).astype(int)
+    gwi = np.clip(np.floor(np.arange(pw) * gw / pw), 0, gw - 1).astype(int)
+    phi = np.floor(np.arange(ph) / ph * part_h).astype(int)     # part row
+    pwi = np.floor(np.arange(pw) / pw * part_w).astype(int)     # part col
+    # channel of (ctop, bin): (ctop*gh + ghi)*gw + gwi  -> [O, ph, pw]
+    cidx = ((np.arange(output_dim)[:, None, None] * gh + ghi[None, :, None])
+            * gw + gwi[None, None, :])
+    cidx = jnp.asarray(cidx)
+    class_id = np.arange(output_dim) // channels_each           # [O]
+
+    def one_roi(roi, bidx, tr):
+        x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        roi_w = jnp.maximum(x2 - x1, 0.1)
+        roi_h = jnp.maximum(y2 - y1, 0.1)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        sub_w = bin_w / S
+        sub_h = bin_h / S
+        if no_trans:
+            tx = jnp.zeros((output_dim, ph, pw), dt)
+            ty = jnp.zeros((output_dim, ph, pw), dt)
+        else:
+            # tr: [2*num_classes, part_h, part_w]
+            tx = tr[2 * class_id][:, phi][:, :, pwi] * trans_std
+            ty = tr[2 * class_id + 1][:, phi][:, :, pwi] * trans_std
+        # sample positions [O, ph, pw, S, S]
+        wstart = (jnp.arange(pw, dtype=dt)[None, None, :] * bin_w + x1
+                  + tx * roi_w)[..., None, None]
+        hstart = (jnp.arange(ph, dtype=dt)[None, :, None] * bin_h + y1
+                  + ty * roi_h)[..., None, None]
+        wpos = wstart + jnp.arange(S, dtype=dt)[None, None, None, None, :] \
+            * sub_w
+        hpos = hstart + jnp.arange(S, dtype=dt)[None, None, None, :, None] \
+            * sub_h
+        ok = ((wpos >= -0.5) & (wpos <= W - 0.5)
+              & (hpos >= -0.5) & (hpos <= H - 0.5))
+        wc = jnp.clip(wpos, 0.0, W - 1.0)
+        hc = jnp.clip(hpos, 0.0, H - 1.0)
+        h0 = jnp.floor(hc).astype(jnp.int32)
+        w0 = jnp.floor(wc).astype(jnp.int32)
+        h1 = jnp.minimum(h0 + 1, H - 1)
+        w1 = jnp.minimum(w0 + 1, W - 1)
+        lh = hc - h0
+        lw = wc - w0
+        img = x[bidx]                                           # [C,H,W]
+        ch = jnp.broadcast_to(cidx[..., None, None], h0.shape)
+        v00 = img[ch, h0, w0]
+        v01 = img[ch, h0, w1]
+        v10 = img[ch, h1, w0]
+        v11 = img[ch, h1, w1]
+        val = (v00 * (1 - lh) * (1 - lw) + v01 * (1 - lh) * lw
+               + v10 * lh * (1 - lw) + v11 * lh * lw)
+        val = jnp.where(ok, val, 0.0)
+        cnt = jnp.sum(ok.astype(dt), axis=(-1, -2))             # [O,ph,pw]
+        s = jnp.sum(val, axis=(-1, -2))
+        return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0), cnt
+
+    tr_in = (jnp.zeros((rois.shape[0], 2, part_h, part_w), dt)
+             if no_trans else trans.astype(dt))
+    return jax.vmap(one_roi)(rois.astype(dt), roi_batch_ids, tr_in)
 
 
 @register_op("collect_fpn_proposals")

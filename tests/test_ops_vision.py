@@ -6,6 +6,7 @@ test_unpool_op.py, test_psroi_pool_op.py patterns)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu.ops import vision
 
@@ -224,3 +225,191 @@ class TestDataNorm:
         out, means, scales = vision.data_norm(jnp.asarray(x), bsize, bsum, bsq)
         np.testing.assert_allclose(np.asarray(out).mean(0), 0.0, atol=1e-4)
         np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=2e-2)
+
+
+def _np_prroi_pool(x, rois, batch_ids, ph, pw, scale):
+    """Loop reference for PrRoIPool: numeric integration of the bilinear
+    interpolant at very fine resolution (the closed form being what the op
+    computes analytically). ref: operators/prroi_pool_op.h."""
+    R = rois.shape[0]
+    B, C, H, W = x.shape
+    out = np.zeros((R, C, ph, pw), np.float64)
+
+    def interp(img, y, xq):
+        # bilinear with zero outside [0,H)x[0,W)
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        v = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                wgt = (1 - abs(y - yy)) * (1 - abs(xq - xx))
+                if 0 <= yy < H and 0 <= xx < W and wgt > 0:
+                    v += wgt * img[yy, xx]
+        return v
+
+    K = 20  # integration samples per bin axis (midpoint rule)
+    for r in range(R):
+        x1, y1, x2, y2 = rois[r] * scale
+        rw = max(x2 - x1, 0.0)
+        rh = max(y2 - y1, 0.0)
+        bw, bh = rw / pw, rh / ph
+        win = bw * bh
+        for c in range(C):
+            img = x[batch_ids[r], c]
+            for i in range(ph):
+                for j in range(pw):
+                    if win <= 0:
+                        continue
+                    acc = 0.0
+                    for a in range(K):
+                        for b in range(K):
+                            yy = y1 + i * bh + (a + 0.5) * bh / K
+                            xx = x1 + j * bw + (b + 0.5) * bw / K
+                            acc += interp(img, yy, xx)
+                    out[r, c, i, j] = acc * (bw * bh / (K * K)) / win
+    return out
+
+
+class TestPrRoIPool:
+    def test_matches_numeric_integral(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[0.0, 0.0, 6.0, 6.0],
+                         [1.0, 2.0, 5.0, 7.0],
+                         [2.5, 1.5, 6.5, 4.0]], np.float32)
+        bids = np.array([0, 1, 1], np.int32)
+        got = vision.prroi_pool(jnp.asarray(x), jnp.asarray(rois),
+                                jnp.asarray(bids), 2, 2, 1.0)
+        ref = _np_prroi_pool(x, rois, bids, 2, 2, 1.0)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-3, rtol=2e-3)
+
+    def test_degenerate_roi_zero(self):
+        x = jnp.ones((1, 1, 4, 4))
+        rois = jnp.asarray([[2.0, 2.0, 2.0, 2.0]])
+        out = vision.prroi_pool(x, rois, jnp.asarray([0]), 2, 2, 1.0)
+        assert np.all(np.asarray(out) == 0.0)
+
+    def test_differentiable(self):
+        # the whole point of PrRoIPool: gradients flow to roi COORDS too
+        x = jnp.asarray(np.random.RandomState(1).randn(1, 2, 6, 6)
+                        .astype(np.float32))
+        rois = jnp.asarray([[1.0, 1.0, 4.0, 4.0]])
+
+        def f(rois):
+            return jnp.sum(vision.prroi_pool(x, rois, jnp.asarray([0]),
+                                             2, 2, 1.0))
+
+        g = jax.grad(f)(rois)
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert np.any(np.asarray(g) != 0.0)
+
+
+def _np_deformable_psroi(x, rois, bids, trans, odim, gsz, ph, pw, psz, S,
+                         scale, tstd, no_trans):
+    B, C, H, W = x.shape
+    R = rois.shape[0]
+    gh, gw = gsz
+    part_h, part_w = psz
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    ceach = odim // ncls
+    out = np.zeros((R, odim, ph, pw), np.float64)
+    cnt_out = np.zeros((R, odim, ph, pw), np.float64)
+
+    def interp(img, y, xq):
+        y0, x0 = int(np.floor(y)), int(np.floor(xq))
+        v = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = y0 + dy, x0 + dx
+                wgt = (1 - abs(y - yy)) * (1 - abs(xq - xx))
+                if 0 <= yy < H and 0 <= xx < W and wgt > 0:
+                    v += wgt * img[yy, xx]
+        return v
+
+    for r in range(R):
+        x1 = round(rois[r, 0]) * scale - 0.5
+        y1 = round(rois[r, 1]) * scale - 0.5
+        x2 = (round(rois[r, 2]) + 1.0) * scale - 0.5
+        y2 = (round(rois[r, 3]) + 1.0) * scale - 0.5
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+        sw, sh = bw / S, bh / S
+        for o in range(odim):
+            cls = o // ceach
+            for i in range(ph):
+                for j in range(pw):
+                    pi = int(np.floor(i / ph * part_h))
+                    pj = int(np.floor(j / pw * part_w))
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[r, cls * 2, pi, pj] * tstd
+                        ty = trans[r, cls * 2 + 1, pi, pj] * tstd
+                    ws = j * bw + x1 + tx * rw
+                    hs = i * bh + y1 + ty * rh
+                    gi = min(max(int(np.floor(i * gh / ph)), 0), gh - 1)
+                    gj = min(max(int(np.floor(j * gw / pw)), 0), gw - 1)
+                    c = (o * gh + gi) * gw + gj
+                    img = x[bids[r], c]
+                    acc, n = 0.0, 0
+                    for a in range(S):
+                        for b in range(S):
+                            ww = ws + b * sw
+                            hh = hs + a * sh
+                            if ww < -0.5 or ww > W - 0.5 or hh < -0.5 \
+                                    or hh > H - 0.5:
+                                continue
+                            ww2 = min(max(ww, 0.0), W - 1.0)
+                            hh2 = min(max(hh, 0.0), H - 1.0)
+                            acc += interp(img, hh2, ww2)
+                            n += 1
+                    out[r, o, i, j] = 0.0 if n == 0 else acc / n
+                    cnt_out[r, o, i, j] = n
+    return out, cnt_out
+
+
+class TestDeformablePSRoIPool:
+    def _data(self, no_trans):
+        rng = np.random.RandomState(2)
+        odim, gh, gw = 2, 2, 2
+        x = rng.randn(2, odim * gh * gw, 8, 8).astype(np.float32)
+        rois = np.array([[0.0, 0.0, 6.0, 6.0], [1.0, 1.0, 7.0, 5.0]],
+                        np.float32)
+        bids = np.array([0, 1], np.int32)
+        trans = None if no_trans else \
+            (rng.randn(2, 2, 2, 2).astype(np.float32) * 0.5)
+        return x, rois, bids, trans, odim, (gh, gw)
+
+    @pytest.mark.parametrize("no_trans", [True, False])
+    def test_matches_loop_reference(self, no_trans):
+        x, rois, bids, trans, odim, gsz = self._data(no_trans)
+        got, cnt = vision.deformable_psroi_pool(
+            jnp.asarray(x), jnp.asarray(rois), jnp.asarray(bids),
+            None if trans is None else jnp.asarray(trans),
+            output_dim=odim, group_size=gsz, pooled_height=2,
+            pooled_width=2, part_size=(2, 2), sample_per_part=2,
+            spatial_scale=1.0, trans_std=0.1, no_trans=no_trans)
+        ref, rcnt = _np_deformable_psroi(
+            x, rois, bids, trans, odim, gsz, 2, 2, (2, 2), 2, 1.0, 0.1,
+            no_trans)
+        np.testing.assert_allclose(np.asarray(cnt), rcnt)
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-4,
+                                   rtol=1e-4)
+
+    def test_grads_flow_to_input_and_trans(self):
+        x, rois, bids, trans, odim, gsz = self._data(False)
+
+        def f(x_, t_):
+            out, _ = vision.deformable_psroi_pool(
+                x_, jnp.asarray(rois), jnp.asarray(bids), t_,
+                output_dim=odim, group_size=gsz, pooled_height=2,
+                pooled_width=2, part_size=(2, 2), sample_per_part=2)
+            return jnp.sum(out ** 2)
+
+        gx, gt = jax.grad(f, argnums=(0, 1))(jnp.asarray(x),
+                                             jnp.asarray(trans))
+        assert np.all(np.isfinite(np.asarray(gx)))
+        assert np.any(np.asarray(gx) != 0.0)
+        assert np.all(np.isfinite(np.asarray(gt)))
+        assert np.any(np.asarray(gt) != 0.0)

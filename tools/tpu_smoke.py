@@ -1,0 +1,233 @@
+"""TPU silicon smoke test for the Pallas kernel surface (VERDICT r2 #2).
+
+Runs on the real chip (axon tunnel):
+  1. fused layer-norm fwd+bwd parity vs the XLA twin
+  2. flash attention fwd parity vs chunked XLA (causal / non-causal /
+     kv-masked / tail shapes)
+  3. flash attention bwd (Pallas dq/dkv) parity vs chunked autodiff
+  4. conv custom-VJP parity vs XLA's native conv gradients
+  5. micro-timings (flash vs chunked at BERT-base shapes)
+
+Emits one PASS/FAIL line per check plus a JSON summary; exit code 0 only
+if every numeric check passes. Results are recorded in BASELINE.md.
+
+Usage:  timeout 560 python tools/tpu_smoke.py [--quick]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _maxdiff(a, b):
+    return float(np.max(np.abs(np.asarray(a, np.float32)
+                               - np.asarray(b, np.float32))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="skip the timing section")
+    ap.add_argument("--interpret", action="store_true",
+                    help="CPU harness self-check via the Pallas interpreter")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform}) "
+          f"[init {time.time() - t0:.1f}s]", flush=True)
+
+    from paddle_tpu.ops.pallas import on_tpu
+    if args.interpret:
+        from paddle_tpu.core.flags import set_flags as _sf
+        _sf({"pallas_interpret": True})
+    elif not on_tpu():
+        print("NOT A TPU — smoke test requires the real chip", flush=True)
+        sys.exit(2)
+    interp = bool(args.interpret)
+
+    results = {}
+    failed = []
+
+    def check(name, diff, tol):
+        ok = diff < tol
+        results[name] = {"maxdiff": diff, "tol": tol, "ok": ok}
+        print(f"{'PASS' if ok else 'FAIL'} {name}: maxdiff={diff:.3e} "
+              f"(tol {tol:.0e})", flush=True)
+        if not ok:
+            failed.append(name)
+
+    # ---- 1. fused layer norm ------------------------------------------
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm_fused
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(256, 512).astype(np.float32))
+    g = jnp.asarray((rng.rand(512) + 0.5).astype(np.float32))
+    b = jnp.asarray(rng.randn(512).astype(np.float32))
+
+    def ln_ref(x, g, b):
+        m = jnp.mean(x, -1, keepdims=True)
+        v = jnp.var(x, -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(v + 1e-5) * g + b
+
+    out = jax.jit(layer_norm_fused)(x, g, b)
+    ref = jax.jit(ln_ref)(x, g, b)
+    check("ln_fwd", _maxdiff(out, ref), 1e-4)
+
+    co = jnp.asarray(rng.randn(256, 512).astype(np.float32))
+    gx, gg, gb = jax.jit(jax.grad(
+        lambda *a: jnp.sum(layer_norm_fused(*a) * co), argnums=(0, 1, 2)))(
+            x, g, b)
+    rx, rg, rb = jax.jit(jax.grad(
+        lambda *a: jnp.sum(ln_ref(*a) * co), argnums=(0, 1, 2)))(x, g, b)
+    check("ln_bwd_dx", _maxdiff(gx, rx), 5e-3)
+    check("ln_bwd_dgamma", _maxdiff(gg, rg), 5e-3)
+    check("ln_bwd_dbeta", _maxdiff(gb, rb), 5e-3)
+
+    # ---- 2/3. flash attention ------------------------------------------
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _flash_attention_bwd_tpu, _flash_attention_fwd_tpu,
+        chunked_attention, flash_attention)
+
+    def qkvg(b_, h_, tq, d_, tk=None, seed=0):
+        tk = tk or tq
+        ks = jax.random.split(jax.random.key(seed), 4)
+        return (jax.random.normal(ks[0], (b_, h_, tq, d_), jnp.float32),
+                jax.random.normal(ks[1], (b_, h_, tk, d_), jnp.float32),
+                jax.random.normal(ks[2], (b_, h_, tk, d_), jnp.float32),
+                jax.random.normal(ks[3], (b_, h_, tq, d_), jnp.float32))
+
+    cases = [
+        ("fa_plain", dict(b=2, h=4, t=512, d=64, causal=False, mask=False)),
+        ("fa_causal", dict(b=2, h=4, t=512, d=64, causal=True, mask=False)),
+        ("fa_masked", dict(b=2, h=4, t=512, d=64, causal=False, mask=True)),
+        ("fa_tail", dict(b=1, h=2, t=520, d=64, causal=False, mask=False)),
+        ("fa_d128", dict(b=1, h=2, t=256, d=128, causal=True, mask=False)),
+    ]
+    for name, cfg in cases:
+        q, k, v, go = qkvg(cfg["b"], cfg["h"], cfg["t"], cfg["d"])
+        scale = 1.0 / cfg["d"] ** 0.5
+        kv_mask = None
+        if cfg["mask"]:
+            lens = [cfg["t"] * 3 // 4] + [cfg["t"]] * (cfg["b"] - 1)
+            m = np.zeros((cfg["b"], cfg["t"]), bool)
+            for i, n in enumerate(lens):
+                m[i, :n] = True
+            kv_mask = jnp.asarray(m)
+        bq = bk = 256
+        try:
+            out, lse = _flash_attention_fwd_tpu(
+                q, k, v, scale, cfg["causal"], bq, bk, kv_mask=kv_mask,
+                interpret=interp, return_lse=True)
+            out.block_until_ready()
+        except Exception as e:  # Mosaic compile failure is a result too
+            results[name] = {"error": str(e)[:300], "ok": False}
+            print(f"FAIL {name}: {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+            failed.append(name)
+            continue
+        ref = chunked_attention(q, k, v, scale=scale, causal=cfg["causal"],
+                                kv_mask=kv_mask, chunk_size=bk)
+        check(name + "_fwd", _maxdiff(out, ref), 2e-3)
+
+        try:
+            dq, dk, dv = _flash_attention_bwd_tpu(
+                q, k, v, out, lse, go, scale, cfg["causal"], bq, bk,
+                kv_mask=kv_mask, interpret=interp)
+            dq.block_until_ready()
+        except Exception as e:
+            results[name + "_bwd"] = {"error": str(e)[:300], "ok": False}
+            print(f"FAIL {name}_bwd: {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+            failed.append(name + "_bwd")
+            continue
+        _, vjp = jax.vjp(lambda a, b_, c: chunked_attention(
+            a, b_, c, scale=scale, causal=cfg["causal"], kv_mask=kv_mask,
+            chunk_size=bk), q, k, v)
+        rdq, rdk, rdv = vjp(go)
+        check(name + "_dq", _maxdiff(dq, rdq), 5e-3)
+        check(name + "_dk", _maxdiff(dk, rdk), 5e-3)
+        check(name + "_dv", _maxdiff(dv, rdv), 5e-3)
+
+    # ---- 4. conv custom VJP -------------------------------------------
+    from paddle_tpu.core.flags import get_flag, set_flags
+    from paddle_tpu.ops import nn as F
+    xc = jnp.asarray(rng.randn(8, 56, 56, 64).astype(np.float32))
+    wc = jnp.asarray(rng.randn(3, 3, 64, 64).astype(np.float32) * 0.05)
+    gc = jnp.asarray(rng.randn(8, 56, 56, 64).astype(np.float32))
+
+    def conv_loss(x_, w_):
+        return jnp.sum(F.conv2d(x_, w_, stride=1, padding=1,
+                                data_format="NHWC") * gc)
+
+    old = get_flag("conv_custom_vjp")
+    set_flags({"conv_custom_vjp": True})
+    try:
+        gxc, gwc = jax.jit(jax.grad(conv_loss, argnums=(0, 1)))(xc, wc)
+        gxc.block_until_ready()
+    finally:
+        set_flags({"conv_custom_vjp": old})
+    set_flags({"conv_custom_vjp": False})
+    try:
+        rxc, rwc = jax.jit(jax.grad(conv_loss, argnums=(0, 1)))(xc, wc)
+    finally:
+        set_flags({"conv_custom_vjp": old})
+    check("conv_vjp_dx", _maxdiff(gxc, rxc), 5e-2)
+    check("conv_vjp_dw", _maxdiff(gwc, rwc), 5e-2)
+
+    # ---- 5. micro-timings ---------------------------------------------
+    if not args.quick:
+        def timeit(f, *a, n=20):
+            r = f(*a)
+            jax.tree_util.tree_map(
+                lambda t: t.block_until_ready()
+                if hasattr(t, "block_until_ready") else t, r)
+            # two-run difference cancels the tunnel dispatch latency
+            t1 = time.perf_counter()
+            for _ in range(n):
+                r = f(*a)
+            _sync(r)
+            mid = time.perf_counter()
+            for _ in range(2 * n):
+                r = f(*a)
+            _sync(r)
+            end = time.perf_counter()
+            return max(end - mid - (mid - t1), 1e-9) / n
+
+        def _sync(r):
+            leaf = jax.tree_util.tree_leaves(r)[0]
+            _ = float(jnp.sum(leaf))
+
+        q, k, v, go = qkvg(8, 12, 512, 64, seed=1)
+        scale = 1.0 / 8.0
+        fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=False))
+        ch = jax.jit(lambda q, k, v: chunked_attention(q, k, v, scale=scale))
+
+        def fl_bwd(q, k, v):
+            return jax.grad(lambda a: jnp.sum(
+                flash_attention(a, k, v, causal=False)))(q)
+
+        t_fl = timeit(fl, q, k, v)
+        t_ch = timeit(ch, q, k, v)
+        t_flb = timeit(jax.jit(fl_bwd), q, k, v)
+        results["timing_ms"] = {
+            "flash_fwd": round(t_fl * 1e3, 3),
+            "chunked_fwd": round(t_ch * 1e3, 3),
+            "flash_fwd_bwd": round(t_flb * 1e3, 3),
+        }
+        print(f"timing b8 h12 t512 d64: flash {t_fl*1e3:.3f} ms, "
+              f"chunked {t_ch*1e3:.3f} ms, flash f+b {t_flb*1e3:.3f} ms",
+              flush=True)
+
+    print(json.dumps({"ok": not failed, "failed": failed,
+                      "n_checks": len(results)}))
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

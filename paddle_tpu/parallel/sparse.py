@@ -61,6 +61,34 @@ def segment_rowsum(row_cotangents, inv, k):
         inv.reshape(-1)].add(flat)
 
 
+def _probe_slots(opt, dim, dtype):
+    """Probe the optimizer's slot initial values on one row (e.g. Adagrad's
+    epsilon accumulator) so host tables can broadcast them."""
+    probe = opt.slots(jnp.zeros((1, dim), jnp.float32))
+    names = sorted(probe)
+    init = {n: np.asarray(probe[n], dtype)[0] for n in names}
+    return names, init
+
+
+def _host_row_update(opt, step, rows_np, slots_np, grad):
+    """One optimizer step over gathered host rows; returns (new_p, new_s)
+    as numpy. Shared by every host-RAM tier (single source for the sparse
+    row-update semantics)."""
+    lr = float(opt.lr(jnp.asarray(step)))
+    new_p, new_s = opt._update_leaf(
+        jnp.asarray(np.asarray(grad)), jnp.asarray(rows_np),
+        {n: jnp.asarray(v) for n, v in slots_np.items()}, lr,
+        jnp.asarray(step))
+    return np.asarray(new_p), {n: np.asarray(v) for n, v in new_s.items()}
+
+
+def _embed_from_rows(rows, uniq, ids, dim):
+    """Map pulled unique rows back to per-position embeddings (host inv)."""
+    inv = np.searchsorted(uniq, np.asarray(ids).reshape(-1))
+    return jnp.take(rows, jnp.asarray(inv), axis=0).reshape(
+        tuple(np.asarray(ids).shape) + (dim,))
+
+
 class SparseTable:
     """HBM-resident embedding table with sparse-row training.
 
@@ -142,11 +170,8 @@ class HostTable:
         rng = np.random.RandomState(seed)
         self.table = (init_scale *
                       rng.standard_normal((vocab_size, dim))).astype(dtype)
-        # honor the optimizer's slot initial values (e.g. Adagrad epsilon
-        # accumulator) by probing one row and broadcasting it
-        probe = self.opt.slots(jnp.zeros((1, dim), jnp.float32))
-        self._slot_names = sorted(probe)
-        self.slots = {n: np.broadcast_to(np.asarray(probe[n], dtype),
+        self._slot_names, slot_init = _probe_slots(self.opt, dim, dtype)
+        self.slots = {n: np.broadcast_to(slot_init[n],
                                          (vocab_size, dim)).copy()
                       for n in self._slot_names}
         self.step = 0
@@ -184,26 +209,188 @@ class HostTable:
 
     def embed_ids(self, rows, uniq, ids):
         """Map pulled rows back to per-position embeddings (host inv map)."""
-        inv = np.searchsorted(uniq, np.asarray(ids).reshape(-1))
-        return jnp.take(rows, jnp.asarray(inv), axis=0).reshape(
-            tuple(np.asarray(ids).shape) + (self.dim,))
+        return _embed_from_rows(rows, uniq, ids, self.dim)
 
     def push(self, uniq, row_grad):
         """Row-wise optimizer update applied in host memory."""
-        g = np.asarray(row_grad)
         p = self.table[uniq]
-        s = {n: self.slots[n][uniq] for n in self._slot_names}
-        lr = float(self.opt.lr(jnp.asarray(self.step)))
-        new_p, new_s = self.opt._update_leaf(
-            jnp.asarray(g), jnp.asarray(p),
-            {n: jnp.asarray(v) for n, v in s.items()}, lr,
-            jnp.asarray(self.step))
+        slo = {n: self.slots[n][uniq] for n in self._slot_names}
+        new_p, new_s = _host_row_update(self.opt, self.step, p, slo, row_grad)
         with self._lock:
-            self.table[uniq] = np.asarray(new_p, dtype=self.table.dtype)
+            self.table[uniq] = new_p.astype(self.table.dtype)
             for n in self._slot_names:
-                self.slots[n][uniq] = np.asarray(new_s[n],
-                                                 dtype=self.slots[n].dtype)
+                self.slots[n][uniq] = new_s[n].astype(self.slots[n].dtype)
         self.step += 1
 
     def nbytes(self):
         return self.table.nbytes + sum(v.nbytes for v in self.slots.values())
+
+
+class FeatureTable:
+    """PSLib-style *keyed* host table: arbitrary int64 feature signs (no
+    bounded vocab), bounded resident capacity, and cold-row eviction.
+
+    Ref: fleet_wrapper.h:76 pull flow + PSLib's DownpourSparseTable, whose
+    entries are created on first touch and evicted by recency/frequency
+    when the shard fills. Here: a host-RAM arena [capacity, D] plus an
+    id->slot dict; eviction reinitializes the row on its next touch (the
+    PSLib cold-feature semantics).
+
+    evict: "lru" (least-recently-touched) or "lfu" (least-frequently).
+    """
+
+    def __init__(self, dim, capacity, optimizer=None, init_scale=0.01,
+                 evict="lru", seed=0, dtype=np.float32):
+        from paddle_tpu.optimizer.optimizers import SGD
+        assert evict in ("lru", "lfu"), evict
+        self.dim, self.capacity, self.evict = dim, int(capacity), evict
+        self.opt = optimizer if optimizer is not None else SGD(0.01)
+        self.init_scale = init_scale
+        self._rng = np.random.RandomState(seed)
+        self.arena = np.zeros((self.capacity, dim), dtype)
+        self._slot_names, self._slot_init = _probe_slots(self.opt, dim, dtype)
+        self.slots = {n: np.zeros((self.capacity, dim), dtype)
+                      for n in self._slot_names}
+        self._index = {}          # feature sign -> arena slot
+        self._rindex = {}         # arena slot -> feature sign
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._clock = 0
+        self._score = np.zeros((self.capacity,), np.int64)  # recency or freq
+        self.step = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    def _touch(self, slot):
+        self._clock += 1
+        if self.evict == "lru":
+            self._score[slot] = self._clock
+        else:
+            self._score[slot] += 1
+
+    def _alloc(self, sign):
+        if self._free:
+            slot = self._free.pop()
+        else:
+            # evict the coldest resident row
+            slot = int(np.argmin(self._score))
+            old = self._rindex.pop(slot)
+            del self._index[old]
+            self.evictions += 1
+        self._index[sign] = slot
+        self._rindex[slot] = sign
+        self.arena[slot] = (self.init_scale *
+                            self._rng.standard_normal(self.dim))
+        for n in self._slot_names:
+            self.slots[n][slot] = self._slot_init[n]
+        self._score[slot] = 0 if self.evict == "lfu" else self._clock
+        return slot
+
+    def pull(self, ids):
+        """Unique host gather; creates rows on first touch. Returns
+        (rows [k, D] device, uniq signs [k], ctx) — pass ctx to push()."""
+        uniq = np.unique(np.asarray(ids).reshape(-1))
+        with self._lock:
+            slot_arr = np.empty((len(uniq),), np.int64)
+            for i, sign in enumerate(uniq):
+                s = self._index.get(int(sign))
+                if s is None:
+                    s = self._alloc(int(sign))
+                self._touch(s)
+                slot_arr[i] = s
+            rows = self.arena[slot_arr]
+        return jnp.asarray(rows), uniq, {"signs": uniq, "slots": slot_arr}
+
+    def embed_ids(self, rows, uniq, ids):
+        return _embed_from_rows(rows, uniq, ids, self.dim)
+
+    def push(self, ctx, row_grad):
+        """Row-wise optimizer update into the arena. Rows whose slot was
+        reallocated to a DIFFERENT sign between pull and push (eviction
+        under async prefetch) are dropped — checked by sign identity, the
+        PSLib stale-update semantics."""
+        slot_arr = np.asarray(ctx["slots"], np.int64)
+        signs = np.asarray(ctx["signs"])
+        g = np.asarray(row_grad)
+        if slot_arr.size == 0:
+            return
+        with self._lock:
+            live = np.array([self._rindex.get(int(sl)) == int(sg)
+                             for sl, sg in zip(slot_arr, signs)], bool)
+            if not live.any():
+                self.step += 1
+                return
+            sl = slot_arr[live]
+            p = self.arena[sl]
+            slo = {n: self.slots[n][sl] for n in self._slot_names}
+            new_p, new_s = _host_row_update(self.opt, self.step, p, slo,
+                                            g[live])
+            self.arena[sl] = new_p.astype(self.arena.dtype)
+            for n in self._slot_names:
+                self.slots[n][sl] = new_s[n].astype(self.slots[n].dtype)
+            self.step += 1
+
+    @property
+    def resident(self):
+        return len(self._index)
+
+
+class ShardedHostTable:
+    """Multi-host PSLib topology: each process owns the rows with
+    ``sign % num_shards == shard_id`` in its own host RAM (ref:
+    fleet_wrapper.h:55 — tables sharded across pserver machines;
+    downpour_worker.cc pull/push flow).
+
+    TPU-first redesign of the RPC pull: every process host-gathers the rows
+    it owns into a zero-filled [k, D] buffer and the buffers are summed
+    with one ``psum`` over the mesh axis — the parameter-server exchange as
+    an XLA collective over ICI/DCN instead of brpc. Push needs no
+    communication: row gradients are already replicated after the train
+    step's psum, and each process updates only its owned rows.
+    """
+
+    def __init__(self, dim, capacity_per_shard, shard_id, num_shards,
+                 optimizer=None, **kw):
+        self.shard_id, self.num_shards = int(shard_id), int(num_shards)
+        self.dim = dim
+        self.local = FeatureTable(dim, capacity_per_shard,
+                                  optimizer=optimizer, **kw)
+
+    def owns(self, signs):
+        return (np.asarray(signs) % self.num_shards) == self.shard_id
+
+    def pull_local(self, uniq, return_ctx=False):
+        """Host gather of the owned subset of `uniq` into a zero-filled
+        [k, D] buffer (device). Sum the shards' buffers (psum over the mesh
+        axis, or `sum_shards` in-process) to complete the pull. With
+        return_ctx, also returns the ctx that push_local requires."""
+        uniq = np.asarray(uniq).reshape(-1)
+        mine = self.owns(uniq)
+        buf = np.zeros((len(uniq), self.dim), self.local.arena.dtype)
+        if mine.any():
+            rows, _, lctx = self.local.pull(uniq[mine])
+            buf[mine] = np.asarray(rows)
+            ctx = {"local": lctx, "positions": np.where(mine)[0]}
+        else:
+            ctx = {"local": None, "positions": np.empty((0,), np.int64)}
+        if return_ctx:
+            return jnp.asarray(buf), ctx
+        return jnp.asarray(buf)
+
+    @staticmethod
+    def sum_shards(buffers):
+        """In-process stand-in for the cross-host psum (used by tests and
+        single-process multi-shard serving)."""
+        out = buffers[0]
+        for b in buffers[1:]:
+            out = out + b
+        return out
+
+    def push_local(self, row_grad, ctx):
+        """Apply the (replicated) row-gradient to the owned rows only.
+        ctx comes from ``pull_local(uniq, return_ctx=True)`` — pulls and
+        pushes are explicitly paired (a hidden last-pull state would be
+        silently clobbered by prefetch-style double pulls)."""
+        if ctx["local"] is None:
+            return
+        g = np.asarray(row_grad)[ctx["positions"]]
+        self.local.push(ctx["local"], g)

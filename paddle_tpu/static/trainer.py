@@ -36,6 +36,13 @@ class TrainerConfig:
     prefetch: bool = True          # stage batch t+1 during step t
     log_every: int = 0             # 0 = silent
     max_steps: int = None          # None = drain the dataset
+    # failure detection (ref heart_beat_monitor.h:38): None = auto-on when
+    # jax.process_count() > 1 and a heartbeat_dir is available
+    heartbeat: bool = None
+    heartbeat_dir: str = None      # shared dir for cross-process mtimes
+    heartbeat_timeout_s: float = None   # default: dist_heartbeat_timeout_s
+    heartbeat_interval_s: float = None  # default: dist_heartbeat_interval_s
+    on_peer_stall: callable = None      # (worker, age_s) -> None
 
 
 class _EndOfData:
@@ -110,7 +117,74 @@ class Trainer:
             return [dataset.reader()]
         return [dataset]  # assume callable yielding items
 
-    def train(self, state, dataset, batch_size=None):
+    # -- failure detection (ref heart_beat_monitor.h LostWorkerMonitor) ----
+    def _start_heartbeat(self, num_workers=None, worker_id=None):
+        """Cross-process liveness: ping a shared-dir mtime file per step and
+        monitor peers in the background, flagging silent RUNNING workers.
+        Returns (ping, finish) callables (no-ops when disabled)."""
+        cfg = self.cfg
+        enabled = cfg.heartbeat
+        if enabled is None:
+            enabled = (jax.process_count() > 1
+                       and cfg.heartbeat_dir is not None)
+        if enabled:
+            enforce(cfg.heartbeat_dir is not None,
+                    "TrainerConfig(heartbeat=True) requires heartbeat_dir "
+                    "(a shared directory all workers can reach)")
+        if not enabled:
+            return (lambda: None), (lambda ok=True: None)
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.parallel.heartbeat import STALLED, FileHeartbeat
+        nw = num_workers if num_workers is not None else jax.process_count()
+        wid = worker_id if worker_id is not None else jax.process_index()
+        timeout = (cfg.heartbeat_timeout_s if cfg.heartbeat_timeout_s
+                   is not None else F.get_flag("dist_heartbeat_timeout_s"))
+        interval = (cfg.heartbeat_interval_s if cfg.heartbeat_interval_s
+                    is not None else F.get_flag("dist_heartbeat_interval_s"))
+        hb = FileHeartbeat(cfg.heartbeat_dir, wid)
+        hb.ping()
+        last_ping = [time.monotonic()]
+
+        def ping():
+            # throttle to the monitor interval: per-step open()+utime() on
+            # a shared (possibly network) dir would put metadata writes on
+            # the hot loop while scan() only samples every interval anyway
+            now = time.monotonic()
+            if now - last_ping[0] >= min(interval, timeout / 4):
+                hb.ping()
+                last_ping[0] = now
+
+        stop = threading.Event()
+        stalled = self.stalled_peers = set()
+
+        def monitor():
+            while not stop.wait(interval):
+                for w, (st, age) in FileHeartbeat.scan(
+                        cfg.heartbeat_dir, nw, timeout).items():
+                    if w != wid and st == STALLED and w not in stalled:
+                        stalled.add(w)
+                        if cfg.on_peer_stall is not None:
+                            cfg.on_peer_stall(w, age)
+                        else:
+                            print(f"[trainer] WARNING: worker {w} silent "
+                                  f"for {age:.1f}s (> {timeout}s)")
+
+        t = threading.Thread(target=monitor, daemon=True,
+                             name="trainer-heartbeat")
+        t.start()
+
+        def finish(ok=True):
+            if ok:
+                # only a CLEAN exit writes the done marker — a crashed
+                # worker must look STALLED to its peers, not COMPLETED
+                hb.complete()
+            stop.set()
+            t.join(timeout=5)
+
+        return ping, finish
+
+    def train(self, state, dataset, batch_size=None, num_workers=None,
+              worker_id=None):
         """Drain the dataset (or max_steps); returns (state, stats).
 
         With batch_size set, ingestion threads enqueue SAMPLES and the
@@ -120,6 +194,7 @@ class Trainer:
         Without it, readers must yield ready batches."""
         chan, stop, errors = self._start_ingest(
             self._split_readers(dataset))
+        hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
         cfg = self.cfg
         step = 0
         t0 = time.perf_counter()
@@ -142,6 +217,7 @@ class Trainer:
                 buf.append(item)
             return _collate(buf)
 
+        clean = False
         try:
             nxt = next_batch()
             while nxt is not None:
@@ -156,14 +232,17 @@ class Trainer:
                 else:
                     loss, state = self.step_fn(state, *staged)
                 step += 1
+                hb_ping()
                 if cfg.log_every and step % cfg.log_every == 0:
                     lv = float(loss)
                     self.history.append((step, lv))
                     print(f"[trainer] step {step} loss {lv:.6f}")
                 if not cfg.prefetch:
                     nxt = next_batch()
+            clean = True
         finally:
             stop.set()  # release producers even when step_fn raises
+            hb_finish(clean)
         if errors:
             raise RuntimeError(
                 f"ingestion thread failed after {step} steps") from errors[0]

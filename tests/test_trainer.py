@@ -1,6 +1,7 @@
 """Trainer runtime: train_from_dataset + DeviceWorker parity
 (ref trainer.h:38, device_worker.h:151/:180, executor.py:1107)."""
 
+import os
 import numpy as np
 import pytest
 
@@ -164,3 +165,61 @@ def test_file_dataset_validation_and_cleanup(tmp_path):
     gen = ds.reader()()
     next(gen)
     gen.close()
+
+
+class TestTrainerHeartbeat:
+    """Failure detection wired into the Trainer runtime (VERDICT r2 #10;
+    ref operators/distributed/heart_beat_monitor.h:38 — a RUNNING trainer
+    that stops pinging is flagged)."""
+
+    def test_killed_peer_detected(self, tmp_path):
+        import time as _time
+
+        from paddle_tpu.parallel.heartbeat import FileHeartbeat
+        from paddle_tpu.static.trainer import Trainer, TrainerConfig
+
+        hbdir = str(tmp_path / "hb")
+        # simulate a peer (worker 1) that pinged once and then died
+        peer = FileHeartbeat(hbdir, 1)
+        peer.ping()
+        old = _time.time() - 60.0
+        os.utime(peer.path, (old, old))
+
+        stalls = []
+
+        def slow_reader():
+            for i in range(6):
+                _time.sleep(0.05)
+                yield (np.ones((2, 2), np.float32),)
+
+        def step(state, x):
+            return jnp.sum(x) * 0.0 + state, state + 1.0
+
+        cfg = TrainerConfig(
+            heartbeat=True, heartbeat_dir=hbdir,
+            heartbeat_timeout_s=0.5, heartbeat_interval_s=0.05,
+            on_peer_stall=lambda w, age: stalls.append((w, age)),
+            num_ingest_threads=1)
+        tr = Trainer(step, cfg)
+        state, stats = tr.train(jnp.zeros(()), lambda: slow_reader(),
+                                num_workers=2, worker_id=0)
+        assert stats["steps"] == 6
+        assert stalls and stalls[0][0] == 1
+        assert stalls[0][1] > 0.5
+        assert tr.stalled_peers == {1}
+        # worker 0 completed cleanly: done marker present
+        assert os.path.exists(os.path.join(hbdir, "worker_0.hb.done"))
+
+    def test_heartbeat_off_by_default_single_process(self, tmp_path):
+        from paddle_tpu.static.trainer import Trainer, TrainerConfig
+
+        def reader():
+            yield (np.ones((2, 2), np.float32),)
+
+        def step(state, x):
+            return jnp.sum(x), state
+
+        tr = Trainer(step, TrainerConfig(num_ingest_threads=1))
+        _, stats = tr.train(jnp.zeros(()), lambda: reader())
+        assert stats["steps"] == 1
+        assert not hasattr(tr, "stalled_peers")

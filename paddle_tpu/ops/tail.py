@@ -251,7 +251,9 @@ def register_reference_aliases():
             ("smooth_l1", "smooth_l1_loss"),
             ("nce", "nce_loss"),
             ("cross_entropy2", "cross_entropy"),
-            ("unique", "unique_with_counts")):
+            ("unique", "unique_with_counts"),
+            ("cvm", "continuous_value_model"),
+            ("deformable_psroi_pooling", "deformable_psroi_pool")):
         _alias(name, target)
 
 
@@ -380,3 +382,164 @@ def filter_by_instag(x, ins_tags, filter_tags, out_size=None, pad_tag=0):
     out = jnp.where(valid.reshape((-1,) + (1,) * (x.ndim - 1)),
                     jnp.take(x, jnp.minimum(row_map, B - 1), axis=0), 0)
     return out, hit, row_map
+
+
+@register_op("conv_shift")
+def conv_shift(x, y):
+    """ref operators/conv_shift_op.cc — NTM circular correlation:
+    out[i, j] = sum_k x[i, (j + k - (N-1)/2) mod M] * y[i, k];
+    x [B, M], y [B, N] (N odd, N <= M) -> [B, M]."""
+    B, M = x.shape
+    N = y.shape[1]
+    half = (N - 1) // 2
+    # gather index matrix [M, N]: column j of out reads x at (j+k-half)%M
+    j = jnp.arange(M)[:, None]
+    k = jnp.arange(N)[None, :]
+    idx = (j + k - half) % M
+    return jnp.einsum("bmn,bn->bm", x[:, idx], y)
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(x, y):
+    """ref operators/squared_l2_distance_op.cc — rowwise ||x-y||²; y may
+    have batch 1 (broadcast). Returns (distance [N, 1], sub [N, D])."""
+    sub = x - y
+    return jnp.sum(sub * sub, axis=-1, keepdims=True), sub
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x):
+    """ref operators/squared_l2_norm_op.cc — sum of squares (scalar)."""
+    return jnp.sum(x * x)
+
+
+@register_op("l1_norm")
+def l1_norm(x):
+    """ref operators/l1_norm_op.cc — sum of absolute values (scalar)."""
+    return jnp.sum(jnp.abs(x))
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss(x, y):
+    """ref operators/modified_huber_loss_op.h — binary classification loss
+    on margin val = (2y-1)*x: val<-1 -> -4*val; val<1 -> (1-val)²; else 0."""
+    val = (2.0 * y - 1.0) * x
+    return jnp.where(val < -1.0, -4.0 * val,
+                     jnp.where(val < 1.0, (1.0 - val) ** 2, 0.0))
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair(score, label, query_id):
+    """ref operators/positive_negative_pair_op.cc — LTR metric: within each
+    query, count item pairs ranked concordantly (positive), discordantly
+    (negative); ties count 0.5 each. Returns (positive, negative, neutral).
+
+    TPU-first: the reference walks a per-query hash map; here one [N, N]
+    comparison matrix masked to same-query pairs (static shape)."""
+    s = score.reshape(-1)
+    l = label.reshape(-1)
+    q = query_id.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)       # each pair once
+    pair = same_q & (upper > 0) & (l[:, None] != l[None, :])
+    prod = (s[:, None] - s[None, :]) * (l[:, None] - l[None, :]).astype(
+        s.dtype)
+    # reference tie semantics (positive_negative_pair_op.h:94-99): a score
+    # tie increments neutral AND falls into the negative branch (the
+    # ternary's > 0 test fails at exactly 0)
+    pos = jnp.sum(jnp.where(pair, (prod > 0).astype(s.dtype), 0.0))
+    neg = jnp.sum(jnp.where(pair, (prod <= 0).astype(s.dtype), 0.0))
+    neu = jnp.sum(jnp.where(pair, (s[:, None] == s[None, :]).astype(s.dtype),
+                            0.0))
+    return pos, neg, neu
+
+
+@register_op("sample_logits")
+def sample_logits(logits, labels, num_samples, key, remove_accidental_hits=True,
+                  use_customized_samples=False, customized_samples=None,
+                  customized_probabilities=None):
+    """ref operators/sample_logits_op.{cc,h} — sampled-softmax helper.
+
+    samples = concat(labels, drawn negatives) [N, T+S] with per-column
+    sampler probabilities q; output = gather(logits, samples) - log(q)
+    (the same correction for true and sampled columns, as the reference's
+    `smp_logits - probs.log()`), with accidental hits (a sampled column
+    equal to one of the row's true labels) pushed to -inf. With
+    use_customized_samples, customized_samples/probabilities are the full
+    [N, T+S] arrays (the reference ShareDataWith's them verbatim).
+    Returns (sampled_logits [N, T+S], sampled_labels [N, T]).
+
+    Deviation: negatives are drawn uniformly (q = 1/K) rather than
+    log-uniform — Zipf resampling is data-dependent control flow; feed
+    customized samples for a log-uniform schedule."""
+    n, k = logits.shape
+    t = labels.shape[1]
+    if use_customized_samples:
+        samples = customized_samples                       # [N, T+S]
+        probs = customized_probabilities
+    else:
+        drawn = jax.random.randint(key, (n, num_samples), 0, k)
+        samples = jnp.concatenate([labels, drawn], axis=1)  # [N, T+S]
+        probs = jnp.full((n, t + num_samples), 1.0 / k, logits.dtype)
+    out = jnp.take_along_axis(logits, samples, axis=1)     # [N, T+S]
+    if remove_accidental_hits:
+        hit = samples[:, None, t:] == labels[:, :, None]   # [N, T, S]
+        out = out.at[:, t:].add(jnp.where(hit.any(1), -1e20, 0.0))
+    out = out - jnp.log(probs)
+    sampled_labels = jnp.broadcast_to(jnp.arange(t)[None, :], (n, t))
+    return out, sampled_labels
+
+
+@register_op("similarity_focus")
+def similarity_focus(x, axis, indexes):
+    """ref operators/similarity_focus_op.cc — per (batch, index) slice
+    T=[B', C'], greedily mark min(B',C') maxima with distinct rows AND
+    columns (like a greedy assignment), OR the masks over indexes, and
+    broadcast back to x's shape. x: 4-D [N, A, B, C]; axis in {1, 2, 3}.
+
+    TPU-first: the reference's sort-and-scan becomes a lax.fori_loop of
+    argmax + row/col suppression (min(B', C') static iterations)."""
+    enforce(x.ndim == 4, "similarity_focus expects a 4-D input")
+    enforce(axis in (1, 2, 3), "axis must be 1, 2 or 3")
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = jnp.transpose(x, perm)                       # [N, K, R, C]
+    n, _, r, c = xt.shape
+    iters = min(r, c)
+
+    def one_slice(t):                                  # [R, C] -> mask
+        def body(_, carry):
+            mask, rowf, colf = carry
+            neg = jnp.finfo(t.dtype).min
+            masked = jnp.where(rowf[:, None] | colf[None, :], neg, t)
+            flat = jnp.argmax(masked)
+            i, j = flat // c, flat % c
+            mask = mask.at[i, j].set(1.0)
+            return mask, rowf.at[i].set(True), colf.at[j].set(True)
+
+        mask0 = jnp.zeros_like(t)
+        rowf0 = jnp.zeros((r,), bool)
+        colf0 = jnp.zeros((c,), bool)
+        mask, _, _ = lax.fori_loop(0, iters, body, (mask0, rowf0, colf0))
+        return mask
+
+    sel = xt[:, jnp.asarray(list(indexes))]            # [N, I, R, C]
+    masks = jax.vmap(jax.vmap(one_slice))(sel)         # [N, I, R, C]
+    merged = masks.max(axis=1, keepdims=True)          # OR over indexes
+    out = jnp.broadcast_to(merged, xt.shape)
+    inv = np.argsort(perm)
+    return jnp.transpose(out, tuple(inv))
+
+
+@register_op("is_empty")
+def is_empty(x):
+    """ref operators/is_empty_op.cc — static on TPU: shapes are compile-time."""
+    return jnp.asarray(x.size == 0)
+
+
+@register_op("minus")
+def minus(x, y):
+    """ref operators/minus_op.cc — out = x - y."""
+    return x - y
+
+
+

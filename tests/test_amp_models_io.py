@@ -61,6 +61,7 @@ class TestAMP:
 
 
 class TestModels:
+    @pytest.mark.slow
     def test_resnet18_cifar_train_step(self):
         model = models.ResNet(18, 10, small_input=True)
         v = model.init(jax.random.key(0))
@@ -113,6 +114,7 @@ class TestModels:
                 loss0 = float(loss)
         assert float(loss) < loss0  # memorizing a fixed batch
 
+    @pytest.mark.slow
     def test_transformer_tiny_forward_and_loss(self):
         from paddle_tpu.models.transformer import (Transformer,
                                                    TransformerConfig,

@@ -34,6 +34,7 @@ def train_steps(loss_fn, params, steps=12, lr=0.1, opt=None):
 
 
 class TestSeq2Seq:
+    @pytest.mark.slow
     def test_nmt_loss_drops_and_decodes(self):
         cfg = Seq2SeqConfig.tiny()
         model = AttentionSeq2Seq(cfg)
@@ -72,6 +73,7 @@ class TestSeq2Seq:
 
 
 class TestTagger:
+    @pytest.mark.slow
     def test_crf_tagger_learns_identity_tags(self):
         cfg = TaggerConfig.tiny()
         model = BiLstmCrfTagger(cfg)
@@ -127,6 +129,7 @@ class TestRecommender:
 
 
 class TestVisionModels:
+    @pytest.mark.slow
     def test_vgg16_forward_and_grad(self):
         model = pt.models.vgg16(num_classes=10)
         variables = model.init(jax.random.key(3))
@@ -143,6 +146,7 @@ class TestVisionModels:
         flat = jax.tree_util.tree_leaves(g)
         assert all(np.all(np.isfinite(np.asarray(l))) for l in flat)
 
+    @pytest.mark.slow
     def test_se_resnext_tiny_forward(self):
         model = pt.models.vision_cls.SEResNeXt(
             layers=(1, 1), cardinality=4, num_classes=5)
@@ -294,6 +298,7 @@ class TestSentiment:
                                    atol=1e-6)
 
 
+@pytest.mark.slow
 def test_examples_run(tmp_path):
     """The examples/ scripts are living documentation — keep them running."""
     import os

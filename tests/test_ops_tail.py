@@ -12,6 +12,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.ops import tail as T
 from paddle_tpu.ops.graph import (tree_conv, tree_conv_layer,
                                   tree_patch_coefficients)
 from paddle_tpu.ops.nn import fsp_matrix
@@ -404,3 +405,122 @@ class TestOpTail3:
         assert out8.shape == (8, 2)
         np.testing.assert_allclose(np.asarray(out8)[2:], 0.0)
         assert np.all(np.asarray(rm8)[2:] == 4)
+
+
+class TestOpTailR3:
+    """Round-3 straggler sweep (VERDICT r2 missing #5 follow-up)."""
+
+    def test_cvm_alias_reference_semantics(self):
+        # ref cvm_op.h: y0 = log(show+1); y1 = log(click+1) - y0
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        fn = R.get("cvm")          # alias of continuous_value_model
+        x = jnp.asarray([[2.0, 1.0, 0.5, 0.25]])
+        out = fn(x, use_cvm=True)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            [[np.log(3.0), np.log(2.0) - np.log(3.0), 0.5, 0.25]],
+            rtol=1e-6)
+        out2 = fn(x, use_cvm=False)
+        np.testing.assert_allclose(np.asarray(out2), [[0.5, 0.25]])
+
+    def test_conv_shift_matches_loop(self):
+        rng = np.random.RandomState(0)
+        B, M, N = 3, 7, 3
+        x = rng.randn(B, M).astype(np.float32)
+        y = rng.randn(B, N).astype(np.float32)
+        ref = np.zeros((B, M), np.float32)
+        half = (N - 1) // 2
+        for b in range(B):
+            for j in range(M):
+                for k in range(N):
+                    ref[b, j] += x[b, (j + k - half) % M] * y[b, k]
+        got = T.conv_shift(jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_squared_l2_and_l1(self):
+        x = jnp.asarray([[1.0, -2.0], [3.0, 0.0]])
+        y = jnp.asarray([[0.0, 0.0], [1.0, 1.0]])
+        d, sub = T.squared_l2_distance(x, y)
+        np.testing.assert_allclose(np.asarray(d), [[5.0], [5.0]])
+        np.testing.assert_allclose(np.asarray(sub), [[1, -2], [2, -1]])
+        assert float(T.squared_l2_norm(x)) == 14.0
+        assert float(T.l1_norm(x)) == 6.0
+
+    def test_modified_huber_loss_regions(self):
+        x = jnp.asarray([-2.0, 0.0, 2.0])
+        y = jnp.asarray([1.0, 1.0, 1.0])        # margin = x
+        out = np.asarray(T.modified_huber_loss(x, y))
+        np.testing.assert_allclose(out, [8.0, 1.0, 0.0])
+        # flipped label mirrors the margin
+        out0 = np.asarray(T.modified_huber_loss(x, jnp.zeros(3)))
+        np.testing.assert_allclose(out0, [0.0, 1.0, 8.0])
+
+    def test_positive_negative_pair(self):
+        # query 0: items with labels [2, 1] scores [0.9, 0.1] -> concordant
+        # query 1: labels [1, 2] scores [0.8, 0.2] -> discordant
+        score = jnp.asarray([0.9, 0.1, 0.8, 0.2])
+        label = jnp.asarray([2.0, 1.0, 1.0, 2.0])
+        qid = jnp.asarray([0, 0, 1, 1])
+        pos, neg, neu = T.positive_negative_pair(score, label, qid)
+        assert (float(pos), float(neg), float(neu)) == (1.0, 1.0, 0.0)
+        # reference tie semantics (positive_negative_pair_op.h:94-99):
+        # a tie increments neutral AND negative
+        score2 = jnp.asarray([0.5, 0.5])
+        pos, neg, neu = T.positive_negative_pair(
+            score2, jnp.asarray([1.0, 2.0]), jnp.asarray([0, 0]))
+        assert (float(pos), float(neg), float(neu)) == (0.0, 1.0, 1.0)
+
+    def test_sample_logits_reference_semantics(self):
+        rng = np.random.RandomState(1)
+        n, k, t, ns = 4, 20, 1, 5
+        logits = jnp.asarray(rng.randn(n, k).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, k, (n, t)))
+        out, slab = T.sample_logits(logits, labels, ns, jax.random.key(0),
+                                    remove_accidental_hits=False)
+        assert out.shape == (n, t + ns) and slab.shape == (n, t)
+        assert (np.asarray(slab) == 0).all()
+        # identical -log(q) correction for true and sampled columns
+        # (ref sample_logits_op.h: smp_logits - probs.log())
+        true_col = np.asarray(out)[:, 0]
+        expect = np.take_along_axis(np.asarray(logits), np.asarray(labels),
+                                    1)[:, 0] + np.log(k)
+        np.testing.assert_allclose(true_col, expect, rtol=1e-5)
+        # customized: full [N, T+S] samples; accidental hits (sampled col
+        # == a true label) pushed to -inf
+        cs = jnp.concatenate([labels, jnp.broadcast_to(labels, (n, 2))], 1)
+        out2, _ = T.sample_logits(
+            logits, labels, 2, jax.random.key(0),
+            use_customized_samples=True, customized_samples=cs,
+            customized_probabilities=jnp.full((n, t + 2), 0.05))
+        assert (np.asarray(out2)[:, t:] < -1e19).all()
+        assert np.isfinite(np.asarray(out2)[:, :t]).all()
+
+    def test_similarity_focus(self):
+        # [1, 2, 2, 2]: axis=1 index 0 slice [[1, 9], [8, 2]]
+        # greedy: 9 at (0,1), then 8's row/col blocked -> pick (1,0)=8
+        x = jnp.asarray([[[[1.0, 9.0], [8.0, 2.0]],
+                          [[0.0, 0.0], [0.0, 0.0]]]])
+        m = np.asarray(T.similarity_focus(x, axis=1, indexes=[0]))
+        assert m.shape == x.shape
+        np.testing.assert_allclose(m[0, 0], [[0, 1], [1, 0]])
+        np.testing.assert_allclose(m[0, 1], [[0, 1], [1, 0]])  # broadcast
+
+    def test_is_empty_minus(self):
+        assert bool(T.is_empty(jnp.zeros((0, 3))))
+        assert not bool(T.is_empty(jnp.zeros((1,))))
+        np.testing.assert_allclose(
+            np.asarray(T.minus(jnp.asarray([3.0]), jnp.asarray([1.0]))),
+            [2.0])
+
+    def test_deformable_psroi_pooling_alias(self):
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        assert R.meta("deformable_psroi_pooling").get("alias_of") \
+            == "deformable_psroi_pool"
+        fn = R.get("deformable_psroi_pooling")
+        x = jnp.ones((1, 4, 4, 4))
+        out, cnt = fn(x, jnp.asarray([[0.0, 0.0, 3.0, 3.0]]),
+                      jnp.asarray([0]), output_dim=1, group_size=(2, 2),
+                      pooled_height=2, pooled_width=2, no_trans=True,
+                      sample_per_part=2)
+        assert out.shape == (1, 1, 2, 2)

@@ -45,10 +45,10 @@ def test_converges(maker):
     loss_fn, params = quadratic_problem()
     o = maker()
     st = o.init(params)
+    step = jax.jit(lambda p, s: o.minimize(loss_fn, p, s))
     loss0 = None
     for i in range(100):
-        loss, params, st, _ = jax.jit(
-            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+        loss, params, st, _ = step(params, st)
         if loss0 is None:
             loss0 = float(loss)
     assert float(loss) < loss0 * 0.1, (float(loss), loss0)
@@ -110,9 +110,9 @@ def test_lookahead():
     loss_fn, params = quadratic_problem()
     o = opt.Lookahead(opt.SGD(0.5), alpha=0.5, k=5)
     st = o.init(params)
+    step = jax.jit(lambda p, s: o.minimize(loss_fn, p, s))
     for _ in range(60):
-        loss, params, st, _ = jax.jit(
-            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+        loss, params, st, _ = step(params, st)
     assert float(loss) < 1e-2
 
 
@@ -142,9 +142,9 @@ def test_dgc_momentum_converges():
     loss_fn, params = quadratic_problem()
     o = opt.DGCMomentum(0.1, 0.9, rampup_begin_step=5, sparsity=0.5)
     st = o.init(params)
+    step = jax.jit(lambda p, s: o.minimize(loss_fn, p, s))
     for _ in range(150):
-        loss, params, st, _ = jax.jit(
-            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+        loss, params, st, _ = step(params, st)
     assert float(loss) < 0.05
 
 
@@ -169,9 +169,9 @@ def test_schedule_in_optimizer():
     loss_fn, params = quadratic_problem()
     o = opt.SGD(lrs.piecewise_decay([50], [0.5, 0.05]))
     st = o.init(params)
+    step = jax.jit(lambda p, s: o.minimize(loss_fn, p, s))
     for _ in range(100):
-        loss, params, st, _ = jax.jit(
-            lambda p, s: o.minimize(loss_fn, p, s))(params, st)
+        loss, params, st, _ = step(params, st)
     assert float(loss) < 5e-3
 
 

@@ -64,6 +64,76 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         block_q=512, block_k=512):
+    """Ring attention with the Pallas flash kernel as the per-block
+    engine: each ring step runs the O(T) online-softmax kernel on the
+    resident KV block and partial results merge by logsumexp — so the
+    per-device inner loop is MXU-tiled VMEM compute instead of a dense
+    [Tl, Tl] XLA einsum, while KV blocks rotate on ICI exactly as in
+    `ring_attention`.
+
+    Causality is resolved at BLOCK granularity with lax.cond (the kernel's
+    causal flag is compile-time): a device's own block runs the causal
+    kernel, blocks from earlier ranks run the plain kernel, later ranks
+    contribute nothing. Falls back to `ring_attention` off-TPU or for
+    shapes the kernel refuses.
+
+    Call inside shard_map(..., check_vma=False) — pallas_call does not
+    declare varying-mesh-axes metadata (same requirement as
+    parallel/pipeline.py).
+    """
+    from paddle_tpu.core.flags import get_flag
+    from paddle_tpu.ops.pallas import on_tpu
+    from paddle_tpu.ops.pallas.flash_attention import \
+        _flash_attention_fwd_tpu
+    b, h, tl, d = q.shape
+    if not ((on_tpu() or get_flag("pallas_interpret"))
+            and d % 64 == 0 and tl % 8 == 0):
+        return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def blk(kb, vb, blk_causal):
+        out, lse = _flash_attention_fwd_tpu(
+            q, kb, vb, scale, blk_causal, min(block_q, tl), min(block_k, tl),
+            return_lse=True)
+        return out.astype(jnp.float32), lse
+
+    def step(i, state):
+        o, lse, kb, vb = state
+        owner = (my - i) % n
+        if causal:
+            ob, lb = lax.cond(
+                owner == my,
+                lambda kv: blk(kv[0], kv[1], True),
+                lambda kv: lax.cond(
+                    owner < my,
+                    lambda kv2: blk(kv2[0], kv2[1], False),
+                    # later rank: causally invisible — contributes nothing
+                    lambda kv2: (jnp.zeros_like(o),
+                                 jnp.full(lse.shape, NEG_INF, jnp.float32)),
+                    kv),
+                (kb, vb))
+        else:
+            ob, lb = blk(kb, vb, False)
+        # merge normalized partials by logsumexp weight
+        new_lse = jnp.logaddexp(lse, lb)
+        w_old = jnp.exp(lse - new_lse)[..., None]
+        w_new = jnp.exp(lb - new_lse)[..., None]
+        o = o * w_old + ob * w_new
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, new_lse, kb, vb
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
+    o, _, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, axis_name, attention_fn=None, causal=False):
     """Ulysses/DeepSpeed-style sequence parallelism: all_to_all reshards
     [B, H, T/N, D] → [B, H/N, T, D] so each device holds full sequences for a

@@ -14,6 +14,9 @@ distillers are plain loss terms composed into the student's loss function
 
 from paddle_tpu.slim.distill import (Distiller, fsp_loss, l2_loss,
                                      soft_label_loss)
-from paddle_tpu.slim.nas import LightNAS, SAController, SearchSpace
+from paddle_tpu.slim.nas import (ControllerServer, LightNAS, SAController,
+                                 SearchAgent, SearchSpace,
+                                 distributed_search)
 from paddle_tpu.slim.prune import (MaskedOptimizer, StructurePruner,
-                                   prune_tree, sensitivity)
+                                   prune_tree, sensitive_prune,
+                                   sensitive_prune_ratios, sensitivity)

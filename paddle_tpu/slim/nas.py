@@ -115,3 +115,166 @@ class LightNAS:
             reward = float(self.eval_fn(tokens))
             self.controller.update(tokens, reward)
         return self.controller.best
+
+
+class ControllerServer:
+    """Socket-served controller for DISTRIBUTED search (ref
+    nas/controller_server.py + search_agent.py: N agents each train a
+    candidate and report rewards to one central SA controller).
+
+    Protocol (original design, line-delimited JSON over TCP):
+      agent -> {"op": "next"}                      -> {"tokens": [...]}
+      agent -> {"op": "update", "tokens": [...],
+                "reward": r}                       -> {"ok": true,
+                                                       "steps_left": n}
+      agent -> {"op": "best"}                      -> {"tokens": [...],
+                                                       "reward": r}
+    The controller state is guarded by a lock, so any number of agents can
+    pull candidates and push rewards concurrently (the reference's
+    max_client_num concurrency)."""
+
+    def __init__(self, controller, search_steps=None, address=("", 0)):
+        import socket
+        import threading
+        self._controller = controller
+        self._steps_left = [search_steps if search_steps is not None
+                            else -1]
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(address)
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._closed = False
+        self._thread = None
+
+    def start(self):
+        import threading
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def _serve(self):
+        import json
+        import threading
+
+        def handle(conn):
+            f = conn.makefile("rw")
+            try:
+                for line in f:
+                    req = json.loads(line)
+                    with self._lock:
+                        if req["op"] == "next":
+                            if self._steps_left[0] == 0:
+                                # budget exhausted (ref controller_server's
+                                # search_steps): stop handing out candidates
+                                resp = {"tokens": None, "done": True}
+                            else:
+                                resp = {"tokens":
+                                        self._controller.next_tokens()}
+                        elif req["op"] == "update":
+                            self._controller.update(req["tokens"],
+                                                    float(req["reward"]))
+                            if self._steps_left[0] > 0:
+                                self._steps_left[0] -= 1
+                            resp = {"ok": True,
+                                    "steps_left": self._steps_left[0]}
+                        elif req["op"] == "best":
+                            t, r = self._controller.best
+                            resp = {"tokens": t, "reward": r}
+                        else:
+                            resp = {"error": f"unknown op {req['op']}"}
+                    f.write(json.dumps(resp) + "\n")
+                    f.flush()
+            except (ValueError, OSError):
+                pass
+            finally:
+                conn.close()
+
+        while not self._closed:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SearchAgent:
+    """Client side of the distributed search (ref nas/search_agent.py):
+    pull a candidate, evaluate it locally, report the reward."""
+
+    def __init__(self, host, port):
+        self._addr = (host, port)
+
+    def _rpc(self, req):
+        import json
+        import socket
+        with socket.create_connection(self._addr, timeout=60) as s:
+            f = s.makefile("rw")
+            f.write(json.dumps(req) + "\n")
+            f.flush()
+            return json.loads(f.readline())
+
+    def next_tokens(self):
+        return self._rpc({"op": "next"})["tokens"]
+
+    def update(self, tokens, reward):
+        return self._rpc({"op": "update", "tokens": list(tokens),
+                          "reward": float(reward)})
+
+    def best(self):
+        r = self._rpc({"op": "best"})
+        return r["tokens"], r["reward"]
+
+    def run(self, eval_fn, steps):
+        """Evaluate up to `steps` candidates against the shared controller;
+        stops early when the server's search budget is exhausted."""
+        for _ in range(steps):
+            tokens = self.next_tokens()
+            if tokens is None:          # server budget exhausted
+                return
+            self.update(tokens, float(eval_fn(tokens)))
+
+
+def distributed_search(space, eval_fn, num_agents=2, steps_per_agent=10,
+                       constrain_func=None, controller=None):
+    """Multi-agent search against one ControllerServer (in-process agents;
+    point real SearchAgents at server.port for multi-host). Returns
+    (best_tokens, best_reward)."""
+    import threading
+    ctrl = controller or SAController()
+    ctrl.reset(space.range_table, space.init_tokens, constrain_func)
+    # seed the controller with the init point so next_tokens mutates it
+    ctrl.update(list(space.init_tokens), float(eval_fn(space.init_tokens)))
+    server = ControllerServer(ctrl)
+    server.start()
+    agents = [SearchAgent("127.0.0.1", server.port)
+              for _ in range(num_agents)]
+    errors = []
+
+    def run_agent(a):
+        try:
+            a.run(eval_fn, steps_per_agent)
+        except BaseException as e:      # surfaced after join — a crashed
+            errors.append(e)            # search must not look successful
+
+    threads = [threading.Thread(target=run_agent, args=(a,))
+               for a in agents]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    best = agents[0].best()
+    server.close()
+    if errors:
+        raise RuntimeError(
+            f"{len(errors)} search agent(s) failed") from errors[0]
+    return best

@@ -159,3 +159,40 @@ def sensitivity(eval_fn, params, pattern=r"conv.*weight",
             m = float(eval_fn(pruned))
             out[name][float(ratio)] = (base - m) / (abs(base) + 1e-12)
     return out
+
+
+def sensitive_prune_ratios(sens, max_loss=0.05):
+    """Per-layer ratios from sensitivity curves (ref
+    SensitivePruneStrategy._get_best_ratios): for each param pick the
+    LARGEST measured ratio whose metric-loss fraction stays within
+    `max_loss` (0.0 when even the smallest ratio exceeds it)."""
+    out = {}
+    for name, curve in sens.items():
+        best = 0.0
+        for ratio in sorted(curve):
+            if curve[ratio] <= max_loss:
+                best = ratio
+        out[name] = best
+    return out
+
+
+def sensitive_prune(eval_fn, params, pattern=r"conv.*weight",
+                    ratios=(0.1, 0.3, 0.5), max_loss=0.05, pruner=None):
+    """Sensitivity-driven structured pruning end-to-end (ref
+    prune_strategy.py SensitivePruneStrategy): measure curves, pick
+    per-layer ratios under the degradation budget, prune each layer at its
+    own ratio. Returns (pruned_params, masks, chosen_ratios)."""
+    pruner = pruner or StructurePruner()
+    sens = sensitivity(eval_fn, params, pattern=pattern, ratios=ratios,
+                       pruner=pruner)
+    chosen = sensitive_prune_ratios(sens, max_loss=max_loss)
+    pruned, masks = params, {}
+    for path, name, leaf in _iter_params(params, pattern):
+        r = chosen.get(name, 0.0)
+        if r <= 0.0:
+            continue
+        pruned, m = prune_tree(pruned, r,
+                               pattern="^" + re.escape(name) + "$",
+                               pruner=pruner)
+        masks.update(m)
+    return pruned, masks, chosen

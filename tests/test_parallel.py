@@ -416,3 +416,47 @@ class TestDistributionPlanner:
         plan = planner.plan(params)
         assert "fsdp" in plan.entries["big"].spec
         assert plan.entries["small"].spec == (None,)
+
+
+class TestRingFlashAttention:
+    """ring_flash_attention: the Pallas flash kernel as the per-block ring
+    engine (interpret mode on the 8-device CPU mesh) must match the dense
+    ring_attention math."""
+
+    def _run(self, fn, q, causal):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        import paddle_tpu as pt
+        mesh = pt.parallel.make_mesh({"sp": 8})
+        f = shard_map(
+            lambda q_, k_, v_: fn(q_, k_, v_, "sp", causal=causal),
+            mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None), check_vma=False)
+        return np.asarray(f(q, q, q))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_ring(self, causal):
+        from paddle_tpu.core.flags import set_flags
+        from paddle_tpu.parallel.ring_attention import (ring_attention,
+                                                        ring_flash_attention)
+        q = jax.random.normal(jax.random.key(0), (1, 2, 8 * 16, 64),
+                              jnp.float32)
+        ref = self._run(ring_attention, q, causal)
+        set_flags({"pallas_interpret": True})
+        try:
+            got = self._run(ring_flash_attention, q, causal)
+        finally:
+            set_flags({"pallas_interpret": False})
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_falls_back_off_tpu(self):
+        # without the interpret flag on CPU the flash ring must silently
+        # route to the dense ring (same numbers)
+        from paddle_tpu.parallel.ring_attention import (ring_attention,
+                                                        ring_flash_attention)
+        q = jax.random.normal(jax.random.key(1), (1, 1, 8 * 8, 64),
+                              jnp.float32)
+        got = self._run(ring_flash_attention, q, True)
+        ref = self._run(ring_attention, q, True)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

@@ -135,6 +135,70 @@ def bench_bert(steps, batch, seq, use_flash=False):
     }
 
 
+def bench_transformer(steps, batch, seq):
+    """Transformer big (WMT en-de config) training step — the seq2seq
+    flagship from BASELINE.md's target table."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.transformer import (Transformer,
+                                               TransformerConfig, nmt_loss)
+
+    cfg = TransformerConfig.big()
+    cfg.dropout = 0.0
+    cfg.max_len = max(cfg.max_len, seq)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.key(0))
+    params = variables["params"]
+
+    policy = pt.amp.bf16_policy()
+    opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(1, cfg.src_vocab, (batch, seq),
+                                  dtype=np.int32))
+    tgt_in = jnp.asarray(rng.randint(1, cfg.tgt_vocab, (batch, seq),
+                                     dtype=np.int32))
+    tgt_out = jnp.asarray(rng.randint(1, cfg.tgt_vocab, (batch, seq),
+                                      dtype=np.int32))
+
+    def loss_fn(p, src, tgt_in, tgt_out):
+        logits = model.apply({"params": p, "state": {}}, src, tgt_in)
+        return nmt_loss(logits, tgt_out), 0.0
+
+    def train_step(params, opt_state, src, tgt_in, tgt_out):
+        loss, params, opt_state, _ = opt.minimize(
+            loss_fn, params, opt_state, src, tgt_in, tgt_out)
+        return loss, params, opt_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    flops_per_step = _cost_flops(jitted, params, opt_state, src, tgt_in,
+                                 tgt_out)
+    loss, params, opt_state = jitted(params, opt_state, src, tgt_in, tgt_out)
+    _ = float(loss)
+
+    st = {"params": params, "opt": opt_state}
+
+    def step_once():
+        loss, st["params"], st["opt"] = jitted(st["params"], st["opt"], src,
+                                               tgt_in, tgt_out)
+        return loss
+
+    dt, loss_v = _timed_steps(step_once, steps)
+    achieved = flops_per_step / dt if flops_per_step else 0.0
+    mfu = achieved / peak_flops()
+    return {
+        "metric": "transformer_big_tokens_per_sec_per_chip",
+        "value": round(batch * seq / dt, 1),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": loss_v,
+        "seq": seq,
+    }
+
+
 def bench_resnet(steps, batch):
     import jax
     import jax.numpy as jnp
@@ -201,6 +265,9 @@ def _run_inner(args):
     if args.model == "bert":
         res = bench_bert(args.steps, args.batch or 64, args.seq,
                          use_flash=args.flash)
+    elif args.model == "transformer_big":
+        res = bench_transformer(args.steps, args.batch or 32,
+                                min(args.seq, 256))
     else:
         res = bench_resnet(args.steps, args.batch or 128)
     res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
@@ -230,7 +297,8 @@ def _probe(timeout_s):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="bert", choices=["bert", "resnet50"])
+    ap.add_argument("--model", default="bert",
+                    choices=["bert", "resnet50", "transformer_big"])
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)

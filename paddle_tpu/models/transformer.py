@@ -130,12 +130,10 @@ def nmt_loss(logits, labels, pad_id=0, label_smoothing=0.1):
     uses label_smooth + softmax_with_cross_entropy soft labels)."""
     vocab = logits.shape[-1]
     valid = (labels != pad_id).astype(jnp.float32)
+    import jax
     smooth_pos = 1.0 - label_smoothing
     smooth_neg = label_smoothing / (vocab - 1)
-    onehot = jnp.full(logits.shape, smooth_neg)
-    onehot = jnp.take_along_axis(
-        onehot, labels[..., None], axis=-1) * 0 + onehot  # keep shape
-    import jax
-    onehot = jax.nn.one_hot(labels, vocab) * (smooth_pos - smooth_neg) + smooth_neg
+    onehot = jax.nn.one_hot(labels, vocab) * (smooth_pos - smooth_neg) \
+        + smooth_neg
     loss = L.softmax_with_cross_entropy(logits, onehot, soft_label=True)[..., 0]
     return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -241,6 +241,7 @@ class TestYolo:
         ref0 = sig(xr[0, 0, 4, 0, 0]) * sig(xr[0, 0, 5:, 0, 0])
         np.testing.assert_allclose(np.asarray(scores)[0, 0], ref0, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_yolov3_loss_finite_and_grad(self):
         rng = np.random.RandomState(9)
         b, cls, h, w = 2, 3, 4, 4

@@ -277,6 +277,7 @@ class TestAddLayerNormFused:
             set_flags({"pallas_interpret": False})
         np.testing.assert_allclose(interp, base, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_bert_layer_uses_fused_path(self):
         # functional check: BERT still trains with the fused residual+LN
         from paddle_tpu.models.bert import BertConfig, BertForPretraining

@@ -311,6 +311,7 @@ class TestDGC:
 
 
 class TestLaunch:
+    @pytest.mark.slow
     def test_multiprocess_allreduce(self, tmp_path):
         """ref: test_dist_base.py subprocess cluster fixture — 2 local
         processes form one jax.distributed job and allreduce."""
@@ -381,6 +382,7 @@ class TestDistributionPlanner:
         assert plan.input_specs[0] == jax.sharding.PartitionSpec(
             "dp", None)
 
+    @pytest.mark.slow
     def test_planned_step_matches_single_device(self):
         """Transpiled-program equivalence: dp x tp planned training equals
         single-device training (parallel_executor_test_base pattern)."""

@@ -43,6 +43,11 @@ class TrainerConfig:
     heartbeat_timeout_s: float = None   # default: dist_heartbeat_timeout_s
     heartbeat_interval_s: float = None  # default: dist_heartbeat_interval_s
     on_peer_stall: callable = None      # (worker, age_s) -> None
+    # checkpoint/resume (ref: the Fluid trainer's save_checkpoint flow,
+    # io.py save_persistables + executor.py train loop integration)
+    checkpoint_dir: str = None     # None = checkpointing off
+    checkpoint_every: int = 0      # steps between saves (0 = off)
+    resume: bool = True            # restore latest checkpoint before start
 
 
 class _EndOfData:
@@ -192,11 +197,28 @@ class Trainer:
         channel (drop_last on the global stream) — per-thread remainders
         are not lost, matching the reference's shared DataFeed channel.
         Without it, readers must yield ready batches."""
+        cfg = self.cfg
+        step = 0
+        ckpt_mgr = None
+        if cfg.checkpoint_dir and cfg.checkpoint_every:
+            from paddle_tpu.io.checkpoint import CheckpointManager
+            ckpt_mgr = CheckpointManager(
+                cfg.checkpoint_dir, save_interval_steps=cfg.checkpoint_every)
+            if cfg.resume:
+                restored, at = ckpt_mgr.restore(state)
+                if restored is not None:
+                    state, step = restored, int(at)
+                    # datasets that support seek(step) continue mid-stream;
+                    # plain generator factories restart from the beginning
+                    # (epoch semantics — the reference trainer's
+                    # save_checkpoint flow restarts epochs the same way)
+                    if hasattr(dataset, "seek"):
+                        dataset.seek(step)
+                    print(f"[trainer] resumed from step {step}")
+        start_step = step
         chan, stop, errors = self._start_ingest(
             self._split_readers(dataset))
         hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
-        cfg = self.cfg
-        step = 0
         t0 = time.perf_counter()
         loss = None
 
@@ -233,6 +255,8 @@ class Trainer:
                     loss, state = self.step_fn(state, *staged)
                 step += 1
                 hb_ping()
+                if ckpt_mgr is not None:
+                    ckpt_mgr.save(step, state)  # manager gates the interval
                 if cfg.log_every and step % cfg.log_every == 0:
                     lv = float(loss)
                     self.history.append((step, lv))
@@ -243,12 +267,16 @@ class Trainer:
         finally:
             stop.set()  # release producers even when step_fn raises
             hb_finish(clean)
+            if ckpt_mgr is not None:
+                ckpt_mgr.close()
+        run_steps = step - start_step
         if errors:
             raise RuntimeError(
-                f"ingestion thread failed after {step} steps") from errors[0]
+                f"ingestion thread failed after {run_steps} steps "
+                f"(total step {step})") from errors[0]
         wall = time.perf_counter() - t0
-        stats = {"steps": step, "wall_s": wall,
-                 "steps_per_s": step / wall if wall > 0 else 0.0,
+        stats = {"steps": step, "run_steps": run_steps, "wall_s": wall,
+                 "steps_per_s": run_steps / wall if wall > 0 else 0.0,
                  "final_loss": float(loss) if loss is not None else None}
         return state, stats
 

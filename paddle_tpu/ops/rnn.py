@@ -150,14 +150,10 @@ def beam_search_decode(log_probs_fn, init_state, bos_id, eos_id, beam_size,
     def step(carry, t):
         tokens, scores, seqs, done, state = carry
         logp, state = log_probs_fn(tokens, state)
-        logp = jnp.where(done[:, None],
-                         jnp.full_like(logp, neg_inf).at[:, eos_id].set(0.0),
-                         logp)
-        cand = scores[:, None] + logp           # [B*K, V]
-        cand = cand.reshape(batch_size, k * vocab_size)
-        top_scores, top_idx = lax.top_k(cand, k)   # [B, K]
-        beam_idx = top_idx // vocab_size           # which parent beam
-        tok_idx = (top_idx % vocab_size).astype(jnp.int32)
+        tok_idx, top_scores, beam_idx = beam_search_step(
+            scores.reshape(batch_size, k),
+            logp.reshape(batch_size, k, vocab_size), k, eos_id=eos_id,
+            done=done.reshape(batch_size, k))
         flat_parent = (jnp.arange(batch_size)[:, None] * k + beam_idx).reshape(-1)
         seqs = seqs.reshape(batch_size * k, max_len)[flat_parent]
         seqs = seqs.reshape(batch_size, k, max_len)
@@ -171,3 +167,36 @@ def beam_search_decode(log_probs_fn, init_state, bos_id, eos_id, beam_size,
     (tokens, scores, seqs, done, _), _ = lax.scan(
         step, carry, jnp.arange(max_len))
     return seqs, scores.reshape(batch_size, k)
+
+
+@register_op("beam_search")
+def beam_search_step(pre_scores, log_probs, beam_size, eos_id=None,
+                     done=None):
+    """ONE beam-search selection step — the reference's `beam_search` op
+    (operators/beam_search_op.cc + math/beam_search.cc), redesigned from
+    its LoD formulation to static shapes; `beam_search_decode` runs this
+    op inside its scan.
+
+    pre_scores: [B, K] cumulative log-probs; log_probs: [B, K, V] raw
+    next-token log-probs. With `done` [B, K], finished beams are masked
+    HERE: they may only extend with `eos_id` at zero cost (so completed
+    hypotheses carry at their current score — eos_id is therefore
+    required alongside done, matching the reference's end_id attr).
+    -> (sel_tokens [B, K] int32, sel_scores [B, K], parent_idx [B, K] int32)
+    — parent_idx indexes the source beam for the backtrace.
+    """
+    from paddle_tpu.core.enforce import enforce
+    b, k, v = log_probs.shape
+    neg_inf = -1e9
+    if done is not None:
+        enforce(eos_id is not None,
+                "beam_search: done beams need eos_id to carry their "
+                "finished hypothesis (the reference's end_id)")
+        keep_eos = jnp.full((v,), neg_inf).at[eos_id].set(0.0)
+        log_probs = jnp.where(done[:, :, None], keep_eos[None, None],
+                              log_probs)
+    cand = (pre_scores[:, :, None] + log_probs).reshape(b, k * v)
+    sel_scores, top_idx = lax.top_k(cand, beam_size)
+    parent = (top_idx // v).astype(jnp.int32)
+    tokens = (top_idx % v).astype(jnp.int32)
+    return tokens, sel_scores, parent

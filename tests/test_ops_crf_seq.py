@@ -254,3 +254,38 @@ class TestNewSequenceOps:
         out = sequence.sequence_erase(rb, [2])
         pooled = np.asarray(sequence.sequence_pool(out, "max"))
         np.testing.assert_allclose(pooled, [3.0, 4.0])
+
+
+class TestBeamSearchStepOp:
+    """The single-step beam_search op (ref operators/beam_search_op.cc):
+    must agree with a numpy argmax-over-candidates reference."""
+
+    def test_selects_global_topk_and_parents(self):
+        from paddle_tpu.ops.rnn import beam_search_step
+        b, k, v = 2, 2, 5
+        rng = np.random.RandomState(0)
+        pre = jnp.asarray(rng.randn(b, k).astype(np.float32))
+        logp = jnp.asarray(rng.randn(b, k, v).astype(np.float32))
+        toks, scores, parent = beam_search_step(pre, logp, k)
+        cand = (np.asarray(pre)[:, :, None] + np.asarray(logp)).reshape(b,
+                                                                        -1)
+        for i in range(b):
+            order = np.argsort(-cand[i])[:k]
+            np.testing.assert_allclose(np.asarray(scores)[i],
+                                       cand[i][order], rtol=1e-6)
+            np.testing.assert_array_equal(np.asarray(parent)[i], order // v)
+            np.testing.assert_array_equal(np.asarray(toks)[i], order % v)
+
+    def test_done_beams_emit_eos_only(self):
+        from paddle_tpu.ops.rnn import beam_search_step
+        pre = jnp.asarray([[0.0, -0.5]])
+        logp = jnp.zeros((1, 2, 4))
+        done = jnp.asarray([[True, False]])
+        toks, scores, parent = beam_search_step(pre, logp, 2, eos_id=3,
+                                                done=done)
+        # the finished beam can only extend with EOS at zero cost
+        got = set(zip(np.asarray(parent)[0].tolist(),
+                      np.asarray(toks)[0].tolist()))
+        for p, t in got:
+            if p == 0:
+                assert t == 3

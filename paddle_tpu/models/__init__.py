@@ -8,7 +8,7 @@
   mnist        smoke-test models                    ref: book recognize_digits
 """
 
-from paddle_tpu.models import (bert, ctr, ernie, mnist, recommender, resnet, sentiment, seq2seq,
+from paddle_tpu.models import (bert, ctr, ernie, gpt, mnist, recommender, resnet, sentiment, seq2seq,
                                tagging, transformer, vision_cls, word2vec)
 from paddle_tpu.models.resnet import ResNet, resnet18, resnet50
 from paddle_tpu.models.seq2seq import AttentionSeq2Seq, Seq2SeqConfig, nmt_loss
@@ -18,6 +18,7 @@ from paddle_tpu.models.vision_cls import VGG, SEResNeXt, se_resnext50, vgg16
 from paddle_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from paddle_tpu.models.transformer import Transformer, TransformerConfig
 from paddle_tpu.models.ctr import CTRConfig, DeepFM, WideAndDeep
+from paddle_tpu.models.gpt import GPT, GPTConfig
 from paddle_tpu.models.word2vec import SkipGramNCE, Word2Vec
 from paddle_tpu.models.mnist import (MLP, ConvNet, LinearRegression,
                                      SoftmaxRegression)

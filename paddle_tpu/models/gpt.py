@@ -1,0 +1,108 @@
+"""Decoder-only causal LM (GPT-style) — the long-context flagship.
+
+Ref: no decoder-only LM exists in the reference (2019-era; its language
+models are word2vec + the NMT transformer, tests/book). This family exists
+because the brief's long-context requirement (BASELINE.json north star)
+needs a first-class consumer: causal flash attention on one chip,
+ring/Ulysses sequence parallelism across chips.
+
+Design: pre-norm transformer decoder; attention runs
+  * `flash_attention(causal=True)` (Pallas, O(T) memory) on a single chip
+  * `ring_flash_attention` over the `sp` mesh axis when `seq_axis` is set
+    (call inside shard_map with the sequence dim sharded)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import loss as L
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 2048
+    dropout: float = 0.1
+    use_flash: bool = True
+    seq_axis: str = None       # mesh axis name for ring sequence parallelism
+
+    @staticmethod
+    def small():
+        return GPTConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position=128)
+
+
+class GPTBlock(nn.Module):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        # the shared fused-MHA layer (one implementation across BERT /
+        # Transformer / GPT); the ring sequence-parallel branch is selected
+        # per-call via seq_axis
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size, cfg.num_heads,
+                                          dropout=cfg.dropout,
+                                          use_flash=cfg.use_flash)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        # pre-norm residual blocks (GPT-2 style)
+        x = x + self.drop(self.attn(self.ln1(x), causal=True,
+                                    seq_axis=self.cfg.seq_axis))
+        x = x + self.drop(self.fc2(A.gelu(self.fc1(self.ln2(x)))))
+        return x
+
+
+class GPT(nn.Module):
+    """Causal LM: returns next-token logits [B, T, V] (weight-tied head)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.pos_emb = nn.Embedding(cfg.max_position, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, pos_offset=0):
+        b, t = input_ids.shape
+        if self.cfg.seq_axis is not None:
+            # under shard_map the leading tokens of this shard sit at
+            # global position rank * t_local
+            from jax import lax
+            pos_offset = pos_offset + lax.axis_index(
+                self.cfg.seq_axis) * t
+        pos = pos_offset + jnp.arange(t)[None, :]
+        x = self.drop(self.tok_emb(input_ids) + self.pos_emb(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        return x @ self.tok_emb.p("weight").T
+
+
+def lm_loss(logits, labels, pad_id=None):
+    """Shifted next-token cross entropy; optionally ignores pad positions."""
+    lp = logits[:, :-1]
+    tgt = labels[:, 1:]
+    ce = L.softmax_with_cross_entropy(lp, tgt[..., None])[..., 0]
+    if pad_id is not None:
+        valid = (tgt != pad_id).astype(ce.dtype)
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.mean(ce)

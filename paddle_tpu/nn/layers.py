@@ -438,7 +438,7 @@ class MultiHeadAttention(Module):
             if bias:
                 self.param(f"b{n}", (embed_dim,), I.zeros(), dtype)
 
-    def forward(self, x, kv=None, mask=None, causal=False):
+    def forward(self, x, kv=None, mask=None, causal=False, seq_axis=None):
         from paddle_tpu.ops.attention import multihead_attention
         key = self.rng("dropout") if (self.training and self.dropout_rate > 0) \
             else None
@@ -450,7 +450,7 @@ class MultiHeadAttention(Module):
             self.p("bo") if self.has_bias else None,
             num_heads=self.num_heads, mask=mask, causal=causal, kv=kv,
             dropout_rate=self.dropout_rate if self.training else 0.0,
-            dropout_key=key, use_flash=self.use_flash)
+            dropout_key=key, use_flash=self.use_flash, seq_axis=seq_axis)
 
 
 class FC(Linear):

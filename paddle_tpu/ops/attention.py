@@ -84,7 +84,8 @@ def _as_key_padding_mask(mask, batch, tk):
 @register_op("multihead_attention")
 def multihead_attention(x, wq, wk, wv, wo, bq=None, bk=None, bv=None, bo=None,
                         num_heads=8, mask=None, causal=False, kv=None,
-                        dropout_rate=0.0, dropout_key=None, use_flash=False):
+                        dropout_rate=0.0, dropout_key=None, use_flash=False,
+                        seq_axis=None):
     """Full fused MHA forward (ref: ir/multihead_matmul_fuse_pass.h — the
     reference *fuses* q/k/v matmuls post-hoc; we write it fused from the
     start). x: [B, T, E]; w*: [E, E]."""
@@ -101,11 +102,23 @@ def multihead_attention(x, wq, wk, wv, wo, bq=None, bk=None, bv=None, bo=None,
     q = proj(x, wq, bq)
     k = proj(kv, wk, bk)
     v = proj(kv, wv, bv)
+    no_dropout = dropout_rate == 0.0 or dropout_key is None
+    if seq_axis is not None:
+        # sequence sharded over a mesh axis: ring attention (flash-backed
+        # on TPU). Per-device positions are contiguous so block-granular
+        # causality is exact. Masks/dropout are not supported here.
+        from paddle_tpu.core.enforce import enforce
+        enforce(mask is None and no_dropout,
+                "seq_axis attention supports no mask/attention-dropout")
+        from paddle_tpu.parallel.ring_attention import ring_flash_attention
+        ctx = ring_flash_attention(q, k, v, seq_axis, causal=causal)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, t, e)
+        out = ctx @ wo
+        return out + bo if bo is not None else out
     # flash path handles key-padding masks ([B,1,1,Tk]-style) natively;
     # only an arbitrary per-query mask or attention dropout falls back to
     # the XLA path
     kv_mask = _as_key_padding_mask(mask, b, k.shape[2])
-    no_dropout = dropout_rate == 0.0 or dropout_key is None
     if use_flash and (mask is None or kv_mask is not None) and no_dropout:
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
         ctx = flash_attention(q, k, v, causal=causal, kv_mask=kv_mask)

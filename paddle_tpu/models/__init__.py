@@ -18,7 +18,7 @@ from paddle_tpu.models.vision_cls import VGG, SEResNeXt, se_resnext50, vgg16
 from paddle_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
 from paddle_tpu.models.transformer import Transformer, TransformerConfig
 from paddle_tpu.models.ctr import CTRConfig, DeepFM, WideAndDeep
-from paddle_tpu.models.gpt import GPT, GPTConfig
+from paddle_tpu.models.gpt import GPT, GPTConfig, GPTDecoder
 from paddle_tpu.models.word2vec import SkipGramNCE, Word2Vec
 from paddle_tpu.models.mnist import (MLP, ConvNet, LinearRegression,
                                      SoftmaxRegression)

@@ -68,6 +68,14 @@ class GPTBlock(nn.Module):
         x = x + self.drop(self.fc2(A.gelu(self.fc1(self.ln2(x)))))
         return x
 
+    def decode_step(self, x, cache, pos):
+        """Incremental twin of forward: same pre-norm residual structure,
+        attention through the KV cache (dropout is inference-off)."""
+        h, cache = self.attn.decode_step(self.ln1(x), cache, pos)
+        x = x + h
+        x = x + self.fc2(A.gelu(self.fc1(self.ln2(x))))
+        return x, cache
+
 
 class GPT(nn.Module):
     """Causal LM: returns next-token logits [B, T, V] (weight-tied head)."""
@@ -106,3 +114,87 @@ def lm_loss(logits, labels, pad_id=None):
         valid = (tgt != pad_id).astype(ce.dtype)
         return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)
     return jnp.mean(ce)
+
+
+def _gpt_decode_step(model, token, caches, pos):
+    """One incremental forward through all blocks with KV caches.
+    token: [B, 1] int32 (lookup_table's Paddle trailing-1 squeeze is
+    undone with an explicit reshape)."""
+    b = token.shape[0]
+    e = model.cfg.hidden_size
+    x = (model.tok_emb(token)
+         + model.pos_emb(jnp.full(token.shape, pos, jnp.int32))
+         ).reshape(b, 1, e)
+    new_caches = []
+    for blk, cache in zip(model.blocks, caches):
+        x, cache = blk.decode_step(x, cache, pos)
+        new_caches.append(cache)
+    x = model.ln_f(x)
+    return x @ model.tok_emb.p("weight").T, new_caches
+
+
+class GPTDecoder(GPT):
+    """GPT + incremental decoding: KV caches make each generated token an
+    O(1)-projection step (no full-sequence recompute). No reference
+    counterpart — Fluid's decoders re-ran the network per step via the
+    beam_search op loop."""
+
+    def init_caches(self, batch, max_len, dtype=jnp.float32):
+        from paddle_tpu.core.enforce import enforce
+        enforce(self.cfg.seq_axis is None,
+                "GPTDecoder decoding needs an unsharded sequence "
+                "(seq_axis must be None); gather the sequence before "
+                "decoding")
+        return [blk.attn.init_cache(batch, max_len, dtype)
+                for blk in self.blocks]
+
+    def decode_step(self, token, caches, pos):
+        """token: [B, 1] int32; pos: scalar. -> (logits [B, 1, V], caches)."""
+        return _gpt_decode_step(self, token, caches, pos)
+
+    def generate(self, prompt, max_new, temperature=0.0, key=None):
+        """Greedy (temperature=0) or sampled generation. prompt: [B, Tp].
+        Returns [B, Tp + max_new] (prompt prefix included)."""
+        from jax import lax
+
+        from paddle_tpu.core.enforce import enforce
+        enforce(temperature <= 0.0 or key is not None,
+                "sampled generation (temperature > 0) requires a PRNG key")
+        b, tp = prompt.shape
+        total = tp + max_new
+        assert total <= self.cfg.max_position, (total,
+                                                self.cfg.max_position)
+        caches = self.init_caches(b, total)
+
+        # prefill: feed prompt tokens one by one, carrying only the LAST
+        # logits (stacking per-position [B, 1, V] outputs would
+        # materialize Tp*B*V dead floats on the long-context path)
+        def prefill(carry, t):
+            caches, _ = carry
+            logits, caches = _gpt_decode_step(
+                self, lax.dynamic_slice(prompt, (0, t), (b, 1)), caches, t)
+            return (caches, logits), None
+
+        zero_logits = jnp.zeros((b, 1, self.cfg.vocab_size), jnp.float32)
+        (caches, last_logits), _ = lax.scan(
+            prefill, (caches, zero_logits), jnp.arange(tp))
+
+        def sample(logits, k):
+            if temperature <= 0.0:
+                return jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits[:, 0] / temperature, -1).astype(jnp.int32)
+
+        keys = (jax.random.split(key, max_new) if key is not None
+                else jnp.zeros((max_new, 2), jnp.uint32))
+
+        def step(carry, inp):
+            caches, last_logits = carry
+            t, k = inp
+            tok = sample(last_logits, k)[:, None]        # [B, 1]
+            logits, caches = _gpt_decode_step(self, tok, caches, tp + t)
+            return (caches, logits), tok[:, 0]
+
+        (_, _), new_toks = lax.scan(
+            step, (caches, last_logits), (jnp.arange(max_new), keys))
+        return jnp.concatenate([prompt, new_toks.T.astype(prompt.dtype)], 1)

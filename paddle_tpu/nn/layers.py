@@ -7,6 +7,7 @@ BilinearTensorProduct, Conv2DTranspose, SequenceConv, GroupNorm,
 SpectralNorm, TreeConv) and python/paddle/fluid/layers/nn.py.
 """
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu import initializer as I
@@ -451,6 +452,52 @@ class MultiHeadAttention(Module):
             num_heads=self.num_heads, mask=mask, causal=causal, kv=kv,
             dropout_rate=self.dropout_rate if self.training else 0.0,
             dropout_key=key, use_flash=self.use_flash, seq_axis=seq_axis)
+
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        """KV cache for incremental decoding: {k, v} [B, H, Tmax, hd]."""
+        e = self.p("wq").shape[0]
+        hd = e // self.num_heads
+        shape = (batch, self.num_heads, max_len, hd)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def decode_step(self, x_t, cache, pos, causal=True):
+        """One incremental step: project the new token(s), write K/V into
+        the cache at `pos`, attend over positions <= pos. x_t: [B, 1, E];
+        pos: scalar int (dynamic ok). Returns (out [B, 1, E], new_cache).
+
+        O(1) projection per step — the full-sequence K/V projections are
+        never recomputed (the KV-cache serving pattern; no reference
+        counterpart: Fluid decoded via beam_search ops re-running the
+        whole decoder per step)."""
+        from jax import lax as _lax
+        b, one, e = x_t.shape
+        hd = e // self.num_heads
+
+        def proj(n):
+            w = self.p(f"w{n}")
+            out = x_t @ w
+            if self.has_bias:
+                out = out + self.p(f"b{n}")
+            return out.reshape(b, 1, self.num_heads, hd).transpose(
+                0, 2, 1, 3)                            # [B, H, 1, hd]
+
+        q = proj("q")
+        k_t = proj("k").astype(cache["k"].dtype)
+        v_t = proj("v").astype(cache["v"].dtype)
+        k = _lax.dynamic_update_slice(cache["k"], k_t, (0, 0, pos, 0))
+        v = _lax.dynamic_update_slice(cache["v"], v_t, (0, 0, pos, 0))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (hd ** 0.5)
+        if causal:
+            valid = jnp.arange(k.shape[2]) <= pos      # [Tmax]
+            scores = jnp.where(valid[None, None, None, :], scores, -1e9)
+        probs = jnp.exp(scores - jax.nn.logsumexp(
+            scores, axis=-1, keepdims=True))
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, e)
+        out = ctx @ self.p("wo")
+        if self.has_bias:
+            out = out + self.p("bo")
+        return out, {"k": k, "v": v}
 
 
 class FC(Linear):

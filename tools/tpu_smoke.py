@@ -180,6 +180,23 @@ def main():
     check("conv_vjp_dx", _maxdiff(gxc, rxc), 5e-2)
     check("conv_vjp_dw", _maxdiff(gwc, rwc), 5e-2)
 
+    # ---- 4b. maxpool custom VJP (argmax scatter vs SelectAndScatter) ---
+    xm = jnp.asarray(rng.randn(32, 112, 112, 64).astype(np.float32))
+
+
+    def mp_loss(x_):
+        return jnp.sum(F.pool2d(x_, 3, "max", 2, padding=1,
+                                data_format="NHWC") ** 2)
+
+    set_flags({"maxpool_custom_vjp": True})
+    try:
+        mp_cv = jax.jit(jax.grad(mp_loss))(xm)
+        mp_cv.block_until_ready()
+    finally:
+        set_flags({"maxpool_custom_vjp": False})
+    mp_ref = jax.jit(jax.grad(mp_loss))(xm)
+    check("maxpool_vjp_dx", _maxdiff(mp_cv, mp_ref), 1e-3)
+
     # ---- 5. micro-timings ---------------------------------------------
     if not args.quick:
         def timeit(f, *a, n=20):
@@ -215,13 +232,23 @@ def main():
         t_fl = timeit(fl, q, k, v)
         t_ch = timeit(ch, q, k, v)
         t_flb = timeit(jax.jit(fl_bwd), q, k, v)
+        set_flags({"maxpool_custom_vjp": True})
+        try:
+            t_mp_cv = timeit(jax.jit(jax.grad(mp_loss)), xm)
+        finally:
+            set_flags({"maxpool_custom_vjp": False})
+        t_mp_ref = timeit(jax.jit(jax.grad(mp_loss)), xm)
         results["timing_ms"] = {
             "flash_fwd": round(t_fl * 1e3, 3),
             "chunked_fwd": round(t_ch * 1e3, 3),
             "flash_fwd_bwd": round(t_flb * 1e3, 3),
+            "maxpool_grad_scatter": round(t_mp_cv * 1e3, 3),
+            "maxpool_grad_selscatter": round(t_mp_ref * 1e3, 3),
         }
         print(f"timing b8 h12 t512 d64: flash {t_fl*1e3:.3f} ms, "
-              f"chunked {t_ch*1e3:.3f} ms, flash f+b {t_flb*1e3:.3f} ms",
+              f"chunked {t_ch*1e3:.3f} ms, flash f+b {t_flb*1e3:.3f} ms; "
+              f"maxpool-grad scatter {t_mp_cv*1e3:.3f} ms vs "
+              f"sel-scatter {t_mp_ref*1e3:.3f} ms",
               flush=True)
 
     print(json.dumps({"ok": not failed, "failed": failed,

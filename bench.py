@@ -199,6 +199,63 @@ def bench_transformer(steps, batch, seq):
     }
 
 
+def bench_gpt(steps, batch, seq):
+    """GPT-small causal-LM training step (long-context flagship; flash
+    causal attention default-on)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.gpt import GPT, GPTConfig, lm_loss
+
+    cfg = GPTConfig.small()
+    cfg.dropout = 0.0
+    cfg.max_position = max(cfg.max_position, seq)
+    model = GPT(cfg)
+    variables = model.init(jax.random.key(0))
+    params = variables["params"]
+
+    policy = pt.amp.bf16_policy()
+    opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq),
+                                  dtype=np.int32))
+
+    def loss_fn(p, ids):
+        logits = model.apply({"params": p, "state": {}}, ids)
+        return lm_loss(logits, ids), 0.0
+
+    def train_step(params, opt_state, ids):
+        loss, params, opt_state, _ = opt.minimize(
+            loss_fn, params, opt_state, ids)
+        return loss, params, opt_state
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    flops_per_step = _cost_flops(jitted, params, opt_state, ids)
+    loss, params, opt_state = jitted(params, opt_state, ids)
+    _ = float(loss)
+
+    st = {"params": params, "opt": opt_state}
+
+    def step_once():
+        loss, st["params"], st["opt"] = jitted(st["params"], st["opt"], ids)
+        return loss
+
+    dt, loss_v = _timed_steps(step_once, steps)
+    achieved = flops_per_step / dt if flops_per_step else 0.0
+    mfu = achieved / peak_flops()
+    return {
+        "metric": "gpt_small_tokens_per_sec_per_chip",
+        "value": round(batch * seq / dt, 1),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": loss_v,
+        "seq": seq,
+    }
+
+
 def bench_resnet(steps, batch):
     import jax
     import jax.numpy as jnp
@@ -268,6 +325,8 @@ def _run_inner(args):
     elif args.model == "transformer_big":
         res = bench_transformer(args.steps, args.batch or 32,
                                 min(args.seq, 256))
+    elif args.model == "gpt":
+        res = bench_gpt(args.steps, args.batch or 16, args.seq)
     else:
         res = bench_resnet(args.steps, args.batch or 128)
     res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
@@ -298,7 +357,7 @@ def _probe(timeout_s):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="bert",
-                    choices=["bert", "resnet50", "transformer_big"])
+                    choices=["bert", "resnet50", "transformer_big", "gpt"])
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)

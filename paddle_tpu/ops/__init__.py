@@ -46,9 +46,12 @@ from paddle_tpu.ops.tensor_ops import *  # noqa: F401,F403
 from paddle_tpu.ops.nn import *  # noqa: F401,F403
 from paddle_tpu.ops.loss import *  # noqa: F401,F403
 from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY
+from paddle_tpu.ops import fused  # noqa: F401
+from paddle_tpu.ops.fused import register_fused_aliases as _rfa
 from paddle_tpu.ops.tail import register_reference_aliases as _rra
 
 _rra()
+_rfa()
 del _rra
 
 

@@ -1,0 +1,235 @@
+"""Fused-op surface (ref: /root/reference/paddle/fluid/operators/fused/).
+
+The reference hand-wrote these CPU/CUDA fusion kernels because its executor
+ran one op at a time; on TPU, XLA's fusion pass composes the same chains
+automatically, so each op here is the *mathematical composition* expressed
+in one call — same name, same semantics, compiler-owned fusion. (The truly
+bandwidth-bound cases that XLA cannot fuse — flash attention, fused
+layer-norm — live in ops/pallas/ as real kernels instead.)
+
+Sequence-typed inputs use the framework's padded-batch + lengths
+convention (core/ragged.py) rather than LoD.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY, register_op
+from paddle_tpu.ops import activations as A
+
+
+def _act(name, x):
+    if name in (None, "", "identity"):
+        return x
+    return getattr(A, name)(x)
+
+
+@register_op("fused_elemwise_activation")
+def fused_elemwise_activation(x, y, functor_list=("elementwise_add", "relu"),
+                              scale=1.0):
+    """ref fused/fused_elemwise_activation_op.{cc,h} — exact reference
+    composition rules:
+      [binary, unary]  ->  Binary(X, Unary(Y))   e.g. add,relu = x+relu(y)
+      [unary, binary]  ->  Unary(Binary(X, Y))   e.g. relu,add = relu(x+y)
+    Unaries: relu, scale (with the `scale` attr), per the reference's
+    supported functor pairs."""
+    from paddle_tpu.core.enforce import enforce
+    binary_fns = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply}
+
+    def unary(name, t):
+        if name == "relu":
+            return jnp.maximum(t, 0.0)
+        if name == "scale":
+            return t * scale
+        enforce(False, f"unsupported unary functor '{name}' "
+                       "(reference supports relu, scale)")
+
+    f0, f1 = functor_list
+    if f0 in binary_fns:
+        return binary_fns[f0](x, unary(f1, y))    # Binary(X, Unary(Y))
+    enforce(f1 in binary_fns,
+            f"functor_list {functor_list} has no binary functor")
+    return unary(f0, binary_fns[f1](x, y))        # Unary(Binary(X, Y))
+
+
+@register_op("fused_embedding_seq_pool")
+def fused_embedding_seq_pool(table, ids, lengths=None, combiner="sum"):
+    """ref fused/fused_embedding_seq_pool_op.cc — lookup + per-sequence sum
+    pool. ids: [B, T] padded; lengths: [B] valid counts."""
+    emb = jnp.take(table, ids, axis=0)                  # [B, T, D]
+    if lengths is not None:
+        mask = (jnp.arange(ids.shape[1])[None, :]
+                < lengths[:, None]).astype(emb.dtype)
+        emb = emb * mask[..., None]
+    out = jnp.sum(emb, axis=1)
+    if combiner == "mean":
+        n = (jnp.maximum(lengths, 1)[:, None].astype(out.dtype)
+             if lengths is not None else float(ids.shape[1]))
+        out = out / n
+    return out
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def fused_fc_elementwise_layernorm(x, w, y, bias=None, scale=None,
+                                   shift=None, epsilon=1e-5):
+    """ref fused/fused_fc_elementwise_layernorm_op.cc —
+    layer_norm(fc(x, w) + y)."""
+    h = x @ w
+    if bias is not None:
+        h = h + bias
+    h = h + y
+    m = jnp.mean(h, -1, keepdims=True)
+    v = jnp.var(h, -1, keepdims=True)
+    out = (h - m) * jax.lax.rsqrt(v + epsilon)
+    if scale is not None:
+        out = out * scale
+    if shift is not None:
+        out = out + shift
+    return out
+
+
+@register_op("fusion_repeated_fc_relu")
+def fusion_repeated_fc_relu(x, weights, biases):
+    """ref fused/fusion_repeated_fc_relu_op.cc — a chain of fc+relu."""
+    h = x
+    for w, b in zip(weights, biases):
+        h = jnp.maximum(h @ w + b, 0.0)
+    return h
+
+
+@register_op("fusion_squared_mat_sub")
+def fusion_squared_mat_sub(x, y, scalar=1.0):
+    """ref fused/fusion_squared_mat_sub_op.cc —
+    ((x @ y)^2 - (x^2 @ y^2)) * scalar (the FM interaction trick)."""
+    xy = x @ y
+    return (xy * xy - (x * x) @ (y * y)) * scalar
+
+
+@register_op("fusion_transpose_flatten_concat")
+def fusion_transpose_flatten_concat(inputs, trans_axis, flatten_axis,
+                                    concat_axis=0):
+    """ref fused/fusion_transpose_flatten_concat_op.cc — per-input
+    transpose -> flatten-from-axis -> concat."""
+    outs = []
+    for t in inputs:
+        t = jnp.transpose(t, trans_axis)
+        lead = 1
+        for d in t.shape[:flatten_axis]:
+            lead *= int(d)
+        outs.append(t.reshape(lead, -1))
+    return jnp.concatenate(outs, axis=concat_axis)
+
+
+@register_op("fusion_seqpool_concat")
+def fusion_seqpool_concat(inputs, lengths=None, pooltype="SUM"):
+    """ref fused/fusion_seqpool_concat_op.cc — seq-pool each input then
+    concat along features. inputs: list of [B, T, D] padded;
+    pooltype: SUM | AVERAGE | SQRT (sum / sqrt(len), the reference's
+    sequence_pool modes)."""
+    pooled = []
+    for x in inputs:
+        n = (jnp.maximum(lengths, 1)[:, None].astype(x.dtype)
+             if lengths is not None else float(x.shape[1]))
+        if lengths is not None:
+            mask = (jnp.arange(x.shape[1])[None, :]
+                    < lengths[:, None]).astype(x.dtype)
+            x = x * mask[..., None]
+        s = jnp.sum(x, axis=1)
+        if pooltype == "AVERAGE":
+            s = s / n
+        elif pooltype == "SQRT":
+            s = s / jnp.sqrt(n)
+        pooled.append(s)
+    return jnp.concatenate(pooled, axis=-1)
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def fusion_seqpool_cvm_concat(inputs, lengths=None, use_cvm=True,
+                              pooltype="SUM"):
+    """ref fused/fusion_seqpool_cvm_concat_op.cc — seq-pool + CVM transform
+    + concat (the Baidu CTR ingest chain)."""
+    from paddle_tpu.ops.tail import continuous_value_model
+    outs = []
+    for x in inputs:
+        s = fusion_seqpool_concat([x], lengths, pooltype=pooltype)
+        outs.append(continuous_value_model(s, use_cvm=use_cvm))
+    return jnp.concatenate(outs, axis=-1)
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def fusion_seqexpand_concat_fc(seq_input, static_inputs, w, bias=None,
+                               act="relu"):
+    """ref fused/fusion_seqexpand_concat_fc_op.cc — broadcast per-batch
+    static features along the sequence, concat with the sequence input,
+    one fc + activation. seq_input: [B, T, D0]; static: list of [B, Di]."""
+    b, t, _ = seq_input.shape
+    parts = [seq_input] + [jnp.broadcast_to(s[:, None, :], (b, t, s.shape[-1]))
+                           for s in static_inputs]
+    h = jnp.concatenate(parts, axis=-1) @ w
+    if bias is not None:
+        h = h + bias
+    return _act(act, h)
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def fusion_seqconv_eltadd_relu(x, w, b, context_length, context_start=None,
+                               lengths=None):
+    """ref fused/fusion_seqconv_eltadd_relu_op.cc —
+    relu(sequence_conv(x) + b). x: [B, T, D] padded; w:
+    [context_length*D, out]; same window math as ops.sequence.sequence_conv
+    (which takes a RaggedBatch)."""
+    start = (-((context_length - 1) // 2) if context_start is None
+             else context_start)
+    B, T, D = x.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    xm = jnp.where(mask[..., None], x, 0.0)
+    cols = []
+    for k in range(context_length):
+        off = start + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        pos = jnp.arange(T) + off
+        valid = (pos >= 0)[None, :] & (pos[None, :] < lengths[:, None])
+        cols.append(jnp.where(valid[..., None], shifted, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)
+    return jnp.maximum(ctx @ w + b, 0.0)
+
+
+@register_op("conv_fusion")
+def conv_fusion(x, weight, bias=None, residual=None, stride=1, padding=0,
+                dilation=1, groups=1, activation="relu",
+                data_format="NCHW"):
+    """ref fused/conv_fusion_op.cc (cudnnConvolutionBiasActivationForward):
+    activation(conv(x, w) + bias + residual)."""
+    from paddle_tpu.ops.nn import conv2d
+    out = conv2d(x, weight, bias, stride, padding, dilation, groups,
+                 data_format=data_format)
+    if residual is not None:
+        out = out + residual
+    return _act(None if activation == "identity" else activation, out)
+
+
+@register_op("fused_embedding_fc_lstm")
+def fused_embedding_fc_lstm(ids, embeddings, h0, c0, w_hh, bias=None,
+                            lengths=None):
+    """ref fused/fused_embedding_fc_lstm_op.cc — the embedding lookup and
+    the LSTM input projection are pre-fused: `embeddings` is the table
+    ALREADY multiplied by the input weight ([V, 4H], the op's rearranged
+    WeightX@Embeddings input), so the lookup IS the x-projection."""
+    from paddle_tpu.ops.rnn import lstm
+    xproj = jnp.take(embeddings, ids, axis=0)          # [B, T, 4H]
+    ident = jnp.eye(xproj.shape[-1], dtype=xproj.dtype)
+    return lstm(xproj, h0, c0, ident, w_hh, b=bias, lengths=lengths)
+
+
+def register_fused_aliases():
+    """Name aliases for fused ops whose base op already covers the fused
+    semantics exactly (the hand-fused CPU kernels of the same math)."""
+    from paddle_tpu.ops.tail import _alias
+    for name, target in (
+            ("fusion_gru", "gru"),
+            ("fusion_lstm", "lstm"),
+            ("fusion_conv_inception", "conv_fusion"),
+            ("multihead_matmul", "multihead_attention")):
+        _alias(name, target)

@@ -524,3 +524,139 @@ class TestOpTailR3:
                       pooled_height=2, pooled_width=2, no_trans=True,
                       sample_per_part=2)
         assert out.shape == (1, 1, 2, 2)
+
+
+class TestFusedOps:
+    """The fused/ surface (ref operators/fused/): compositions XLA fuses;
+    each must match its unfused chain exactly."""
+
+    def test_fused_elemwise_activation_reference_orderings(self):
+        # ref fused_elemwise_activation_op.h: "elementwise_add,relu" =
+        # Binary(X, Unary(Y)) = x + relu(y); "relu,elementwise_add" =
+        # Unary(Binary(X, Y)) = relu(x + y)
+        rng = np.random.RandomState(0)
+        x = np.asarray(rng.randn(4, 8), np.float32)
+        y = np.asarray(rng.randn(4, 8), np.float32)
+        from paddle_tpu.ops.fused import fused_elemwise_activation
+        np.testing.assert_allclose(
+            np.asarray(fused_elemwise_activation(
+                jnp.asarray(x), jnp.asarray(y),
+                ("elementwise_add", "relu"))),
+            x + np.maximum(y, 0.0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(fused_elemwise_activation(
+                jnp.asarray(x), jnp.asarray(y),
+                ("relu", "elementwise_add"))),
+            np.maximum(x + y, 0.0), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(fused_elemwise_activation(
+                jnp.asarray(x), jnp.asarray(y),
+                ("elementwise_add", "scale"), scale=3.0)),
+            x + 3.0 * y, rtol=1e-6)
+
+    def test_conv_fusion_and_embedding_fc_lstm(self):
+        from paddle_tpu.ops.fused import (conv_fusion,
+                                          fused_embedding_fc_lstm)
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(1, 2, 6, 6).astype(np.float32))
+        w = jnp.asarray(rng.randn(3, 2, 3, 3).astype(np.float32) * 0.2)
+        res = jnp.asarray(rng.randn(1, 3, 6, 6).astype(np.float32))
+        from paddle_tpu.ops.nn import conv2d
+        ref = np.maximum(np.asarray(conv2d(x, w, padding=1))
+                         + np.asarray(res), 0.0)
+        got = np.asarray(conv_fusion(x, w, residual=res, padding=1))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # fused embedding-fc-lstm == lstm over looked-up projections
+        V, H, B, T = 10, 3, 2, 4
+        emb = jnp.asarray(rng.randn(V, 4 * H).astype(np.float32) * 0.3)
+        ids = jnp.asarray(rng.randint(0, V, (B, T)))
+        h0 = jnp.zeros((B, H)); c0 = jnp.zeros((B, H))
+        w_hh = jnp.asarray(rng.randn(H, 4 * H).astype(np.float32) * 0.3)
+        out, (h, c) = fused_embedding_fc_lstm(ids, emb, h0, c0, w_hh)
+        from paddle_tpu.ops.rnn import lstm
+        xp = jnp.take(emb, ids, axis=0)
+        ref_out, _ = lstm(xp, h0, c0, jnp.eye(4 * H), w_hh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_embedding_seq_pool(self):
+        from paddle_tpu.ops.fused import fused_embedding_seq_pool
+        table = jnp.asarray(np.arange(20, dtype=np.float32).reshape(10, 2))
+        ids = jnp.asarray([[1, 2, 0], [3, 0, 0]])
+        lengths = jnp.asarray([2, 1])
+        out = np.asarray(fused_embedding_seq_pool(table, ids, lengths))
+        t = np.asarray(table)
+        np.testing.assert_allclose(out, [t[1] + t[2], t[3]], rtol=1e-6)
+
+    def test_fused_fc_elementwise_layernorm(self):
+        from paddle_tpu.ops.fused import fused_fc_elementwise_layernorm
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+        w = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+        y = jnp.asarray(rng.randn(3, 6).astype(np.float32))
+        out = np.asarray(fused_fc_elementwise_layernorm(x, w, y))
+        h = np.asarray(x) @ np.asarray(w) + np.asarray(y)
+        ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+            h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fusion_squared_mat_sub(self):
+        from paddle_tpu.ops.fused import fusion_squared_mat_sub
+        rng = np.random.RandomState(2)
+        x = np.asarray(rng.randn(3, 4), np.float32)
+        y = np.asarray(rng.randn(4, 5), np.float32)
+        out = np.asarray(fusion_squared_mat_sub(jnp.asarray(x),
+                                                jnp.asarray(y), 2.0))
+        ref = ((x @ y) ** 2 - (x * x) @ (y * y)) * 2.0
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fusion_repeated_fc_relu_and_seqpool_concat(self):
+        from paddle_tpu.ops.fused import (fusion_repeated_fc_relu,
+                                          fusion_seqpool_concat)
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+        ws = [jnp.asarray(rng.randn(4, 4).astype(np.float32)),
+              jnp.asarray(rng.randn(4, 3).astype(np.float32))]
+        bs = [jnp.zeros((4,)), jnp.zeros((3,))]
+        out = np.asarray(fusion_repeated_fc_relu(x, ws, bs))
+        ref = np.maximum(
+            np.maximum(np.asarray(x) @ np.asarray(ws[0]), 0)
+            @ np.asarray(ws[1]), 0)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        a = jnp.asarray(rng.randn(2, 3, 2).astype(np.float32))
+        lens = jnp.asarray([3, 1])
+        got = np.asarray(fusion_seqpool_concat([a, a], lens))
+        am = np.asarray(a).copy()
+        am[1, 1:] = 0
+        ref2 = np.concatenate([am.sum(1), am.sum(1)], -1)
+        np.testing.assert_allclose(got, ref2, rtol=1e-6)
+
+    def test_fusion_seqconv_eltadd_relu_matches_sequence_conv(self):
+        from paddle_tpu.core.ragged import RaggedBatch
+        from paddle_tpu.ops.fused import fusion_seqconv_eltadd_relu
+        from paddle_tpu.ops.sequence import sequence_conv
+        rng = np.random.RandomState(4)
+        B, T, D, O, CL = 2, 5, 3, 4, 3
+        x = rng.randn(B, T, D).astype(np.float32)
+        lens = np.array([5, 3])
+        w = jnp.asarray(rng.randn(CL * D, O).astype(np.float32))
+        b = jnp.asarray(rng.randn(O).astype(np.float32))
+        got = np.asarray(fusion_seqconv_eltadd_relu(
+            jnp.asarray(x), w, b, CL, lengths=jnp.asarray(lens)))
+        rb = RaggedBatch.from_padded(jnp.asarray(x), jnp.asarray(lens))
+        ref_rb = sequence_conv(rb, w, context_start=-1, context_length=CL)
+        ref, _ = ref_rb.to_padded(T)
+        ref = np.maximum(np.asarray(ref) + np.asarray(b), 0.0)
+        mask = (np.arange(T)[None, :] < lens[:, None])
+        np.testing.assert_allclose(got * mask[..., None],
+                                   ref * mask[..., None],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_aliases_registered(self):
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        for n in ("fusion_gru", "fusion_lstm", "conv_fusion",
+                  "multihead_matmul", "fused_elemwise_activation",
+                  "fused_embedding_fc_lstm", "fusion_conv_inception",
+                  "fusion_seqpool_cvm_concat", "fusion_seqexpand_concat_fc",
+                  "fusion_transpose_flatten_concat"):
+            assert n in R, n

@@ -39,6 +39,7 @@ from paddle_tpu.parallel.heartbeat import (FileHeartbeat, HeartBeatMonitor,
 from paddle_tpu.parallel.mesh import (
     DP, EP, FSDP, PP, SP, TP,
     data_parallel_mesh,
+    make_hybrid_mesh,
     make_mesh,
     named_sharding,
     replicated,

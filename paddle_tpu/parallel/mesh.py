@@ -44,6 +44,59 @@ def data_parallel_mesh(devices=None):
     return make_mesh({DP: -1}, devices)
 
 
+def make_hybrid_mesh(ici_axes, dcn_axes, devices=None):
+    """Multi-slice mesh: DCN-connected slices on the OUTER axes, ICI
+    within a slice on the inner axes (ref: the reference's hierarchical
+    inter/intra-node communicators, nccl_helper.h:179 — rebuilt as mesh
+    geometry so XLA routes collectives onto the right fabric).
+
+    ici_axes / dcn_axes: {axis_name: size} (sizes of -1 inferred; DCN
+    sizes must multiply to the slice count). On real multi-slice TPU,
+    uses mesh_utils.create_hybrid_device_mesh (which reads slice_index);
+    on homogeneous single-slice platforms (CPU testing), falls back to a
+    reshape with the DCN axes outermost — the same axis ORDER contract,
+    so shardings written against it transfer unchanged.
+
+    Rule of thumb the axis order encodes: put dp (gradient allreduce,
+    latency-tolerant) on DCN axes; keep tp/sp/pp (activation-sized,
+    latency-sensitive) on ICI axes.
+    """
+    devices = devices if devices is not None else jax.devices()
+    dcn = dict(dcn_axes)
+    ici = dict(ici_axes)
+    n = len(devices)
+    slices = {getattr(d, "slice_index", 0) for d in devices}
+    per_slice = n // max(len(slices), 1)
+
+    def resolve(axes, total):
+        names = list(axes)
+        sizes = [axes[a] for a in names]
+        if -1 in sizes:
+            known = int(np.prod([s for s in sizes if s != -1]))
+            sizes[sizes.index(-1)] = total // known
+        assert int(np.prod(sizes)) == total, (axes, total)
+        return names, sizes
+
+    if len(slices) > 1:
+        from jax.experimental import mesh_utils
+        dcn_names, dcn_sizes = resolve(dcn, len(slices))
+        ici_names, ici_sizes = resolve(ici, per_slice)
+        # returns shape (*dcn_sizes, *ici_sizes): DCN axes outermost
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices=devices)
+        return Mesh(dev_array, tuple(dcn_names) + tuple(ici_names))
+    # single-slice / CPU testing: same axis-order contract, plain reshape
+    # (explicit DCN sizes required — there is no slice topology to infer)
+    dcn_names = list(dcn)
+    dcn_sizes = [dcn[a] for a in dcn_names]
+    assert -1 not in dcn_sizes, \
+        "single-slice make_hybrid_mesh needs explicit dcn sizes"
+    total_dcn = int(np.prod(dcn_sizes))
+    ici_names, ici_sizes = resolve(ici, n // total_dcn)
+    dev_array = np.asarray(devices).reshape(dcn_sizes + ici_sizes)
+    return Mesh(dev_array, tuple(dcn_names) + tuple(ici_names))
+
+
 def named_sharding(mesh, *spec):
     return NamedSharding(mesh, P(*spec))
 

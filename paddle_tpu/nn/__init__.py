@@ -32,4 +32,6 @@ from paddle_tpu.nn.layers import (
     TreeConv,
 )
 
+from paddle_tpu.nn.moe import MoE, top_k_gating
+
 Layer = Module  # reference naming alias (dygraph.Layer)

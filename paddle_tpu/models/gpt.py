@@ -33,6 +33,9 @@ class GPTConfig:
     dropout: float = 0.1
     use_flash: bool = True
     seq_axis: str = None       # mesh axis name for ring sequence parallelism
+    moe_experts: int = 0       # >0: MoE FFN with this many experts
+    moe_k: int = 2
+    moe_ep_axis: str = None    # mesh axis for expert parallelism
 
     @staticmethod
     def small():
@@ -57,15 +60,26 @@ class GPTBlock(nn.Module):
                                           dropout=cfg.dropout,
                                           use_flash=cfg.use_flash)
         self.ln2 = nn.LayerNorm(cfg.hidden_size)
-        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
-        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        if cfg.moe_experts:
+            from paddle_tpu.nn.moe import MoE
+            self.mlp = MoE(cfg.hidden_size, cfg.intermediate_size,
+                           cfg.moe_experts, k=cfg.moe_k,
+                           ep_axis=cfg.moe_ep_axis)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+            self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
+
+    def _ffn(self, x):
+        if self.cfg.moe_experts:
+            return self.mlp(x)
+        return self.fc2(A.gelu(self.fc1(x)))
 
     def forward(self, x):
         # pre-norm residual blocks (GPT-2 style)
         x = x + self.drop(self.attn(self.ln1(x), causal=True,
                                     seq_axis=self.cfg.seq_axis))
-        x = x + self.drop(self.fc2(A.gelu(self.fc1(self.ln2(x)))))
+        x = x + self.drop(self._ffn(self.ln2(x)))
         return x
 
     def decode_step(self, x, cache, pos):
@@ -73,7 +87,7 @@ class GPTBlock(nn.Module):
         attention through the KV cache (dropout is inference-off)."""
         h, cache = self.attn.decode_step(self.ln1(x), cache, pos)
         x = x + h
-        x = x + self.fc2(A.gelu(self.fc1(self.ln2(x))))
+        x = x + self._ffn(self.ln2(x))
         return x, cache
 
 

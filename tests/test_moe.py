@@ -118,3 +118,32 @@ class TestMoELayer:
             out_specs=P(), check_vma=False)
         got = np.asarray(f(params, x))
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestGPTMoE:
+    def test_gpt_with_moe_ffn_trains(self):
+        from paddle_tpu.models.gpt import GPT, GPTConfig, lm_loss
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64, max_position=32,
+                        dropout=0.0, use_flash=False, moe_experts=4,
+                        moe_k=2)
+        model = GPT(cfg)
+        v = model.init(jax.random.key(0))
+        ids = jnp.asarray(np.random.RandomState(0).randint(
+            0, 128, (2, 16), dtype=np.int32))
+
+        def loss(params):
+            logits = model.apply({"params": params, "state": {}}, ids)
+            return lm_loss(logits, ids)
+
+        l0 = float(loss(v["params"]))
+        g = jax.grad(loss)(v["params"])
+        import paddle_tpu as pt
+        opt = pt.optimizer.Adam(1e-2)
+        st = opt.init(v["params"])
+        params = v["params"]
+        step = jax.jit(lambda p, s: opt.minimize(
+            lambda pp: (loss(pp), 0.0), p, s))
+        for _ in range(8):
+            l, params, st, _ = step(params, st)
+        assert float(l) < l0, (float(l), l0)

@@ -253,7 +253,12 @@ def register_reference_aliases():
             ("cross_entropy2", "cross_entropy"),
             ("unique", "unique_with_counts"),
             ("cvm", "continuous_value_model"),
-            ("deformable_psroi_pooling", "deformable_psroi_pool")):
+            ("deformable_psroi_pooling", "deformable_psroi_pool"),
+            ("deformable_roi_pooling", "deformable_psroi_pool"),
+            ("dynamic_lstm", "lstm"),
+            ("dynamic_gru", "gru"),
+            ("gru_unit", "gru_cell"),
+            ("lstm_unit", "lstm_cell")):
         _alias(name, target)
 
 
@@ -543,3 +548,90 @@ def minus(x, y):
 
 
 
+
+
+@register_op("scatter_nd")
+def scatter_nd(index, updates, shape):
+    """ref operators/scatter_nd_add_op.cc family / layers/nn.py scatter_nd:
+    zeros(shape) with `updates` added at `index` (duplicates accumulate).
+    index: [..., K] int; updates: index.shape[:-1] + shape[K:]."""
+    out = jnp.zeros(shape, updates.dtype)
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return out.at[idx].add(updates)
+
+
+@register_op("autoincreased_step_counter")
+def autoincreased_step_counter(counter=None):
+    """ref layers/nn.py autoincreased_step_counter — the reference mutates
+    a persistable counter var in the scope; the functional redesign takes
+    the counter as carried state and returns it incremented (keep it in
+    the optimizer/train state pytree)."""
+    if counter is None:
+        counter = jnp.zeros((), jnp.int64 if jax.config.jax_enable_x64
+                            else jnp.int32)
+    return counter + 1
+
+
+@register_op("resize_trilinear")
+def resize_trilinear(x, size=None, scale_factor=None, align_corners=False):
+    """ref operators/interpolate_op.cc trilinear path — NCDHW volumetric
+    resize by separable linear interpolation along D, H, W."""
+    n, c, d, h, w = x.shape
+    if size is None:
+        s = (scale_factor,) * 3 if isinstance(
+            scale_factor, (int, float)) else tuple(scale_factor)
+        size = (int(d * s[0]), int(h * s[1]), int(w * s[2]))
+    od, oh, ow = size
+
+    def axis_coords(out_n, in_n):
+        if align_corners and out_n > 1:
+            return jnp.arange(out_n) * ((in_n - 1) / (out_n - 1))
+        return jnp.maximum((jnp.arange(out_n) + 0.5) * (in_n / out_n) - 0.5,
+                           0.0)
+
+    def lin(x, coords, axis):
+        i0 = jnp.floor(coords).astype(jnp.int32)
+        i1 = jnp.minimum(i0 + 1, x.shape[axis] - 1)
+        wgt = (coords - i0).astype(x.dtype)
+        a = jnp.take(x, i0, axis=axis)
+        b = jnp.take(x, i1, axis=axis)
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        return a + (b - a) * wgt.reshape(shape)
+
+    x = lin(x, axis_coords(od, d), 2)
+    x = lin(x, axis_coords(oh, h), 3)
+    x = lin(x, axis_coords(ow, w), 4)
+    return x
+
+
+@register_op("merge_selected_rows")
+def merge_selected_rows(ids, rows):
+    """ref operators/merge_selected_rows_op.cc — merge duplicate rows of a
+    SelectedRows. Functional twin over the (ids, rows) pair encoding:
+    returns (unique_ids [k], merged_rows [k, D], valid [k]) with k =
+    ids.size (static worst case)."""
+    from paddle_tpu.parallel.sparse import segment_rowsum, unique_ids
+    uniq, inv, valid = unique_ids(ids)
+    merged = segment_rowsum(rows, inv, uniq.shape[0])
+    return uniq, merged, valid
+
+
+@register_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(ids, rows, height):
+    """ref operators/get_tensor_from_selected_rows_op.cc — densify a
+    SelectedRows into a [height, D] tensor (duplicates accumulate)."""
+    out = jnp.zeros((height, rows.shape[-1]), rows.dtype)
+    return out.at[ids.reshape(-1)].add(
+        rows.reshape(-1, rows.shape[-1]))
+
+
+@register_op("py_func")
+def py_func(func, *args, out_shape_dtype):
+    """ref operators/py_func_op.cc / layers/nn.py py_func — run arbitrary
+    host Python inside a compiled program. TPU-era mechanism:
+    jax.pure_callback (host round-trip at the op's position; func must be
+    pure per its contract, same as the reference's func semantics).
+    out_shape_dtype: a jax.ShapeDtypeStruct (or pytree of them)."""
+    return jax.pure_callback(func, out_shape_dtype, *args)

@@ -660,3 +660,69 @@ class TestFusedOps:
                   "fusion_seqpool_cvm_concat", "fusion_seqexpand_concat_fc",
                   "fusion_transpose_flatten_concat"):
             assert n in R, n
+
+
+class TestLayerSurfaceStragglers:
+    """Final layers/nn.py __all__ sweep (round 3)."""
+
+    def test_scatter_nd_and_add(self):
+        idx = jnp.asarray([[1], [3], [1]])
+        upd = jnp.asarray([1.0, 2.0, 3.0])
+        out = np.asarray(T.scatter_nd(idx, upd, (5,)))
+        np.testing.assert_allclose(out, [0, 4, 0, 2, 0])
+        from paddle_tpu.ops.tensor_ops import scatter_nd_add
+        x = jnp.ones((5,))
+        out2 = np.asarray(scatter_nd_add(x, idx, upd))
+        np.testing.assert_allclose(out2, [1, 5, 1, 3, 1])
+
+    def test_step_counter(self):
+        c = T.autoincreased_step_counter()
+        assert int(c) == 1
+        assert int(T.autoincreased_step_counter(c)) == 2
+
+    def test_resize_trilinear_matches_separable_ref(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+        out = np.asarray(T.resize_trilinear(jnp.asarray(x), size=(8, 8, 8)))
+        assert out.shape == (1, 2, 8, 8, 8)
+        # identity when size == input (half-pixel centers align)
+        same = np.asarray(T.resize_trilinear(jnp.asarray(x), size=(4, 4, 4)))
+        np.testing.assert_allclose(same, x, atol=1e-6)
+        # align_corners endpoints match input corners
+        ac = np.asarray(T.resize_trilinear(jnp.asarray(x), size=(7, 7, 7),
+                                           align_corners=True))
+        np.testing.assert_allclose(ac[0, 0, 0, 0, 0], x[0, 0, 0, 0, 0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(ac[0, 0, -1, -1, -1], x[0, 0, -1, -1, -1],
+                                   rtol=1e-6)
+
+    def test_selected_rows_utils(self):
+        ids = jnp.asarray([4, 1, 4])
+        rows = jnp.asarray([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]])
+        uniq, merged, valid = T.merge_selected_rows(ids, rows)
+        m = {int(u): np.asarray(merged[i])
+             for i, u in enumerate(np.asarray(uniq)) if bool(valid[i])}
+        np.testing.assert_allclose(m[4], [4.0, 4.0])
+        np.testing.assert_allclose(m[1], [2.0, 2.0])
+        dense = np.asarray(T.get_tensor_from_selected_rows(ids, rows, 6))
+        np.testing.assert_allclose(dense[4], [4.0, 4.0])
+        np.testing.assert_allclose(dense[1], [2.0, 2.0])
+        np.testing.assert_allclose(dense[0], [0.0, 0.0])
+
+    def test_py_func_host_callback(self):
+        import numpy as _np
+
+        def host_fn(a):
+            return _np.asarray(a) * 2 + 1
+
+        x = jnp.asarray([1.0, 2.0])
+        out = jax.jit(lambda a: T.py_func(
+            host_fn, a,
+            out_shape_dtype=jax.ShapeDtypeStruct((2,), jnp.float32)))(x)
+        np.testing.assert_allclose(np.asarray(out), [3.0, 5.0])
+
+    def test_rnn_aliases(self):
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        for n in ("dynamic_lstm", "dynamic_gru", "gru_unit", "lstm_unit",
+                  "deformable_roi_pooling"):
+            assert n in R, n

@@ -46,6 +46,7 @@ from paddle_tpu import ops
 from paddle_tpu import nn
 from paddle_tpu import optimizer
 from paddle_tpu import amp
+from paddle_tpu import distributions
 from paddle_tpu import parallel
 from paddle_tpu import data
 from paddle_tpu import io

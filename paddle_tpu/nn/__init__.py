@@ -32,6 +32,7 @@ from paddle_tpu.nn.layers import (
     TreeConv,
 )
 
+from paddle_tpu.nn.heads import MultiBoxHead
 from paddle_tpu.nn.moe import MoE, top_k_gating
 
 Layer = Module  # reference naming alias (dygraph.Layer)

@@ -1,0 +1,100 @@
+"""Detection heads (ref: layers/detection.py multi_box_head — the SSD
+prior + loc/conf conv head over multiple feature maps).
+
+The reference's function creates conv weights implicitly through
+param_attr; here it is a Module (explicit params, functional apply), with
+identical output contract: concatenated (mbox_locs [B, N, 4],
+mbox_confs [B, N, C], prior_boxes [N, 4], variances [N, 4]).
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu.nn.layers import Conv2D
+from paddle_tpu.nn.module import Module
+
+
+def _num_priors(min_sizes, max_sizes, aspect_ratios, flip):
+    from paddle_tpu.ops.detection import expand_aspect_ratios
+    ars = expand_aspect_ratios(aspect_ratios, flip)
+    per_min = 1 + len([a for a in ars if abs(a - 1.0) > 1e-6])
+    n = len(min_sizes) * per_min
+    if max_sizes:
+        n += len(min_sizes)
+    return n
+
+
+class MultiBoxHead(Module):
+    """SSD multi-box head (ref layers/detection.py multi_box_head).
+
+    per_map_cfg: list of dicts, one per input feature map, each with
+    min_sizes, max_sizes (or None), aspect_ratios; in_channels: list of
+    input channel counts. base_size: input image size (h == w == base).
+    """
+
+    def __init__(self, in_channels, num_classes, per_map_cfg, base_size,
+                 kernel_size=3, flip=True, clip=False,
+                 variance=(0.1, 0.1, 0.2, 0.2), steps=None, offset=0.5,
+                 min_max_aspect_ratios_order=False):
+        super().__init__()
+        self.num_classes = num_classes
+        self.cfgs = per_map_cfg
+        self.base_size = base_size
+        self.flip, self.clip = flip, clip
+        self.variance = tuple(variance)
+        self.steps = steps
+        self.offset = offset
+        self.mmaro = min_max_aspect_ratios_order
+        loc_convs, conf_convs, priors = [], [], []
+        for ci, cfg in zip(in_channels, per_map_cfg):
+            p = _num_priors(cfg["min_sizes"], cfg.get("max_sizes"),
+                            cfg["aspect_ratios"], flip)
+            priors.append(p)
+            loc_convs.append(Conv2D(
+                ci, p * 4, kernel_size, padding=(kernel_size - 1) // 2,
+                weight_init=I.xavier()))
+            conf_convs.append(Conv2D(
+                ci, p * num_classes, kernel_size,
+                padding=(kernel_size - 1) // 2, weight_init=I.xavier()))
+        self.priors_per_map = priors
+        # assign complete lists: Module.__setattr__ registers submodules
+        # at assignment time
+        self.loc_convs = loc_convs
+        self.conf_convs = conf_convs
+
+    def forward(self, inputs, image_shape=None):
+        """inputs: list of NCHW feature maps. Returns (locs [B, N, 4],
+        confs [B, N, C], boxes [N, 4], variances [N, 4])."""
+        from paddle_tpu.ops.detection import prior_box
+        ih = iw = self.base_size
+        if image_shape is not None:
+            ih, iw = image_shape
+        locs, confs, boxes, vars_ = [], [], [], []
+        for x, cfg, p, lc, cc in zip(inputs, self.cfgs,
+                                     self.priors_per_map, self.loc_convs,
+                                     self.conf_convs):
+            b, _, fh, fw = x.shape
+            loc = lc(x).transpose(0, 2, 3, 1).reshape(b, -1, 4)
+            conf = cc(x).transpose(0, 2, 3, 1).reshape(
+                b, -1, self.num_classes)
+            if self.steps:
+                # reference format: one scalar per map (or a (w, h) pair,
+                # reference order); prior_box wants (step_h, step_w)
+                st = self.steps[len(boxes)]
+                st = ((st, st) if isinstance(st, (int, float))
+                      else (st[1], st[0]))
+            else:
+                st = (0.0, 0.0)
+            pb, pv = prior_box(
+                (fh, fw), (ih, iw), cfg["min_sizes"],
+                cfg.get("max_sizes"), cfg["aspect_ratios"],
+                variance=self.variance, flip=self.flip, clip=self.clip,
+                steps=st, offset=self.offset,
+                min_max_aspect_ratios_order=self.mmaro)
+            assert pb.shape[2] == p, (pb.shape, p)
+            locs.append(loc)
+            confs.append(conf)
+            boxes.append(pb.reshape(-1, 4))
+            vars_.append(pv.reshape(-1, 4))
+        return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
+                jnp.concatenate(boxes, 0), jnp.concatenate(vars_, 0))

@@ -72,3 +72,48 @@ def fori_loop(lower, upper, body, init):
 @register_op("stop_gradient")
 def stop_gradient(x):
     return lax.stop_gradient(x)
+
+
+# ---- TensorArray successors (ref: layers/control_flow.py array_write/
+# array_read/array_length over LoDTensorArray) — functional redesign: the
+# array is a pre-sized stacked jnp array carried through the loop (static
+# shapes; lax.scan/while carry it), index writes are at[].set.
+@register_op("create_array")
+def create_array(size, element_shape, dtype=None):
+    """Fixed-capacity TensorArray: zeros([size, *element_shape])."""
+    import jax.numpy as jnp
+    return jnp.zeros((size,) + tuple(element_shape),
+                     dtype if dtype is not None else jnp.float32)
+
+
+@register_op("array_write")
+def array_write(array, i, x):
+    """ref layers/control_flow.py array_write — arr[i] = x (functional)."""
+    return array.at[i].set(x)
+
+
+@register_op("array_read")
+def array_read(array, i):
+    """ref layers/control_flow.py array_read."""
+    return array[i]
+
+
+@register_op("array_length")
+def array_length(array):
+    """ref layers/control_flow.py array_length — static capacity."""
+    import jax.numpy as jnp
+    return jnp.asarray(array.shape[0], jnp.int32)
+
+
+@register_op("print")
+def print_op(x, message="", summarize=8):
+    """ref operators/print_op.cc / layers/control_flow.py Print — print a
+    tensor from inside a compiled program (jax.debug.print host hop);
+    returns x unchanged so it drops into dataflow like the reference op.
+    summarize: print only the first N elements (<=0 prints all)."""
+    import jax
+    import jax.numpy as jnp
+    shown = jnp.ravel(x)[:summarize] if summarize and summarize > 0 else x
+    # message passed as a value, not a format string — braces are safe
+    jax.debug.print("{m}{x}", m=message, x=shown)
+    return x

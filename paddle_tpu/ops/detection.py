@@ -115,6 +115,18 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
 
 
 # ------------------------------------------------------------------ priors
+def expand_aspect_ratios(aspect_ratios, flip):
+    """SSD aspect-ratio expansion (dedup + optional 1/ar flip) — shared by
+    prior_box and the MultiBoxHead prior-count computation."""
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    return ars
+
+
 @register_op("prior_box")
 def prior_box(feature_shape, image_shape, min_sizes, max_sizes=None,
               aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
@@ -127,12 +139,7 @@ def prior_box(feature_shape, image_shape, min_sizes, max_sizes=None,
     prior_box()."""
     fh, fw = feature_shape
     ih, iw = image_shape
-    ars = [1.0]
-    for ar in aspect_ratios:
-        if not any(abs(ar - e) < 1e-6 for e in ars):
-            ars.append(float(ar))
-            if flip:
-                ars.append(1.0 / float(ar))
+    ars = expand_aspect_ratios(aspect_ratios, flip)
     step_w = steps[1] if steps[1] > 0 else float(iw) / fw
     step_h = steps[0] if steps[0] > 0 else float(ih) / fh
     max_sizes = list(max_sizes or [])

@@ -258,7 +258,14 @@ def register_reference_aliases():
             ("dynamic_lstm", "lstm"),
             ("dynamic_gru", "gru"),
             ("gru_unit", "gru_cell"),
-            ("lstm_unit", "lstm_cell")):
+            ("lstm_unit", "lstm_cell"),
+            ("While", "while_loop"),
+            ("Switch", "switch_case"),
+            ("IfElse", "cond"),
+            ("StaticRNN", "scan"),
+            ("DynamicRNN", "scan"),
+            ("Print", "print"),
+            ("range", "arange")):
         _alias(name, target)
 
 
@@ -635,3 +642,84 @@ def py_func(func, *args, out_shape_dtype):
     pure per its contract, same as the reference's func semantics).
     out_shape_dtype: a jax.ShapeDtypeStruct (or pytree of them)."""
     return jax.pure_callback(func, out_shape_dtype, *args)
+
+
+@register_op("assign")
+def assign(x, output=None):
+    """ref operators/assign_op.cc — identity copy (functional: output arg
+    is the reference's in-place target, ignored here)."""
+    return jnp.asarray(x)
+
+
+@register_op("sums")
+def sums(inputs):
+    """ref operators/sum_op.cc over a list — elementwise sum of tensors."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+@register_op("has_inf")
+def has_inf(x):
+    """ref operators/isfinite_op.cc has_inf."""
+    return jnp.any(jnp.isinf(x))
+
+
+@register_op("has_nan")
+def has_nan(x):
+    """ref operators/isfinite_op.cc has_nan."""
+    return jnp.any(jnp.isnan(x))
+
+
+@register_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(array, axis=1, use_stack=False):
+    """ref operators/tensor_array_to_tensor_op.cc — our TensorArray is
+    already a stacked [N, ...] tensor: stack keeps it; concat merges the
+    leading dim into `axis`."""
+    if use_stack:
+        return array
+    parts = [array[i] for i in range(array.shape[0])]
+    return jnp.concatenate(parts, axis=axis)
+
+
+@register_op("ones")
+def ones(shape, dtype=jnp.float32):
+    """ref layers/tensor.py ones."""
+    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,),
+                    dtype)
+
+
+@register_op("zeros")
+def zeros(shape, dtype=jnp.float32):
+    """ref layers/tensor.py zeros."""
+    return jnp.zeros(tuple(shape) if not isinstance(shape, int)
+                     else (shape,), dtype)
+
+
+@register_op("create_tensor")
+def create_tensor(dtype=jnp.float32, shape=()):
+    """ref layers/tensor.py create_tensor — a zero tensor (variables are
+    just arrays here; mutation is functional)."""
+    return jnp.zeros(shape, dtype)
+
+
+@register_op("create_global_var")
+def create_global_var(shape, value, dtype=jnp.float32):
+    """ref layers/tensor.py create_global_var — a filled array to carry in
+    the train-state pytree (persistable scope vars are state here)."""
+    return jnp.full(tuple(shape), value, dtype)
+
+
+@register_op("create_parameter")
+def create_parameter(shape, dtype=jnp.float32, initializer=None, key=None):
+    """ref layers/tensor.py create_parameter — initializer-backed array.
+    Random initializers REQUIRE a PRNG key (explicit TPU RNG — a silent
+    constant key would hand every parameter identical values)."""
+    if initializer is None:
+        return jnp.zeros(tuple(shape), dtype)
+    enforce(key is not None,
+            "create_parameter with an initializer needs a PRNG key "
+            "(jax.random.key(...)) — parameters must not share a "
+            "constant default key")
+    return initializer(key, tuple(shape), dtype)

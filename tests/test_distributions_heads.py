@@ -1,0 +1,92 @@
+"""Distributions (ref layers/distributions.py) + MultiBoxHead (ref
+layers/detection.py multi_box_head)."""
+
+import numpy as np
+import pytest
+from scipy import stats as sstats
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributions import (Categorical, MultivariateNormalDiag,
+                                      Normal, Uniform)
+
+
+class TestDistributions:
+    def test_normal_logprob_entropy_kl(self):
+        d = Normal(1.0, 2.0)
+        np.testing.assert_allclose(float(d.log_prob(jnp.asarray(0.5))),
+                                   sstats.norm(1.0, 2.0).logpdf(0.5),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   sstats.norm(1.0, 2.0).entropy(),
+                                   rtol=1e-5)
+        other = Normal(0.0, 1.0)
+        # analytic KL(N(1,2) || N(0,1))
+        kl = 0.5 * (4.0 + 1.0 - 1.0 - np.log(4.0))
+        np.testing.assert_allclose(float(d.kl_divergence(other)), kl,
+                                   rtol=1e-5)
+        s = d.sample(jax.random.key(0), (20000,))
+        assert abs(float(jnp.mean(s)) - 1.0) < 0.05
+        assert abs(float(jnp.std(s)) - 2.0) < 0.05
+
+    def test_uniform(self):
+        d = Uniform(-1.0, 3.0)
+        np.testing.assert_allclose(float(d.log_prob(jnp.asarray(0.0))),
+                                   -np.log(4.0), rtol=1e-6)
+        assert float(d.log_prob(jnp.asarray(5.0))) == -np.inf
+        np.testing.assert_allclose(float(d.entropy()), np.log(4.0),
+                                   rtol=1e-6)
+        s = d.sample(jax.random.key(1), (10000,))
+        assert float(jnp.min(s)) >= -1.0 and float(jnp.max(s)) < 3.0
+
+    def test_categorical(self):
+        logits = jnp.asarray([0.0, 1.0, 2.0])
+        d = Categorical(logits)
+        p = np.exp([0, 1, 2]) / np.exp([0, 1, 2]).sum()
+        np.testing.assert_allclose(float(d.log_prob(jnp.asarray(2))),
+                                   np.log(p[2]), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy()),
+                                   -(p * np.log(p)).sum(), rtol=1e-5)
+        q = Categorical(jnp.zeros(3))
+        kl = (p * (np.log(p) - np.log(1 / 3))).sum()
+        np.testing.assert_allclose(float(d.kl_divergence(q)), kl, rtol=1e-5)
+
+    def test_mvn_diag(self):
+        d = MultivariateNormalDiag(jnp.asarray([0.0, 1.0]),
+                                   jnp.asarray([1.0, 2.0]))
+        v = np.asarray([0.5, 0.0])
+        ref = (sstats.norm(0, 1).logpdf(0.5)
+               + sstats.norm(1, 2).logpdf(0.0))
+        np.testing.assert_allclose(float(d.log_prob(jnp.asarray(v))), ref,
+                                   rtol=1e-5)
+        other = MultivariateNormalDiag(jnp.zeros(2), jnp.ones(2))
+        kl_dims = 0.5 * (np.array([1.0, 4.0]) + np.array([0.0, 1.0])
+                         - 1.0 - np.log(np.array([1.0, 4.0])))
+        np.testing.assert_allclose(float(d.kl_divergence(other)),
+                                   kl_dims.sum(), rtol=1e-5)
+
+
+class TestMultiBoxHead:
+    def test_ssd_head_shapes_and_priors(self):
+        from paddle_tpu import nn
+        cfgs = [
+            {"min_sizes": [60.0], "max_sizes": [110.0],
+             "aspect_ratios": [2.0]},
+            {"min_sizes": [110.0], "max_sizes": [160.0],
+             "aspect_ratios": [2.0, 3.0]},
+        ]
+        head = nn.MultiBoxHead([8, 16], num_classes=4, per_map_cfg=cfgs,
+                               base_size=300)
+        v = head.init(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        f1 = jnp.asarray(rng.randn(2, 8, 10, 10).astype(np.float32))
+        f2 = jnp.asarray(rng.randn(2, 16, 5, 5).astype(np.float32))
+        locs, confs, boxes, vars_ = head.apply(v, [f1, f2])
+        # priors: map1 P=4 (1+2+1), map2 P=6 (1+4+1)
+        n = 10 * 10 * 4 + 5 * 5 * 6
+        assert locs.shape == (2, n, 4)
+        assert confs.shape == (2, n, 4)
+        assert boxes.shape == (n, 4)
+        assert vars_.shape == (n, 4)
+        assert np.isfinite(np.asarray(locs)).all()

@@ -9,3 +9,19 @@ from paddle_tpu.data.dataset import (
     synthetic_mnist,
     synthetic_tokens,
 )
+
+
+def py_reader(feed_list=None, capacity=8, **kw):
+    """ref layers/io.py py_reader — compat shim: the TPU-era reader is
+    DataLoader.from_generator (background prefetch = the reference's
+    double_buffer + py_reader pipeline)."""
+    raise NotImplementedError(
+        "py_reader's graph-variable contract does not exist here; use "
+        "pt.data.DataLoader.from_generator(generator, batch_size) — it "
+        "covers py_reader + double_buffer (background device prefetch)")
+
+
+def double_buffer(reader, **kw):
+    """ref layers/io.py double_buffer — DataLoader already stages batch
+    t+1 while t computes; this is the identity on our readers."""
+    return reader

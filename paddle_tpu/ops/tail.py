@@ -675,26 +675,27 @@ def has_nan(x):
 @register_op("tensor_array_to_tensor")
 def tensor_array_to_tensor(array, axis=1, use_stack=False):
     """ref operators/tensor_array_to_tensor_op.cc — our TensorArray is
-    already a stacked [N, ...] tensor: stack keeps it; concat merges the
-    leading dim into `axis`."""
+    already a stacked [N, ...] tensor: stack moves the array dim to
+    `axis`; concat merges entries along `axis`."""
     if use_stack:
-        return array
+        return jnp.moveaxis(array, 0, axis)
     parts = [array[i] for i in range(array.shape[0])]
     return jnp.concatenate(parts, axis=axis)
 
 
 @register_op("ones")
 def ones(shape, dtype=jnp.float32):
-    """ref layers/tensor.py ones."""
-    return jnp.ones(tuple(shape) if not isinstance(shape, int) else (shape,),
-                    dtype)
+    """ref layers/tensor.py ones — fill_constant(shape, 1) like the
+    reference."""
+    from paddle_tpu.ops.tensor_ops import fill_constant
+    return fill_constant(shape, dtype, 1.0)
 
 
 @register_op("zeros")
 def zeros(shape, dtype=jnp.float32):
-    """ref layers/tensor.py zeros."""
-    return jnp.zeros(tuple(shape) if not isinstance(shape, int)
-                     else (shape,), dtype)
+    """ref layers/tensor.py zeros — fill_constant(shape, 0)."""
+    from paddle_tpu.ops.tensor_ops import fill_constant
+    return fill_constant(shape, dtype, 0.0)
 
 
 @register_op("create_tensor")

@@ -31,6 +31,7 @@ from paddle_tpu.parallel import (
 )
 from paddle_tpu.parallel.planner import DistributionPlan, DistributionPlanner
 from paddle_tpu.parallel.sparse import HostTable, SparseTable
+from paddle_tpu.parallel.elastic import ElasticRunner
 from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet
 from paddle_tpu.parallel.communicator import (GeoSGD, GradientMerge, LocalSGD,
                                               stack_replicas, unstack_replica)

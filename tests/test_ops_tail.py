@@ -726,3 +726,19 @@ class TestLayerSurfaceStragglers:
         for n in ("dynamic_lstm", "dynamic_gru", "gru_unit", "lstm_unit",
                   "deformable_roi_pooling"):
             assert n in R, n
+
+    def test_ones_zeros_tensor_array_to_tensor(self):
+        np.testing.assert_allclose(np.asarray(T.ones((2, 3))),
+                                   np.ones((2, 3)))
+        np.testing.assert_allclose(np.asarray(T.zeros((2,))), np.zeros(2))
+        from paddle_tpu.ops.control_flow import (array_write, create_array)
+        arr = create_array(3, (2,))
+        for i in range(3):
+            arr = array_write(arr, i, jnp.full((2,), float(i)))
+        # stack along axis=1 (reference default): [2, 3]
+        st = np.asarray(T.tensor_array_to_tensor(arr, axis=1,
+                                                 use_stack=True))
+        assert st.shape == (2, 3)
+        np.testing.assert_allclose(st[:, 2], [2.0, 2.0])
+        cat = np.asarray(T.tensor_array_to_tensor(arr, axis=0))
+        assert cat.shape == (6,)

@@ -308,3 +308,27 @@ class DetectionMAP(MetricBase):
                for c in range(self.class_num) if c != self.background]
         aps = [a for a in aps if a is not None]
         return float(np.mean(aps)) if aps else 0.0
+
+
+def ctr_metric_bundle(pred, label):
+    """ref contrib/layers/metric_op.py ctr_metric_bundle — per-batch local
+    sums for CTR metrics; the caller accumulates (and psum-reduces under
+    dp) then finishes: MAE = abserr/n, RMSE = sqrt(sqrerr/n),
+    predicted_ctr = prob/n, q = q_sum/n.
+
+    pred: [N, 1] probabilities; label: [N, 1] 0/1.
+    Returns dict(sqrerr, abserr, prob, q, pos_num, ins_num) scalars —
+    functional redesign of the reference's persistable accumulator vars
+    (carry the dict in train state and add per step)."""
+    import jax.numpy as jnp
+    pred = pred.reshape(-1).astype(jnp.float32)
+    label = label.reshape(-1).astype(jnp.float32)
+    err = pred - label
+    return {
+        "sqrerr": jnp.sum(err * err),
+        "abserr": jnp.sum(jnp.abs(err)),
+        "prob": jnp.sum(pred),
+        "q": jnp.sum(pred),
+        "pos_num": jnp.sum(label),
+        "ins_num": jnp.asarray(float(pred.shape[0])),
+    }

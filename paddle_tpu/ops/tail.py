@@ -265,7 +265,11 @@ def register_reference_aliases():
             ("StaticRNN", "scan"),
             ("DynamicRNN", "scan"),
             ("Print", "print"),
-            ("range", "arange")):
+            ("range", "arange"),
+            ("basic_gru", "gru"),
+            ("basic_lstm", "lstm"),
+            ("BasicGRUUnit", "gru_cell"),
+            ("BasicLSTMUnit", "lstm_cell")):
         _alias(name, target)
 
 

@@ -742,3 +742,17 @@ class TestLayerSurfaceStragglers:
         np.testing.assert_allclose(st[:, 2], [2.0, 2.0])
         cat = np.asarray(T.tensor_array_to_tensor(arr, axis=0))
         assert cat.shape == (6,)
+
+    def test_ctr_metric_bundle_and_contrib_aliases(self):
+        from paddle_tpu.core.registry import GLOBAL_OP_REGISTRY as R
+        from paddle_tpu.metrics import ctr_metric_bundle
+        pred = jnp.asarray([[0.8], [0.2], [0.6]])
+        label = jnp.asarray([[1], [0], [1]])
+        m = ctr_metric_bundle(pred, label)
+        np.testing.assert_allclose(float(m["abserr"]),
+                                   0.2 + 0.2 + 0.4, rtol=1e-6)
+        np.testing.assert_allclose(float(m["prob"]), 1.6, rtol=1e-6)
+        assert float(m["ins_num"]) == 3.0 and float(m["pos_num"]) == 2.0
+        for n in ("basic_gru", "basic_lstm", "BasicGRUUnit",
+                  "BasicLSTMUnit"):
+            assert n in R, n

@@ -355,3 +355,41 @@ class TestProgramDesc:
         parsed = ProgramDesc.parse_from_string(text)
         with pytest.raises(EnforceError, match="not in the op registry"):
             parsed.build_fn()
+
+
+class TestProgramDescRound3Ops:
+    """The serialization layer keeps pace with the round-3 op surface:
+    programs naming new ops (fused compositions, aliases, tensor utils)
+    round-trip through the registry and execute."""
+
+    def test_round3_ops_round_trip(self):
+        import jax
+        from paddle_tpu.static.desc import ProgramDesc, program_desc
+
+        desc = program_desc(feeds=["x", "y"], fetches=["out"])
+        # fused composition + alias + tensor-surface op in one program
+        desc.append_op("fused_elemwise_activation", ["x", "y"], ["a"],
+                       functor_list=("relu", "elementwise_add"))
+        desc.append_op("squared_l2_norm", ["a"], ["n"])
+        desc.append_op("minus", ["n", "n"], ["z"])
+        desc.append_op("assign", ["z"], ["out"])
+
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 4), jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).rand(3, 4), jnp.float32)
+        fn = desc.build_fn()
+        out1 = fn(x, y)
+
+        parsed = ProgramDesc.parse_from_string(desc.to_json())
+        out2 = jax.jit(parsed.build_fn())(x, y)
+        np.testing.assert_allclose(np.asarray(out1["out"]),
+                                   np.asarray(out2["out"]), rtol=1e-6)
+        assert float(out2["out"]) == 0.0   # n - n
+
+    def test_alias_ops_resolve_in_programs(self):
+        from paddle_tpu.static.desc import program_desc
+        desc = program_desc(feeds=["x"], fetches=["out"])
+        desc.append_op("cvm", ["x"], ["out"], use_cvm=True)
+        x = jnp.asarray([[2.0, 1.0, 0.5]])
+        out = desc.build_fn()(x)["out"]
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0], np.log(3.0), rtol=1e-6)

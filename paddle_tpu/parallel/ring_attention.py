@@ -142,8 +142,11 @@ def ulysses_attention(q, k, v, axis_name, attention_fn=None, causal=False):
     """
     n = lax.axis_size(axis_name)
     if attention_fn is None:
-        from paddle_tpu.ops.attention import scaled_dot_product_attention
-        attention_fn = lambda q_, k_, v_: scaled_dot_product_attention(
+        # flash (Pallas) on TPU / interpret; dense softmax elsewhere —
+        # after the all_to_all each device holds FULL sequences for its
+        # head subset, exactly the kernel's layout
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        attention_fn = lambda q_, k_, v_: flash_attention(
             q_, k_, v_, causal=causal)
     # [B, H, Tl, D] -> heads scattered, seq gathered: [B, H/N, T, D]
     reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=1,

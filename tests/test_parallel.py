@@ -490,3 +490,29 @@ class TestHybridMesh:
         import paddle_tpu as pt
         mesh = pt.parallel.make_hybrid_mesh({"tp": -1}, {"dp": 2})
         assert mesh.devices.shape == (2, 4)
+
+
+def test_ulysses_flash_kernel_interpret():
+    """Ulysses default attention now rides the flash kernel: interpret
+    mode must match the dense path (full-sequence per head subset is
+    exactly the kernel's layout)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+    q = jax.random.normal(jax.random.key(0), (1, 8, 8 * 8, 64), jnp.float32)
+    ref = scaled_dot_product_attention(q, q, q, causal=True)
+    sp_mesh = pt.parallel.make_mesh({"sp": 8})
+    f = shard_map(
+        lambda q_, k_, v_: ulysses_attention(q_, k_, v_, "sp", causal=True),
+        mesh=sp_mesh, in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None), check_vma=False)
+    set_flags({"pallas_interpret": True})
+    try:
+        got = f(q, q, q)
+    finally:
+        set_flags({"pallas_interpret": False})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

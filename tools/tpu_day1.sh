@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# One-shot TPU validation the moment a chip is reachable (the round-3
+# tunnel outage staged all of this; see BASELINE.md "Round 3 status").
+# Runs: aliveness probe -> Pallas silicon smoke (parity + timings) ->
+# all four bench rows. Appends everything to tools/tpu_day1.log.
+#
+# Usage: bash tools/tpu_day1.sh
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tpu_day1.log
+say() { echo "== $*" | tee -a "$LOG"; }
+
+say "$(date -u +%FT%TZ) tpu_day1 start"
+
+say "probe"
+if ! timeout 100 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+print('PROBE_OK', float((jnp.ones((128,128))@jnp.ones((128,128))).sum()),
+      d[0].device_kind)" 2>&1 | tee -a "$LOG" | grep -q PROBE_OK; then
+  say "tunnel down — aborting"
+  exit 2
+fi
+
+say "pallas smoke (parity + timings)"
+timeout 560 python tools/tpu_smoke.py 2>&1 | tee -a "$LOG"
+
+say "bench bert (flash+mask default)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model bert --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench resnet50 (NHWC bf16 + conv_custom_vjp)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model resnet50 --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench resnet50 with maxpool scatter backward"
+PT_FLAGS_maxpool_custom_vjp=1 PT_BENCH_WALL=420 timeout 460 \
+  python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
+
+say "bench transformer_big"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model transformer_big \
+  --steps 10 2>&1 | tee -a "$LOG"
+
+say "bench gpt"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "$(date -u +%FT%TZ) tpu_day1 done — record rows in BASELINE.md; flip"
+say "maxpool_custom_vjp default if the scatter row wins; flip any flash"
+say "defaults guarded by smoke results"

@@ -323,8 +323,12 @@ def _run_inner(args):
         res = bench_bert(args.steps, args.batch or 64, args.seq,
                          use_flash=args.flash)
     elif args.model == "transformer_big":
-        res = bench_transformer(args.steps, args.batch or 32,
-                                min(args.seq, 256))
+        seq = min(args.seq, 256)
+        if seq != args.seq:
+            print(f"transformer_big: clamping --seq {args.seq} -> {seq} "
+                  "(WMT sentence-length regime; pass --seq <=256 to "
+                  "silence)", file=sys.stderr)
+        res = bench_transformer(args.steps, args.batch or 32, seq)
     elif args.model == "gpt":
         res = bench_gpt(args.steps, args.batch or 16, args.seq)
     else:

@@ -320,6 +320,7 @@ def ctr_metric_bundle(pred, label):
     Returns dict(sqrerr, abserr, prob, q, pos_num, ins_num) scalars —
     functional redesign of the reference's persistable accumulator vars
     (carry the dict in train state and add per step)."""
+    import jax
     import jax.numpy as jnp
     pred = pred.reshape(-1).astype(jnp.float32)
     label = label.reshape(-1).astype(jnp.float32)
@@ -328,7 +329,9 @@ def ctr_metric_bundle(pred, label):
         "sqrerr": jnp.sum(err * err),
         "abserr": jnp.sum(jnp.abs(err)),
         "prob": jnp.sum(pred),
-        "q": jnp.sum(pred),
+        # the reference's local_q re-applies sigmoid to its input even
+        # when it is already a probability — keep that exact contract
+        "q": jnp.sum(jax.nn.sigmoid(pred)),
         "pos_num": jnp.sum(label),
         "ins_num": jnp.asarray(float(pred.shape[0])),
     }

@@ -12,6 +12,8 @@ Used inside shard_map with sequences sharded over axis `sp`:
   q, k, v: [B, H, T/N, D] per device.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -64,34 +66,28 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
-                         block_q=512, block_k=512):
-    """Ring attention with the Pallas flash kernel as the per-block
-    engine: each ring step runs the O(T) online-softmax kernel on the
-    resident KV block and partial results merge by logsumexp — so the
-    per-device inner loop is MXU-tiled VMEM compute instead of a dense
-    [Tl, Tl] XLA einsum, while KV blocks rotate on ICI exactly as in
-    `ring_attention`.
+def _ring_causal_dispatch(owner, my, blk_fn, zero_fn, kb, vb):
+    """Ring causality at BLOCK granularity, shared by the forward and
+    backward loops so the visibility rule cannot desynchronize: a device's
+    own block runs the causal kernel, blocks from earlier ranks run the
+    plain kernel, later ranks contribute nothing."""
+    return lax.cond(
+        owner == my,
+        lambda kv: blk_fn(kv[0], kv[1], True),
+        lambda kv: lax.cond(
+            owner < my,
+            lambda kv2: blk_fn(kv2[0], kv2[1], False),
+            lambda kv2: zero_fn(),
+            kv),
+        (kb, vb))
 
-    Causality is resolved at BLOCK granularity with lax.cond (the kernel's
-    causal flag is compile-time): a device's own block runs the causal
-    kernel, blocks from earlier ranks run the plain kernel, later ranks
-    contribute nothing. Falls back to `ring_attention` off-TPU or for
-    shapes the kernel refuses.
 
-    Call inside shard_map(..., check_vma=False) — pallas_call does not
-    declare varying-mesh-axes metadata (same requirement as
-    parallel/pipeline.py).
-    """
-    from paddle_tpu.core.flags import get_flag
-    from paddle_tpu.ops.pallas import on_tpu
+def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale, block_q,
+                         block_k):
+    """Forward ring loop; returns (output in q.dtype, final lse [B,H,Tl])."""
     from paddle_tpu.ops.pallas.flash_attention import \
         _flash_attention_fwd_tpu
     b, h, tl, d = q.shape
-    if not ((on_tpu() or get_flag("pallas_interpret"))
-            and d % 64 == 0 and tl % 8 == 0):
-        return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
-    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -106,17 +102,11 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
         o, lse, kb, vb = state
         owner = (my - i) % n
         if causal:
-            ob, lb = lax.cond(
-                owner == my,
-                lambda kv: blk(kv[0], kv[1], True),
-                lambda kv: lax.cond(
-                    owner < my,
-                    lambda kv2: blk(kv2[0], kv2[1], False),
-                    # later rank: causally invisible — contributes nothing
-                    lambda kv2: (jnp.zeros_like(o),
-                                 jnp.full(lse.shape, NEG_INF, jnp.float32)),
-                    kv),
-                (kb, vb))
+            ob, lb = _ring_causal_dispatch(
+                owner, my, blk,
+                lambda: (jnp.zeros_like(o),
+                         jnp.full(lse.shape, NEG_INF, jnp.float32)),
+                kb, vb)
         else:
             ob, lb = blk(kb, vb, False)
         # merge normalized partials by logsumexp weight
@@ -130,8 +120,107 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
 
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((b, h, tl), NEG_INF, jnp.float32)
-    o, _, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k, v))
-    return o.astype(q.dtype)
+    o, lse, _, _ = lax.fori_loop(0, n, step, (o0, lse0, k, v))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash_core(q, k, v, axis_name, causal, scale, block_q, block_k):
+    return _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale,
+                                block_q, block_k)[0]
+
+
+def _ring_flash_core_fwd(q, k, v, axis_name, causal, scale, block_q,
+                         block_k):
+    o, lse = _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale,
+                                  block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_core_bwd(axis_name, causal, scale, block_q, block_k, res, g):
+    """Ring backward: rotate KV blocks around the ring a second time, this
+    time towing their gradient accumulators. Per step the Pallas dq/dkv
+    kernels run against the resident block with the device's FINAL
+    logsumexp (flash-attention-2 recomputation: p = exp(s − lse_final) is
+    exact for any sub-block of keys), so dq accumulates locally and the
+    traveling dk/dv arrive back at their owner after the full cycle."""
+    from paddle_tpu.ops.pallas.flash_attention import \
+        _flash_attention_bwd_tpu
+    q, k, v, o, lse = res
+    tl = q.shape[2]
+    bq, bk = min(block_q, tl), min(block_k, tl)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def blk_bwd(kb, vb, blk_causal):
+        dqc, dkc, dvc = _flash_attention_bwd_tpu(
+            q, kb, vb, o, lse, g, scale, blk_causal, bq, bk)
+        return (dqc.astype(jnp.float32), dkc.astype(jnp.float32),
+                dvc.astype(jnp.float32))
+
+    def step(i, state):
+        dq, kb, vb, dkb, dvb = state
+        owner = (my - i) % n
+        if causal:
+            dqc, dkc, dvc = _ring_causal_dispatch(
+                owner, my, blk_bwd,
+                lambda: (jnp.zeros_like(dq),) * 3,
+                kb, vb)
+        else:
+            dqc, dkc, dvc = blk_bwd(kb, vb, False)
+        dq = dq + dqc
+        dkb = dkb + dkc
+        dvb = dvb + dvc
+        # the accumulators travel WITH their block: after the n-step cycle
+        # each block (and its gradient) is home again
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        dkb = lax.ppermute(dkb, axis_name, perm)
+        dvb = lax.ppermute(dvb, axis_name, perm)
+        return dq, kb, vb, dkb, dvb
+
+    zero = jnp.zeros(q.shape, jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, n, step, (zero, k, v, jnp.zeros_like(zero), jnp.zeros_like(zero)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash_core.defvjp(_ring_flash_core_fwd, _ring_flash_core_bwd)
+
+
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         block_q=512, block_k=512):
+    """Ring attention with the Pallas flash kernel as the per-block
+    engine: each ring step runs the O(T) online-softmax kernel on the
+    resident KV block and partial results merge by logsumexp — so the
+    per-device inner loop is MXU-tiled VMEM compute instead of a dense
+    [Tl, Tl] XLA einsum, while KV blocks rotate on ICI exactly as in
+    `ring_attention`.
+
+    Differentiable: a custom VJP rotates the KV blocks around the ring a
+    second time with towed gradient accumulators, running the Pallas
+    dq/dkv kernels per resident block against the saved final logsumexp.
+
+    Causality is resolved at BLOCK granularity with lax.cond (the kernel's
+    causal flag is compile-time): a device's own block runs the causal
+    kernel, blocks from earlier ranks run the plain kernel, later ranks
+    contribute nothing. Falls back to `ring_attention` off-TPU or for
+    shapes the kernel refuses.
+
+    Call inside shard_map(..., check_vma=False) — pallas_call does not
+    declare varying-mesh-axes metadata (same requirement as
+    parallel/pipeline.py).
+    """
+    from paddle_tpu.core.flags import get_flag
+    from paddle_tpu.ops.pallas import on_tpu
+    b, h, tl, d = q.shape
+    if not ((on_tpu() or get_flag("pallas_interpret"))
+            and d % 64 == 0 and tl % 8 == 0):
+        return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    return _ring_flash_core(q, k, v, axis_name, causal, scale, block_q,
+                            block_k)
 
 
 def ulysses_attention(q, k, v, axis_name, attention_fn=None, causal=False):

@@ -164,14 +164,18 @@ def sensitivity(eval_fn, params, pattern=r"conv.*weight",
 def sensitive_prune_ratios(sens, max_loss=0.05):
     """Per-layer ratios from sensitivity curves (ref
     SensitivePruneStrategy._get_best_ratios): for each param pick the
-    LARGEST measured ratio whose metric-loss fraction stays within
-    `max_loss` (0.0 when even the smallest ratio exceeds it)."""
+    LARGEST ratio reachable before the curve first exceeds `max_loss`
+    (0.0 when even the smallest ratio exceeds it). The scan stops at the
+    first violation — sensitivity curves are not always monotone, and a
+    later in-budget ratio past an observed degradation spike is not
+    trustworthy (matches the reference strategy's monotone assumption)."""
     out = {}
     for name, curve in sens.items():
         best = 0.0
         for ratio in sorted(curve):
-            if curve[ratio] <= max_loss:
-                best = ratio
+            if curve[ratio] > max_loss:
+                break
+            best = ratio
         out[name] = best
     return out
 

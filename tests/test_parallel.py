@@ -452,6 +452,42 @@ class TestRingFlashAttention:
             set_flags({"pallas_interpret": False})
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_matches_dense_ring(self, causal):
+        # the custom ring VJP (rotating Pallas dq/dkv with towed
+        # accumulators) must match autodiff through the dense ring
+        from paddle_tpu.core.flags import set_flags
+        from paddle_tpu.parallel.ring_attention import (ring_attention,
+                                                        ring_flash_attention)
+        key = jax.random.key(2)
+        kq, kk, kv, kw = jax.random.split(key, 4)
+        shape = (1, 2, 8 * 16, 64)
+        q = jax.random.normal(kq, shape, jnp.float32)
+        k = jax.random.normal(kk, shape, jnp.float32)
+        v = jax.random.normal(kv, shape, jnp.float32)
+        w = jax.random.normal(kw, shape, jnp.float32)
+        mesh = pt.parallel.make_mesh({"sp": 8})
+
+        def make_loss(fn):
+            body = lambda a, b_, c, w_: jax.lax.psum(
+                jnp.sum(fn(a, b_, c, "sp", causal=causal) * w_), "sp")
+            f = shard_map(body, mesh=mesh,
+                          in_specs=(P(None, None, "sp", None),) * 4,
+                          out_specs=P(), check_vma=False)
+            return lambda q_, k_, v_: f(q_, k_, v_, w)
+
+        grads_ref = jax.grad(make_loss(ring_attention),
+                             argnums=(0, 1, 2))(q, k, v)
+        set_flags({"pallas_interpret": True})
+        try:
+            grads = jax.grad(make_loss(ring_flash_attention),
+                             argnums=(0, 1, 2))(q, k, v)
+        finally:
+            set_flags({"pallas_interpret": False})
+        for g, gr in zip(grads, grads_ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                       rtol=2e-3, atol=2e-3)
+
     def test_falls_back_off_tpu(self):
         # without the interpret flag on CPU the flash ring must silently
         # route to the dense ring (same numbers)

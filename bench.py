@@ -71,17 +71,32 @@ def _timed_steps(step_once, steps):
 
 
 def bench_bert(steps, batch, seq, use_flash=False):
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    cfg = BertConfig.base()
+    return _bench_mlm(BertForPretraining, cfg, "bert_base", steps, batch,
+                      seq, use_flash)
+
+
+def bench_ernie(steps, batch, seq, use_flash=False):
+    """ERNIE 1.0 pretraining step (BASELINE.md target row). Architecturally
+    BERT-base with knowledge masking; the training step is the same
+    MXU-dominated MLM+NSP compute, so it shares the harness."""
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
+    cfg = ErnieConfig.base()
+    return _bench_mlm(ErnieForPretraining, cfg, "ernie_1.0", steps, batch,
+                      seq, use_flash)
+
+
+def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
-    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
-                                        pretrain_loss)
+    from paddle_tpu.models.bert import pretrain_loss
 
-    cfg = BertConfig.base()
     cfg.dropout = 0.0  # bench the compute path
     cfg.use_flash = use_flash
     cfg.max_position = max(cfg.max_position, seq)
-    model = BertForPretraining(cfg)
+    model = model_cls(cfg)
     variables = model.init(jax.random.key(0))
     params = variables["params"]
 
@@ -124,7 +139,7 @@ def bench_bert(steps, batch, seq, use_flash=False):
     achieved = flops_per_step / dt if flops_per_step else 0.0
     mfu = achieved / peak_flops()
     return {
-        "metric": "bert_base_tokens_per_sec_per_chip",
+        "metric": f"{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "mfu": round(mfu, 4),
@@ -316,6 +331,70 @@ def bench_resnet(steps, batch):
     }
 
 
+def bench_ctr(steps, batch):
+    """DeepFM CTR through the sparse-row pull-push path (BASELINE.md
+    "DeepFM / Wide&Deep CTR" target row; ref dist_ctr.py's
+    embedding+pserver workload). Criteo-shaped: 26 sparse slots, 13 dense,
+    100k hash per slot. Bandwidth/gather-bound by design — examples/s is
+    the headline number, MFU is reported for completeness only."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.ctr import (CTRConfig, DeepFM,
+                                       make_sparse_deepfm_train_step)
+    from paddle_tpu.parallel.sparse import SparseTable
+
+    cfg = CTRConfig(num_sparse_fields=26, num_dense_fields=13,
+                    vocab_size=100_000, embed_dim=16, hidden=(400, 400, 400))
+    model = DeepFM(cfg, sparse_tables=True)
+    params = model.init(jax.random.key(0))["params"]
+    opt = pt.optimizer.Adam(1e-3)
+    opt_state = opt.init(params)
+    vtot = cfg.vocab_size * cfg.num_sparse_fields
+    embed_tbl = SparseTable(vtot, cfg.embed_dim, pt.optimizer.Adagrad(0.05))
+    linear_tbl = SparseTable(vtot, 1, pt.optimizer.Adagrad(0.05))
+    emb_st = embed_tbl.init(jax.random.key(1))
+    lin_st = linear_tbl.init(jax.random.key(2))
+
+    rng = np.random.RandomState(0)
+    dense = jnp.asarray(rng.rand(batch, cfg.num_dense_fields)
+                        .astype(np.float32))
+    sparse_ids = jnp.asarray(rng.randint(
+        0, cfg.vocab_size, (batch, cfg.num_sparse_fields), dtype=np.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (batch, 1), dtype=np.int32)
+                         .astype(np.float32))
+
+    raw_step = make_sparse_deepfm_train_step(model, opt, embed_tbl,
+                                             linear_tbl)
+    jitted = jax.jit(raw_step, donate_argnums=(0, 1, 2, 3))
+    flops_per_step = _cost_flops(jitted, params, opt_state, emb_st, lin_st,
+                                 dense, sparse_ids, labels)
+    loss, params, opt_state, emb_st, lin_st = jitted(
+        params, opt_state, emb_st, lin_st, dense, sparse_ids, labels)
+    _ = float(loss)
+
+    st = {"p": params, "o": opt_state, "e": emb_st, "l": lin_st}
+
+    def step_once():
+        loss, st["p"], st["o"], st["e"], st["l"] = jitted(
+            st["p"], st["o"], st["e"], st["l"], dense, sparse_ids, labels)
+        return loss
+
+    dt, loss_v = _timed_steps(step_once, steps)
+    achieved = flops_per_step / dt if flops_per_step else 0.0
+    mfu = achieved / peak_flops()
+    return {
+        "metric": "deepfm_ctr_examples_per_sec_per_chip",
+        "value": round(batch / dt, 1),
+        "unit": "examples/s/chip",
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": loss_v,
+        "note": "sparse pull-push path; gather/bandwidth-bound, "
+                "examples/s is the headline",
+    }
+
+
 def _run_inner(args):
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
@@ -331,6 +410,11 @@ def _run_inner(args):
         res = bench_transformer(args.steps, args.batch or 32, seq)
     elif args.model == "gpt":
         res = bench_gpt(args.steps, args.batch or 16, args.seq)
+    elif args.model == "ernie":
+        res = bench_ernie(args.steps, args.batch or 64, args.seq,
+                          use_flash=args.flash)
+    elif args.model == "ctr":
+        res = bench_ctr(args.steps, args.batch or 512)
     else:
         res = bench_resnet(args.steps, args.batch or 128)
     res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
@@ -358,10 +442,72 @@ def _probe(timeout_s):
     return False, (proc.stdout.strip()[-300:] or f"probe rc={proc.returncode}")
 
 
+# suite order: cheapest compile first, so at least one row lands inside
+# the driver's window even on a slow tunnel; flagship (bert) right after
+_SUITE = ["ctr", "bert", "resnet50", "gpt", "transformer_big", "ernie"]
+
+
+def _run_suite(args, deadline):
+    """Run every bench row in its own child process, emitting each result
+    JSON line the moment it completes; finish by re-emitting the flagship
+    row augmented with a compact suite summary (the driver parses the last
+    line; humans read them all)."""
+    import subprocess
+    per_model_cap = float(os.environ.get("PT_BENCH_TIMEOUT", "240"))
+    extra = ["--steps", str(args.steps), "--seq", str(args.seq)]
+    if args.batch:
+        extra += ["--batch", str(args.batch)]
+    if not args.flash:
+        extra += ["--no-flash"]
+    rows = {}
+    for model in _SUITE:
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            print(f"suite: wall budget exhausted before {model}",
+                  file=sys.stderr)
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--model", model, *extra, "--_inner"],
+                stdout=subprocess.PIPE, text=True,
+                timeout=min(per_model_cap, remaining - 10))
+        except subprocess.TimeoutExpired:
+            print(f"suite: {model} timed out", file=sys.stderr)
+            continue
+        res = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+                if isinstance(cand, dict) and "metric" in cand:
+                    res = cand
+                    break
+            except ValueError:
+                continue
+        if res is None:
+            print(f"suite: {model} failed: "
+                  f"{proc.stdout.strip()[-300:] or proc.returncode}",
+                  file=sys.stderr)
+            continue
+        rows[model] = res
+        print(json.dumps(res), flush=True)
+    if not rows:
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0, "error": "no suite row completed"}))
+        return
+    flag = rows.get("bert") or next(iter(rows.values()))
+    summary = dict(flag)
+    summary["suite"] = {m: {"value": r["value"], "unit": r["unit"],
+                            "mfu": r.get("mfu")} for m, r in rows.items()}
+    print(json.dumps(summary), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="bert",
-                    choices=["bert", "resnet50", "transformer_big", "gpt"])
+    ap.add_argument("--model", default="all",
+                    choices=["all", "bert", "resnet50", "transformer_big",
+                             "gpt", "ernie", "ctr"])
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)
@@ -390,6 +536,9 @@ def main():
             "metric": "bench_failed", "value": 0.0, "unit": "error",
             "vs_baseline": 0.0,
             "error": f"TPU aliveness probe failed: {probe_detail}"}))
+        return
+    if args.model == "all":
+        _run_suite(args, deadline)
         return
     attempts = int(os.environ.get("PT_BENCH_ATTEMPTS", "2"))
     per_attempt_cap = float(os.environ.get("PT_BENCH_TIMEOUT", "240"))

@@ -1,46 +1,28 @@
-// pt_predictor — standalone C++ serving runtime over the PJRT C API.
-//
-// TPU-native counterpart of the reference's Python-free inference stack:
-// /root/reference/paddle/fluid/inference/api/analysis_predictor.h (load model
-// → optimize → NaiveExecutor) and paddle/fluid/train (pure-C++ training
-// demo). There, the engine interprets a ProgramDesc op-by-op with hand-
-// registered kernels; here the exported artifact is a StableHLO module
-// (written by paddle_tpu.io.save_inference_model) compiled once by the
-// PJRT plugin (libtpu.so on TPU hosts, CPU plugin elsewhere) — XLA is the
-// analysis+optimization pipeline.
-//
-// Artifact layout (<dir>/):
-//   model.stablehlo   MLIR module (text or bytecode)
-//   params.bin        framework binary params (written by export; format
-//                     below) — params are leading arguments of the program
-//   signature.json    input shapes/dtypes (informational here)
-//
-// params.bin format (little-endian):
-//   magic "PTPB" | uint32 version | uint32 n_tensors
-//   per tensor: uint32 dtype (PJRT_Buffer_Type) | uint32 ndim |
-//               int64 dims[ndim] | uint64 nbytes | bytes
+// pt_predictor CLI — thin wrapper over the pt_predictor library
+// (pt_predictor.h; the reference's paddle_api.h:204 as a linkable API).
 //
 // Usage:
 //   pt_predictor --model_dir <dir> --plugin <pjrt_plugin.so> \
-//                [--iters N] [--warmup N]
-// Feeds zero-filled buffers for the non-param inputs listed in the
-// signature; prints per-iteration latency stats. Exits 2 when no plugin is
-// available (so CI can compile-and-smoke-test the artifact path everywhere).
+//                [--iters N] [--warmup N] [--train] [--dump_outputs F]
+//
+// Modes:
+//   (default)        latency bench: Run() with the artifact's example
+//                    inputs (inputs.bin), p50/p99 over --iters
+//   --train          training loop via TrainStep (save_train_program
+//                    artifacts: outputs [loss, state...] fed back)
+//   --dump_outputs F one Run(), outputs written to F as PTPB (tests diff
+//                    C++ serving against the Python forward)
+//   no --plugin      artifact validate only, exit 2 (CI without a device)
 
-#include <dlfcn.h>
-
-#include <algorithm>
 #include <chrono>
-#include <cstdint>
 #include <cstdio>
-#include <cstring>
-#include <fstream>
+#include <cstdlib>
+#include <algorithm>
 #include <numeric>
-#include <sstream>
 #include <string>
 #include <vector>
 
-#include "xla/pjrt/c/pjrt_c_api.h"
+#include "pt_predictor.h"
 
 namespace {
 
@@ -49,125 +31,7 @@ namespace {
   exit(code);
 }
 
-void CheckErr(const PJRT_Api* api, PJRT_Error* err, const char* what) {
-  if (!err) return;
-  PJRT_Error_Message_Args margs;
-  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
-  margs.extension_start = nullptr;
-  margs.error = err;
-  api->PJRT_Error_Message(&margs);
-  std::string msg(margs.message, margs.message_size);
-  PJRT_Error_Destroy_Args dargs;
-  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-  dargs.extension_start = nullptr;
-  dargs.error = err;
-  api->PJRT_Error_Destroy(&dargs);
-  Die(std::string(what) + ": " + msg);
-}
-
-std::string ReadFileOrDie(const std::string& path) {
-  std::ifstream f(path, std::ios::binary);
-  if (!f) Die("cannot open " + path);
-  std::ostringstream ss;
-  ss << f.rdbuf();
-  return ss.str();
-}
-
-struct HostTensor {
-  uint32_t dtype;  // PJRT_Buffer_Type
-  std::vector<int64_t> dims;
-  std::vector<uint8_t> data;
-};
-
-std::vector<HostTensor> LoadParams(const std::string& path) {
-  std::string blob = ReadFileOrDie(path);
-  const uint8_t* p = reinterpret_cast<const uint8_t*>(blob.data());
-  const uint8_t* end = p + blob.size();
-  auto need = [&](size_t n) {
-    if (p + n > end) Die("params.bin truncated");
-  };
-  need(12);
-  if (memcmp(p, "PTPB", 4) != 0) Die("params.bin bad magic");
-  p += 4;
-  uint32_t version, n;
-  memcpy(&version, p, 4); p += 4;
-  memcpy(&n, p, 4); p += 4;
-  if (version != 1) Die("params.bin unsupported version");
-  std::vector<HostTensor> out(n);
-  for (uint32_t i = 0; i < n; ++i) {
-    need(8);
-    uint32_t dtype, ndim;
-    memcpy(&dtype, p, 4); p += 4;
-    memcpy(&ndim, p, 4); p += 4;
-    out[i].dtype = dtype;
-    out[i].dims.resize(ndim);
-    need(8 * ndim + 8);
-    memcpy(out[i].dims.data(), p, 8 * ndim); p += 8 * ndim;
-    uint64_t nbytes;
-    memcpy(&nbytes, p, 8); p += 8;
-    need(nbytes);
-    out[i].data.assign(p, p + nbytes);
-    p += nbytes;
-  }
-  return out;
-}
-
-PJRT_Buffer* ToDevice(const PJRT_Api* api, PJRT_Client* client,
-                      PJRT_Device* device, const HostTensor& t) {
-  PJRT_Client_BufferFromHostBuffer_Args args;
-  memset(&args, 0, sizeof(args));
-  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-  args.client = client;
-  args.data = t.data.data();
-  args.type = static_cast<PJRT_Buffer_Type>(t.dtype);
-  args.dims = t.dims.data();
-  args.num_dims = t.dims.size();
-  args.host_buffer_semantics =
-      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-  args.device = device;
-  CheckErr(api, api->PJRT_Client_BufferFromHostBuffer(&args),
-           "BufferFromHostBuffer");
-  if (args.done_with_host_buffer) {
-    PJRT_Event_Await_Args eargs;
-    memset(&eargs, 0, sizeof(eargs));
-    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-    eargs.event = args.done_with_host_buffer;
-    CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(h2d)");
-    PJRT_Event_Destroy_Args dargs;
-    memset(&dargs, 0, sizeof(dargs));
-    dargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-    dargs.event = args.done_with_host_buffer;
-    api->PJRT_Event_Destroy(&dargs);
-  }
-  return args.buffer;
-}
-
-void WritePTPB(const std::string& path,
-               const std::vector<HostTensor>& tensors) {
-  std::ofstream f(path, std::ios::binary);
-  if (!f) Die("cannot write " + path);
-  f.write("PTPB", 4);
-  uint32_t version = 1, n = static_cast<uint32_t>(tensors.size());
-  f.write(reinterpret_cast<const char*>(&version), 4);
-  f.write(reinterpret_cast<const char*>(&n), 4);
-  for (const auto& t : tensors) {
-    uint32_t ndim = static_cast<uint32_t>(t.dims.size());
-    f.write(reinterpret_cast<const char*>(&t.dtype), 4);
-    f.write(reinterpret_cast<const char*>(&ndim), 4);
-    f.write(reinterpret_cast<const char*>(t.dims.data()), 8 * ndim);
-    uint64_t nbytes = t.data.size();
-    f.write(reinterpret_cast<const char*>(&nbytes), 8);
-    f.write(reinterpret_cast<const char*>(t.data.data()),
-            static_cast<std::streamsize>(nbytes));
-  }
-}
-
 }  // namespace
-
-bool FileExists(const std::string& path) {
-  std::ifstream f(path, std::ios::binary);
-  return static_cast<bool>(f);
-}
 
 int main(int argc, char** argv) {
   std::string model_dir, plugin_path, dump_outputs;
@@ -191,218 +55,33 @@ int main(int argc, char** argv) {
   }
   if (model_dir.empty()) Die("--model_dir is required");
 
-  // Artifact load + validation happens before plugin resolution so the
-  // artifact path is testable on machines without a PJRT plugin.
-  // Train artifacts (save_train_program) feed outputs 1..n back into
-  // inputs 0..n-1 each iteration (the C++ train loop of
-  // /root/reference/paddle/fluid/train, minus the per-op interpreter).
-  std::string mlir = ReadFileOrDie(model_dir + "/model.stablehlo");
-  std::vector<HostTensor> params = LoadParams(model_dir + "/params.bin");
-  std::vector<HostTensor> extra_inputs;
-  if (FileExists(model_dir + "/inputs.bin")) {
-    extra_inputs = LoadParams(model_dir + "/inputs.bin");
-  }
-  if (train && !FileExists(model_dir + "/inputs.bin")) {
+  // One Create: the library reads+validates the artifact before touching
+  // the plugin, so with an empty plugin_path this is the validate-only
+  // mode (testable on machines without a PJRT plugin) and with a plugin
+  // the same artifact load proceeds straight to compile — no double read
+  // of a potentially multi-GB params.bin.
+  std::string err;
+  pt::PredictorConfig cfg;
+  cfg.model_dir = model_dir;
+  cfg.plugin_path = plugin_path;
+  auto pred = pt::Predictor::Create(cfg, &err);
+  if (!pred) Die(err);
+  if (train && pred->num_fixed_inputs() == 0)
     Die("--train needs an inputs.bin (export via save_train_program)");
-  }
-  fprintf(stderr, "loaded model (%zu bytes MLIR, %zu params, %zu inputs%s)\n",
-          mlir.size(), params.size(), extra_inputs.size(),
+  fprintf(stderr, "loaded model (%zu params, %zu inputs%s)\n",
+          pred->num_params(), pred->num_fixed_inputs(),
           train ? ", train mode" : "");
-
   if (plugin_path.empty()) {
     fprintf(stderr, "no --plugin given (libtpu.so on TPU hosts); artifact "
                     "validated, exiting\n");
     return 2;
   }
-  void* lib = dlopen(plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
-  if (!lib) Die(std::string("dlopen failed: ") + dlerror());
-  using GetApiFn = const PJRT_Api* (*)();
-  auto get_api = reinterpret_cast<GetApiFn>(dlsym(lib, "GetPjrtApi"));
-  if (!get_api) Die("plugin has no GetPjrtApi symbol");
-  const PJRT_Api* api = get_api();
-
-  // -- client --
-  PJRT_Client_Create_Args cargs;
-  memset(&cargs, 0, sizeof(cargs));
-  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
-  CheckErr(api, api->PJRT_Client_Create(&cargs), "Client_Create");
-  PJRT_Client* client = cargs.client;
-
-  PJRT_Client_AddressableDevices_Args devargs;
-  memset(&devargs, 0, sizeof(devargs));
-  devargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
-  devargs.client = client;
-  CheckErr(api, api->PJRT_Client_AddressableDevices(&devargs),
-           "AddressableDevices");
-  if (devargs.num_addressable_devices == 0) Die("no addressable devices");
-  PJRT_Device* device = devargs.addressable_devices[0];
-
-  // -- compile (XLA = the whole analysis/optimization pipeline) --
-  PJRT_Program program;
-  memset(&program, 0, sizeof(program));
-  program.struct_size = PJRT_Program_STRUCT_SIZE;
-  program.code = mlir.data();
-  program.code_size = mlir.size();
-  static const char kFormat[] = "mlir";
-  program.format = kFormat;
-  program.format_size = sizeof(kFormat) - 1;
-
-  PJRT_Client_Compile_Args comp;
-  memset(&comp, 0, sizeof(comp));
-  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
-  comp.client = client;
-  comp.program = &program;
-  static const char kOpts[] = "";
-  comp.compile_options = kOpts;
-  comp.compile_options_size = 0;
-  CheckErr(api, api->PJRT_Client_Compile(&comp), "Compile");
-  PJRT_LoadedExecutable* exe = comp.executable;
-
-  // -- stage params once (weights live on device across calls, like the
-  //    reference predictor's persistable scope); batch inputs after them --
-  std::vector<PJRT_Buffer*> arg_bufs;
-  for (const auto& t : params) arg_bufs.push_back(ToDevice(api, client, device, t));
-  const size_t n_state = arg_bufs.size();
-  for (const auto& t : extra_inputs)
-    arg_bufs.push_back(ToDevice(api, client, device, t));
-
-  PJRT_ExecuteOptions opts;
-  memset(&opts, 0, sizeof(opts));
-  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-
-  // Query output arity.
-  PJRT_LoadedExecutable_GetExecutable_Args gexe;
-  memset(&gexe, 0, sizeof(gexe));
-  gexe.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
-  gexe.loaded_executable = exe;
-  CheckErr(api, api->PJRT_LoadedExecutable_GetExecutable(&gexe),
-           "GetExecutable");
-  PJRT_Executable_NumOutputs_Args nout;
-  memset(&nout, 0, sizeof(nout));
-  nout.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
-  nout.executable = gexe.executable;
-  CheckErr(api, api->PJRT_Executable_NumOutputs(&nout), "NumOutputs");
-
-  std::vector<PJRT_Buffer*> outputs(nout.num_outputs);
-  PJRT_Buffer** output_list = outputs.data();
-  PJRT_Buffer* const* arg_list = arg_bufs.data();
-
-  auto destroy_buffer = [&](PJRT_Buffer* b) {
-    if (!b) return;
-    PJRT_Buffer_Destroy_Args bd;
-    memset(&bd, 0, sizeof(bd));
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.buffer = b;
-    api->PJRT_Buffer_Destroy(&bd);
-  };
-
-  auto execute = [&]() {
-    PJRT_LoadedExecutable_Execute_Args ex;
-    memset(&ex, 0, sizeof(ex));
-    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-    ex.executable = exe;
-    ex.options = &opts;
-    ex.argument_lists = &arg_list;
-    ex.num_devices = 1;
-    ex.num_args = arg_bufs.size();
-    ex.output_lists = &output_list;
-    PJRT_Event* done = nullptr;
-    ex.device_complete_events = &done;
-    CheckErr(api, api->PJRT_LoadedExecutable_Execute(&ex), "Execute");
-    PJRT_Event_Await_Args eargs;
-    memset(&eargs, 0, sizeof(eargs));
-    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-    eargs.event = done;
-    CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(exec)");
-    PJRT_Event_Destroy_Args edargs;
-    memset(&edargs, 0, sizeof(edargs));
-    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-    edargs.event = done;
-    api->PJRT_Event_Destroy(&edargs);
-  };
-
-  auto buffer_dtype = [&](PJRT_Buffer* b) -> PJRT_Buffer_Type {
-    PJRT_Buffer_ElementType_Args et;
-    memset(&et, 0, sizeof(et));
-    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
-    et.buffer = b;
-    CheckErr(api, api->PJRT_Buffer_ElementType(&et), "ElementType");
-    return et.type;
-  };
-
-  auto await_and_free = [&](PJRT_Event* ev) {
-    if (!ev) return;
-    PJRT_Event_Await_Args eargs;
-    memset(&eargs, 0, sizeof(eargs));
-    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
-    eargs.event = ev;
-    CheckErr(api, api->PJRT_Event_Await(&eargs), "Event_Await(d2h)");
-    PJRT_Event_Destroy_Args edargs;
-    memset(&edargs, 0, sizeof(edargs));
-    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
-    edargs.event = ev;
-    api->PJRT_Event_Destroy(&edargs);
-  };
-
-  auto read_scalar_f32 = [&](PJRT_Buffer* b) -> float {
-    // dtype-checked: an AMP-exported loss could be bf16 — misreading 4 raw
-    // bytes as f32 would report garbage, so fail loudly instead.
-    PJRT_Buffer_Type ty = buffer_dtype(b);
-    if (ty != PJRT_Buffer_Type_F32)
-      Die("train loss output must be f32, got PJRT_Buffer_Type " +
-          std::to_string(static_cast<int>(ty)) +
-          " (cast the loss to float32 before export)");
-    float v = 0.0f;
-    PJRT_Buffer_ToHostBuffer_Args th;
-    memset(&th, 0, sizeof(th));
-    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-    th.src = b;
-    th.dst = &v;
-    th.dst_size = sizeof(v);
-    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
-    await_and_free(th.event);
-    return v;
-  };
-
-  auto buffer_to_host = [&](PJRT_Buffer* b) -> HostTensor {
-    HostTensor t;
-    t.dtype = static_cast<uint32_t>(buffer_dtype(b));
-    PJRT_Buffer_Dimensions_Args da;
-    memset(&da, 0, sizeof(da));
-    da.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
-    da.buffer = b;
-    CheckErr(api, api->PJRT_Buffer_Dimensions(&da), "Dimensions");
-    t.dims.assign(da.dims, da.dims + da.num_dims);
-    PJRT_Buffer_ToHostBuffer_Args th;
-    memset(&th, 0, sizeof(th));
-    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-    th.src = b;
-    th.dst = nullptr;  // size query
-    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer(size)");
-    t.data.resize(th.dst_size);
-    th.dst = t.data.data();
-    CheckErr(api, api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer");
-    await_and_free(th.event);
-    return t;
-  };
 
   if (train) {
-    // Training loop: outputs = [loss, new_state...]; state outputs replace
-    // the leading state inputs each iteration.
-    if (outputs.size() < 1 + n_state)
-      Die("train program must output [loss, state...]");
     auto t0 = std::chrono::steady_clock::now();
     float loss = 0.0f;
     for (int i = 0; i < iters; ++i) {
-      execute();
-      loss = read_scalar_f32(outputs[0]);
-      destroy_buffer(outputs[0]);
-      for (size_t j = 0; j < n_state; ++j) {
-        destroy_buffer(arg_bufs[j]);
-        arg_bufs[j] = outputs[1 + j];
-      }
-      for (size_t j = 1 + n_state; j < outputs.size(); ++j)
-        destroy_buffer(outputs[j]);
+      if (!pred->TrainStep(&loss, &err)) Die(err);
       if (i == 0 || (i + 1) % 10 == 0 || i + 1 == iters)
         fprintf(stderr, "iter %d loss %.6f\n", i + 1, loss);
     }
@@ -415,32 +94,31 @@ int main(int argc, char** argv) {
     return 0;
   }
 
+  // Serving modes feed the artifact's example inputs (inputs.bin).
+  std::vector<pt::Tensor> inputs;
+  if (!pt::LoadPTPB(model_dir + "/inputs.bin", &inputs, &err))
+    inputs.clear();  // zero-input programs are fine
+
   if (!dump_outputs.empty()) {
-    // one execution, outputs to PTPB — lets tests diff C++ serving output
-    // against the Python forward numerically (ref:
-    // inference/tests/api/ per-model accuracy regressions).
-    execute();
-    std::vector<HostTensor> host_outs;
-    for (auto* b : outputs) {
-      host_outs.push_back(buffer_to_host(b));
-      destroy_buffer(b);
-    }
-    WritePTPB(dump_outputs, host_outs);
+    std::vector<pt::Tensor> outs;
+    if (!pred->Run(inputs, &outs, &err)) Die(err);
+    if (!pt::SavePTPB(dump_outputs, outs, &err)) Die(err);
     printf("{\"mode\": \"dump\", \"outputs\": %zu, \"path\": \"%s\"}\n",
-           host_outs.size(), dump_outputs.c_str());
+           outs.size(), dump_outputs.c_str());
     return 0;
   }
 
-  auto run_once = [&]() {
-    execute();
-    for (auto* b : outputs) destroy_buffer(b);
-  };
-
-  for (int i = 0; i < warmup; ++i) run_once();
+  // End-to-end serving latency: each timed Run() includes the input H2D
+  // upload and the full output D2H fetch — what a caller of the library
+  // actually waits for (earlier revisions timed device execution only;
+  // numbers are not comparable across that change).
+  std::vector<pt::Tensor> outs;
+  for (int i = 0; i < warmup; ++i)
+    if (!pred->Run(inputs, &outs, &err)) Die(err);
   std::vector<double> lat_ms;
   for (int i = 0; i < iters; ++i) {
     auto t0 = std::chrono::steady_clock::now();
-    run_once();
+    if (!pred->Run(inputs, &outs, &err)) Die(err);
     auto t1 = std::chrono::steady_clock::now();
     lat_ms.push_back(
         std::chrono::duration<double, std::milli>(t1 - t0).count());
@@ -449,7 +127,7 @@ int main(int argc, char** argv) {
   double mean = std::accumulate(lat_ms.begin(), lat_ms.end(), 0.0) /
                 lat_ms.size();
   printf("{\"iters\": %d, \"mean_ms\": %.3f, \"p50_ms\": %.3f, "
-         "\"p99_ms\": %.3f}\n",
+         "\"p99_ms\": %.3f, \"transfers_included\": true}\n",
          iters, mean, lat_ms[lat_ms.size() / 2],
          lat_ms[static_cast<size_t>(lat_ms.size() * 0.99)]);
   return 0;

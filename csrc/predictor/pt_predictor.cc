@@ -1,0 +1,496 @@
+// pt_predictor library implementation — PJRT C API plumbing.
+//
+// Ref parity: paddle_api.h:204 PaddlePredictor (Run with host tensors,
+// weights resident across calls), analysis_predictor.h:47 (create-from-dir).
+// Design notes in pt_predictor.h.
+//
+// params.bin / PTPB format (little-endian):
+//   magic "PTPB" | uint32 version(=1) | uint32 n_tensors
+//   per tensor: uint32 dtype (PJRT_Buffer_Type) | uint32 ndim |
+//               int64 dims[ndim] | uint64 nbytes | bytes
+
+#include "pt_predictor.h"
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace pt {
+namespace {
+
+bool ReadFile(const std::string& path, std::string* out, std::string* error) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    if (error) *error = "cannot open " + path;
+    return false;
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+bool FileExists(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  return static_cast<bool>(f);
+}
+
+}  // namespace
+
+bool LoadPTPB(const std::string& path, std::vector<Tensor>* out,
+              std::string* error) {
+  std::string blob;
+  if (!ReadFile(path, &blob, error)) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(blob.data());
+  const uint8_t* end = p + blob.size();
+  // file-supplied sizes are untrusted: every check compares against the
+  // REMAINING byte count (never `p + n`, which can overflow the pointer),
+  // so a corrupt header cannot drive a huge copy or allocation
+  auto need = [&](uint64_t nb) {
+    return nb <= static_cast<uint64_t>(end - p);
+  };
+  if (!need(12) || memcmp(p, "PTPB", 4) != 0) {
+    if (error) *error = path + ": bad PTPB magic";
+    return false;
+  }
+  p += 4;
+  uint32_t version, n;
+  memcpy(&version, p, 4); p += 4;
+  memcpy(&n, p, 4); p += 4;
+  if (version != 1) {
+    if (error) *error = path + ": unsupported PTPB version";
+    return false;
+  }
+  // each tensor needs >= 16 header bytes — an n larger than that bound is
+  // corrupt, and rejecting it keeps assign() from throwing bad_alloc
+  if (!need(uint64_t{16} * n)) {
+    if (error) *error = path + ": PTPB tensor count exceeds file size";
+    return false;
+  }
+  out->assign(n, Tensor{});
+  for (uint32_t i = 0; i < n; ++i) {
+    Tensor& t = (*out)[i];
+    if (!need(8)) goto truncated;
+    uint32_t ndim;
+    memcpy(&t.dtype, p, 4); p += 4;
+    memcpy(&ndim, p, 4); p += 4;
+    if (!need(uint64_t{8} * ndim + 8)) goto truncated;
+    t.dims.resize(ndim);
+    memcpy(t.dims.data(), p, 8 * size_t{ndim}); p += 8 * size_t{ndim};
+    uint64_t nbytes;
+    memcpy(&nbytes, p, 8); p += 8;
+    if (!need(nbytes)) goto truncated;
+    t.data.assign(p, p + nbytes);
+    p += nbytes;
+  }
+  return true;
+truncated:
+  if (error) *error = path + ": PTPB truncated";
+  return false;
+}
+
+bool SavePTPB(const std::string& path, const std::vector<Tensor>& tensors,
+              std::string* error) {
+  std::ofstream f(path, std::ios::binary);
+  if (!f) {
+    if (error) *error = "cannot write " + path;
+    return false;
+  }
+  f.write("PTPB", 4);
+  uint32_t version = 1, n = static_cast<uint32_t>(tensors.size());
+  f.write(reinterpret_cast<const char*>(&version), 4);
+  f.write(reinterpret_cast<const char*>(&n), 4);
+  for (const auto& t : tensors) {
+    uint32_t ndim = static_cast<uint32_t>(t.dims.size());
+    f.write(reinterpret_cast<const char*>(&t.dtype), 4);
+    f.write(reinterpret_cast<const char*>(&ndim), 4);
+    f.write(reinterpret_cast<const char*>(t.dims.data()), 8 * ndim);
+    uint64_t nbytes = t.data.size();
+    f.write(reinterpret_cast<const char*>(&nbytes), 8);
+    f.write(reinterpret_cast<const char*>(t.data.data()),
+            static_cast<std::streamsize>(nbytes));
+  }
+  return static_cast<bool>(f);
+}
+
+struct Predictor::Impl {
+  // artifact
+  std::string mlir;
+  std::vector<Tensor> params;
+  std::vector<Tensor> fixed_inputs;
+
+  // runtime (null when created without a plugin)
+  void* lib = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_Device* device = nullptr;
+  PJRT_LoadedExecutable* exe = nullptr;
+  size_t n_outputs = 0;
+  std::vector<PJRT_Buffer*> state_bufs;  // staged params, device-resident
+
+  ~Impl() {
+    // minimal plugins (the repo's pycpu_pjrt) implement only the execute
+    // path — every teardown entry point is null-checked, and the plugin
+    // .so itself is never dlclosed (it may embed a CPython interpreter
+    // whose threads do not survive unload; the OS reclaims at exit)
+    for (auto* b : state_bufs) DestroyBuffer(b);
+    if (exe && api && api->PJRT_LoadedExecutable_Destroy) {
+      PJRT_LoadedExecutable_Destroy_Args d;
+      memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+      d.executable = exe;
+      api->PJRT_LoadedExecutable_Destroy(&d);
+    }
+    if (client && api && api->PJRT_Client_Destroy) {
+      PJRT_Client_Destroy_Args d;
+      memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+      d.client = client;
+      api->PJRT_Client_Destroy(&d);
+    }
+  }
+
+  // Convert a PJRT_Error to a message (destroying it); false when err set.
+  bool Check(PJRT_Error* err, const char* what, std::string* error) {
+    if (!err) return true;
+    PJRT_Error_Message_Args margs;
+    margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    margs.extension_start = nullptr;
+    margs.error = err;
+    api->PJRT_Error_Message(&margs);
+    if (error)
+      *error = std::string(what) + ": " +
+               std::string(margs.message, margs.message_size);
+    PJRT_Error_Destroy_Args dargs;
+    dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    dargs.extension_start = nullptr;
+    dargs.error = err;
+    api->PJRT_Error_Destroy(&dargs);
+    return false;
+  }
+
+  void DestroyBuffer(PJRT_Buffer* b) {
+    if (!b || !api || !api->PJRT_Buffer_Destroy) return;
+    PJRT_Buffer_Destroy_Args bd;
+    memset(&bd, 0, sizeof(bd));
+    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd.buffer = b;
+    api->PJRT_Buffer_Destroy(&bd);
+  }
+
+  bool AwaitAndFree(PJRT_Event* ev, const char* what, std::string* error) {
+    if (!ev) return true;
+    PJRT_Event_Await_Args eargs;
+    memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = ev;
+    bool ok = Check(api->PJRT_Event_Await(&eargs), what, error);
+    PJRT_Event_Destroy_Args edargs;
+    memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = ev;
+    api->PJRT_Event_Destroy(&edargs);
+    return ok;
+  }
+
+  PJRT_Buffer* ToDevice(const Tensor& t, std::string* error) {
+    PJRT_Client_BufferFromHostBuffer_Args args;
+    memset(&args, 0, sizeof(args));
+    args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    args.client = client;
+    args.data = t.data.data();
+    args.type = static_cast<PJRT_Buffer_Type>(t.dtype);
+    args.dims = t.dims.data();
+    args.num_dims = t.dims.size();
+    args.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    args.device = device;
+    if (!Check(api->PJRT_Client_BufferFromHostBuffer(&args),
+               "BufferFromHostBuffer", error))
+      return nullptr;
+    if (!AwaitAndFree(args.done_with_host_buffer, "Event_Await(h2d)", error)) {
+      DestroyBuffer(args.buffer);
+      return nullptr;
+    }
+    return args.buffer;
+  }
+
+  bool Execute(const std::vector<PJRT_Buffer*>& args_in,
+               std::vector<PJRT_Buffer*>* outputs, std::string* error) {
+    outputs->assign(n_outputs, nullptr);
+    PJRT_Buffer** output_list = outputs->data();
+    PJRT_Buffer* const* arg_list = args_in.data();
+    PJRT_ExecuteOptions opts;
+    memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_LoadedExecutable_Execute_Args ex;
+    memset(&ex, 0, sizeof(ex));
+    ex.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ex.executable = exe;
+    ex.options = &opts;
+    ex.argument_lists = &arg_list;
+    ex.num_devices = 1;
+    ex.num_args = args_in.size();
+    ex.output_lists = &output_list;
+    PJRT_Event* done = nullptr;
+    ex.device_complete_events = &done;
+    if (!Check(api->PJRT_LoadedExecutable_Execute(&ex), "Execute", error))
+      return false;
+    return AwaitAndFree(done, "Event_Await(exec)", error);
+  }
+
+  bool BufferDtype(PJRT_Buffer* b, PJRT_Buffer_Type* ty, std::string* error) {
+    PJRT_Buffer_ElementType_Args et;
+    memset(&et, 0, sizeof(et));
+    et.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+    et.buffer = b;
+    if (!Check(api->PJRT_Buffer_ElementType(&et), "ElementType", error))
+      return false;
+    *ty = et.type;
+    return true;
+  }
+
+  bool BufferToHost(PJRT_Buffer* b, Tensor* t, std::string* error) {
+    PJRT_Buffer_Type ty;
+    if (!BufferDtype(b, &ty, error)) return false;
+    t->dtype = static_cast<uint32_t>(ty);
+    PJRT_Buffer_Dimensions_Args da;
+    memset(&da, 0, sizeof(da));
+    da.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    da.buffer = b;
+    if (!Check(api->PJRT_Buffer_Dimensions(&da), "Dimensions", error))
+      return false;
+    t->dims.assign(da.dims, da.dims + da.num_dims);
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = b;
+    th.dst = nullptr;  // size query
+    if (!Check(api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer(size)",
+               error))
+      return false;
+    t->data.resize(th.dst_size);
+    th.dst = t->data.data();
+    if (!Check(api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer", error))
+      return false;
+    return AwaitAndFree(th.event, "Event_Await(d2h)", error);
+  }
+};
+
+Predictor::Predictor() : impl_(new Impl) {}
+Predictor::~Predictor() = default;
+
+std::unique_ptr<Predictor> Predictor::Create(const PredictorConfig& cfg,
+                                             std::string* error) {
+  std::unique_ptr<Predictor> pred(new Predictor());
+  Impl* im = pred->impl_.get();
+  if (!ReadFile(cfg.model_dir + "/model.stablehlo", &im->mlir, error))
+    return nullptr;
+  if (!LoadPTPB(cfg.model_dir + "/params.bin", &im->params, error))
+    return nullptr;
+  if (FileExists(cfg.model_dir + "/inputs.bin") &&
+      !LoadPTPB(cfg.model_dir + "/inputs.bin", &im->fixed_inputs, error))
+    return nullptr;
+  if (cfg.plugin_path.empty()) return pred;  // validate-only mode
+
+  im->lib = dlopen(cfg.plugin_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (!im->lib) {
+    if (error) *error = std::string("dlopen failed: ") + dlerror();
+    return nullptr;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(im->lib, "GetPjrtApi"));
+  if (!get_api) {
+    if (error) *error = "plugin has no GetPjrtApi symbol";
+    return nullptr;
+  }
+  im->api = get_api();
+
+  PJRT_Client_Create_Args cargs;
+  memset(&cargs, 0, sizeof(cargs));
+  cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (!im->Check(im->api->PJRT_Client_Create(&cargs), "Client_Create", error))
+    return nullptr;
+  im->client = cargs.client;
+
+  PJRT_Client_AddressableDevices_Args devargs;
+  memset(&devargs, 0, sizeof(devargs));
+  devargs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  devargs.client = im->client;
+  if (!im->Check(im->api->PJRT_Client_AddressableDevices(&devargs),
+                 "AddressableDevices", error))
+    return nullptr;
+  if (static_cast<size_t>(cfg.device_ordinal) >=
+      devargs.num_addressable_devices) {
+    if (error)
+      *error = "device_ordinal " + std::to_string(cfg.device_ordinal) +
+               " out of range (" +
+               std::to_string(devargs.num_addressable_devices) + " devices)";
+    return nullptr;
+  }
+  im->device = devargs.addressable_devices[cfg.device_ordinal];
+
+  PJRT_Program program;
+  memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = im->mlir.data();
+  program.code_size = im->mlir.size();
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+  PJRT_Client_Compile_Args comp;
+  memset(&comp, 0, sizeof(comp));
+  comp.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  comp.client = im->client;
+  comp.program = &program;
+  static const char kOpts[] = "";
+  comp.compile_options = kOpts;
+  comp.compile_options_size = 0;
+  if (!im->Check(im->api->PJRT_Client_Compile(&comp), "Compile", error))
+    return nullptr;
+  im->exe = comp.executable;
+
+  PJRT_LoadedExecutable_GetExecutable_Args gexe;
+  memset(&gexe, 0, sizeof(gexe));
+  gexe.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  gexe.loaded_executable = im->exe;
+  if (!im->Check(im->api->PJRT_LoadedExecutable_GetExecutable(&gexe),
+                 "GetExecutable", error))
+    return nullptr;
+  PJRT_Executable_NumOutputs_Args nout;
+  memset(&nout, 0, sizeof(nout));
+  nout.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  nout.executable = gexe.executable;
+  if (!im->Check(im->api->PJRT_Executable_NumOutputs(&nout), "NumOutputs",
+                 error))
+    return nullptr;
+  im->n_outputs = nout.num_outputs;
+
+  // Stage params once: weights stay device-resident across Run calls (the
+  // reference predictor's persistable scope).
+  im->state_bufs.reserve(im->params.size());
+  for (const auto& t : im->params) {
+    PJRT_Buffer* b = im->ToDevice(t, error);
+    if (!b) return nullptr;
+    im->state_bufs.push_back(b);
+  }
+  return pred;
+}
+
+bool Predictor::Run(const std::vector<Tensor>& inputs,
+                    std::vector<Tensor>* outputs, std::string* error) {
+  Impl* im = impl_.get();
+  if (!im->exe) {
+    if (error) *error = "predictor created without a plugin (no device)";
+    return false;
+  }
+  // only the param slots — after a TrainStep the updated weights live
+  // there, and any staged train fixed-inputs must not leak into serving
+  std::vector<PJRT_Buffer*> args(
+      im->state_bufs.begin(), im->state_bufs.begin() + im->params.size());
+  std::vector<PJRT_Buffer*> transient;
+  bool ok = true;
+  for (const auto& t : inputs) {
+    PJRT_Buffer* b = im->ToDevice(t, error);
+    if (!b) { ok = false; break; }
+    transient.push_back(b);
+    args.push_back(b);
+  }
+  std::vector<PJRT_Buffer*> out_bufs;
+  if (ok) ok = im->Execute(args, &out_bufs, error);
+  if (ok && outputs) {
+    outputs->assign(out_bufs.size(), Tensor{});
+    for (size_t i = 0; ok && i < out_bufs.size(); ++i)
+      ok = im->BufferToHost(out_bufs[i], &(*outputs)[i], error);
+  }
+  for (auto* b : out_bufs) im->DestroyBuffer(b);
+  for (auto* b : transient) im->DestroyBuffer(b);
+  return ok;
+}
+
+bool Predictor::TrainStep(float* loss, std::string* error) {
+  Impl* im = impl_.get();
+  if (!im->exe) {
+    if (error) *error = "predictor created without a plugin (no device)";
+    return false;
+  }
+  if (im->fixed_inputs.empty()) {
+    if (error)
+      *error = "not a train artifact (no inputs.bin — export via "
+               "save_train_program)";
+    return false;
+  }
+  // Stage fixed inputs lazily on first step; they are reused afterwards.
+  // On a mid-loop upload failure the partial pushes are rolled back so a
+  // retry re-stages from scratch instead of executing with wrong arity.
+  if (im->state_bufs.size() == im->params.size() &&
+      !im->fixed_inputs.empty()) {
+    const size_t base = im->state_bufs.size();
+    for (const auto& t : im->fixed_inputs) {
+      PJRT_Buffer* b = im->ToDevice(t, error);
+      if (!b) {
+        while (im->state_bufs.size() > base) {
+          im->DestroyBuffer(im->state_bufs.back());
+          im->state_bufs.pop_back();
+        }
+        return false;
+      }
+      im->state_bufs.push_back(b);
+    }
+  }
+  const size_t n_state = im->params.size();
+  if (im->n_outputs < 1 + n_state) {
+    if (error) *error = "train program must output [loss, state...]";
+    return false;
+  }
+  std::vector<PJRT_Buffer*> out_bufs;
+  if (!im->Execute(im->state_bufs, &out_bufs, error)) return false;
+  // loss (dtype-checked: an AMP-exported bf16 loss misread as f32 would
+  // report garbage — fail loudly instead)
+  PJRT_Buffer_Type ty;
+  bool ok = im->BufferDtype(out_bufs[0], &ty, error);
+  if (ok && ty != PJRT_Buffer_Type_F32) {
+    if (error)
+      *error = "train loss output must be f32 (cast before export), got "
+               "PJRT_Buffer_Type " + std::to_string(static_cast<int>(ty));
+    ok = false;
+  }
+  if (ok && loss) {
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = out_bufs[0];
+    th.dst = loss;
+    th.dst_size = sizeof(float);
+    ok = im->Check(im->api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer",
+                   error) &&
+         im->AwaitAndFree(th.event, "Event_Await(d2h)", error);
+  }
+  im->DestroyBuffer(out_bufs[0]);
+  if (ok) {
+    // new state replaces the device-resident state in place
+    for (size_t j = 0; j < n_state; ++j) {
+      im->DestroyBuffer(im->state_bufs[j]);
+      im->state_bufs[j] = out_bufs[1 + j];
+    }
+    for (size_t j = 1 + n_state; j < out_bufs.size(); ++j)
+      im->DestroyBuffer(out_bufs[j]);
+  } else {
+    for (size_t j = 1; j < out_bufs.size(); ++j)
+      im->DestroyBuffer(out_bufs[j]);
+  }
+  return ok;
+}
+
+size_t Predictor::num_params() const { return impl_->params.size(); }
+size_t Predictor::num_fixed_inputs() const {
+  return impl_->fixed_inputs.size();
+}
+size_t Predictor::num_outputs() const { return impl_->n_outputs; }
+bool Predictor::has_device() const { return impl_->exe != nullptr; }
+
+}  // namespace pt

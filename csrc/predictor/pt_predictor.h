@@ -1,0 +1,100 @@
+// pt_predictor — embeddable C++ inference library over the PJRT C API.
+//
+// TPU-native counterpart of the reference's linkable predictor API:
+//   /root/reference/paddle/fluid/inference/api/paddle_api.h:204
+//     (PaddlePredictor::Run / CreatePaddlePredictor)
+//   /root/reference/paddle/fluid/inference/api/analysis_predictor.h:47
+//     (AnalysisPredictor: load dir → optimize → execute, weights resident)
+// There, the engine interprets a ProgramDesc with hand-registered kernels;
+// here the artifact is a StableHLO module (paddle_tpu.io.save_inference_model)
+// compiled once by a PJRT plugin (libtpu.so on TPU hosts; the repo's
+// pycpu_pjrt CPU plugin in CI) — XLA is the analysis/optimization pipeline.
+//
+// Lifecycle (mirrors CreatePaddlePredictor → Run → destroy):
+//   pt::PredictorConfig cfg;
+//   cfg.model_dir = "/path/to/export";     // model.stablehlo + params.bin
+//   cfg.plugin_path = "/path/libtpu.so";
+//   std::string err;
+//   auto pred = pt::Predictor::Create(cfg, &err);       // compiles, stages
+//   if (!pred) { /* err */ }                            //   params on device
+//   std::vector<pt::Tensor> outs;
+//   pred->Run(inputs, &outs, &err);        // weights stay device-resident
+//
+// Thread-safety: a Predictor is NOT thread-safe; create one per thread or
+// serialize calls (same contract as the reference's predictor — it offers
+// Clone() for the per-thread case).
+//
+// All entry points report failures via the std::string* error out-param and
+// a false/nullptr return — the library never exits or throws.
+
+#ifndef PT_PREDICTOR_H_
+#define PT_PREDICTOR_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace pt {
+
+// Host tensor (the ZeroCopyTensor analog, paddle_api.h PaddleTensor):
+// dtype is a PJRT_Buffer_Type value (e.g. 11 = F32, 4 = S32 — see
+// pjrt_c_api.h); dims are row-major; data is the raw little-endian bytes.
+struct Tensor {
+  uint32_t dtype = 0;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct PredictorConfig {
+  std::string model_dir;    // dir containing model.stablehlo + params.bin
+                            // (+ inputs.bin for train artifacts)
+  std::string plugin_path;  // PJRT plugin .so; empty = artifact-validate only
+  int device_ordinal = 0;   // index into the plugin's addressable devices
+};
+
+// PTPB container IO (format doc in pt_predictor.cc): the parameter/input
+// serialization shared by the Python exporter, the CLI and the tests.
+bool LoadPTPB(const std::string& path, std::vector<Tensor>* out,
+              std::string* error);
+bool SavePTPB(const std::string& path, const std::vector<Tensor>& tensors,
+              std::string* error);
+
+class Predictor {
+ public:
+  // Compile the artifact and stage its parameters on the device. Returns
+  // nullptr with *error set on failure. With cfg.plugin_path empty the
+  // artifact is loaded+validated but no device exists: Run/TrainStep fail,
+  // the artifact accessors below work (the CLI's validate-only mode).
+  static std::unique_ptr<Predictor> Create(const PredictorConfig& cfg,
+                                           std::string* error);
+  ~Predictor();
+
+  // Serving call: executes the program on [staged params..., inputs...],
+  // fetches every program output to the host. Input count/shapes/dtypes
+  // must match the exported signature.
+  bool Run(const std::vector<Tensor>& inputs, std::vector<Tensor>* outputs,
+           std::string* error);
+
+  // One training step over a save_train_program artifact: executes on
+  // [state..., fixed inputs (inputs.bin)...]; program outputs are
+  // [loss, new_state...]; the new state replaces the device-resident state
+  // in place (the reference's C++ train loop, paddle/fluid/train).
+  bool TrainStep(float* loss, std::string* error);
+
+  // Artifact facts.
+  size_t num_params() const;
+  size_t num_fixed_inputs() const;   // inputs.bin entries (train artifacts)
+  size_t num_outputs() const;        // program output arity (0 until Create
+                                     //   compiled with a plugin)
+  bool has_device() const;
+
+ private:
+  Predictor();
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace pt
+
+#endif  // PT_PREDICTOR_H_

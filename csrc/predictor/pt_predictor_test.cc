@@ -1,0 +1,74 @@
+// Link test for the pt_predictor LIBRARY from a separate translation unit
+// (the embeddability check the reference guarantees via paddle_api.h:204 —
+// a deployment links the predictor, it does not shell out to a CLI).
+//
+// Serves an exported artifact through a PJRT plugin twice over one
+// Predictor (device-resident params reused), diffs the two runs, and
+// exercises the validate-only mode + error paths. Driven by
+// tests/test_native.py with the pycpu_pjrt CPU plugin.
+//
+// Usage: pt_predictor_test <model_dir> <plugin.so> [out.ptpb]
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pt_predictor.h"
+
+namespace {
+
+int Fail(const std::string& msg) {
+  fprintf(stderr, "pt_predictor_test: FAIL: %s\n", msg.c_str());
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) return Fail("usage: pt_predictor_test DIR PLUGIN [OUT]");
+  std::string model_dir = argv[1], plugin = argv[2];
+  std::string out_path = argc > 3 ? argv[3] : "";
+  std::string err;
+
+  // validate-only mode: artifact facts without a device
+  pt::PredictorConfig vcfg;
+  vcfg.model_dir = model_dir;
+  auto probe = pt::Predictor::Create(vcfg, &err);
+  if (!probe) return Fail("validate-only Create: " + err);
+  if (probe->has_device()) return Fail("validate-only has a device?");
+  std::vector<pt::Tensor> dummy_out;
+  if (probe->Run({}, &dummy_out, &err))
+    return Fail("Run without device must fail");
+  if (err.find("plugin") == std::string::npos)
+    return Fail("no-device error should mention the plugin: " + err);
+
+  // real predictor: create-from-dir, compile, stage params
+  pt::PredictorConfig cfg;
+  cfg.model_dir = model_dir;
+  cfg.plugin_path = plugin;
+  auto pred = pt::Predictor::Create(cfg, &err);
+  if (!pred) return Fail("Create: " + err);
+  if (!pred->has_device()) return Fail("expected a device");
+
+  std::vector<pt::Tensor> inputs;
+  if (!pt::LoadPTPB(model_dir + "/inputs.bin", &inputs, &err))
+    return Fail("LoadPTPB(inputs.bin): " + err);
+
+  std::vector<pt::Tensor> out1, out2;
+  if (!pred->Run(inputs, &out1, &err)) return Fail("Run#1: " + err);
+  if (!pred->Run(inputs, &out2, &err)) return Fail("Run#2: " + err);
+  if (out1.empty() || out1.size() != pred->num_outputs())
+    return Fail("output arity mismatch");
+  for (size_t i = 0; i < out1.size(); ++i) {
+    if (out1[i].data != out2[i].data)
+      return Fail("run-to-run outputs differ (param staging broken?)");
+  }
+
+  if (!out_path.empty() && !pt::SavePTPB(out_path, out1, &err))
+    return Fail("SavePTPB: " + err);
+
+  printf("{\"ok\": true, \"outputs\": %zu, \"params\": %zu}\n",
+         out1.size(), pred->num_params());
+  return 0;
+}

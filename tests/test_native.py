@@ -319,6 +319,39 @@ class TestPredictorEndToEnd:
                  if l.startswith("iter 1 ")][0]
         assert res["final_loss"] < first, (first, res)
 
+    def test_library_link_serving(self, plugin, tmp_path):
+        """The LIBRARY surface (pt_predictor.h, ref paddle_api.h:204):
+        pt_predictor_test is a separate translation unit linking
+        libptpredictor — Create-from-dir, two Run() calls over the same
+        staged params (must agree), outputs must match the Python
+        forward."""
+        plugin, penv = plugin
+        import paddle_tpu as pt
+        from paddle_tpu.io.inference import read_params_bin
+        from paddle_tpu.models.mnist import MLP
+
+        binary = os.path.join(REPO, "csrc", "build", "pt_predictor_test")
+        if not os.path.exists(binary):
+            pytest.skip("pt_predictor_test not built")
+        model = MLP(num_classes=10, in_dim=32)
+        v = model.init(jax.random.key(0))
+        x = jnp.asarray(np.random.RandomState(0).rand(4, 32), jnp.float32)
+
+        def fwd(p, xx):
+            return model.apply({"params": p, "state": {}}, xx)
+
+        path = str(tmp_path / "export")
+        pt.io.save_inference_model(path, fwd, (x,), v["params"])
+        expected = np.asarray(fwd(v["params"], x))
+        dump = str(tmp_path / "outs.ptpb")
+        r = subprocess.run([binary, path, plugin, dump],
+                           capture_output=True, text=True, timeout=420,
+                           env=penv)
+        assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+        assert '"ok": true' in r.stdout
+        outs = read_params_bin(dump)
+        np.testing.assert_allclose(outs[0], expected, rtol=2e-2, atol=2e-2)
+
     def test_int8_serving_outputs_match(self, plugin, tmp_path):
         """int8 artifact (real int8 weights in params.bin) served by the
         C++ predictor matches the frozen-model Python forward."""

@@ -33,10 +33,13 @@ from paddle_tpu.parallel.planner import DistributionPlan, DistributionPlanner
 from paddle_tpu.parallel.sparse import HostTable, SparseTable
 from paddle_tpu.parallel.elastic import ElasticRunner
 from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet
-from paddle_tpu.parallel.communicator import (GeoSGD, GradientMerge, LocalSGD,
-                                              stack_replicas, unstack_replica)
+from paddle_tpu.parallel.communicator import (DCASGD, GeoSGD, GradientMerge,
+                                              LocalSGD, stack_replicas,
+                                              unstack_replica)
 from paddle_tpu.parallel.heartbeat import (FileHeartbeat, HeartBeatMonitor,
-                                           barrier_with_timeout)
+                                           KVHeartbeat, KVMonitor,
+                                           PeerFailureError,
+                                           barrier_with_timeout, kv_barrier)
 from paddle_tpu.parallel.mesh import (
     DP, EP, FSDP, PP, SP, TP,
     data_parallel_mesh,

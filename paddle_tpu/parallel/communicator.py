@@ -1,4 +1,5 @@
-"""Gradient-communication schedules: gradient merge, Local SGD, Geo-SGD.
+"""Gradient-communication schedules: gradient merge, Local SGD, Geo-SGD,
+DC-ASGD.
 
 Ref: /root/reference/paddle/fluid/operators/distributed/communicator.h:276
 (AsyncCommunicator — background threads merging grads before send) and :323
@@ -120,6 +121,57 @@ class LocalSGD:
             lambda p: p, params)
         since = jnp.where(do_sync, 0, since)
         return loss, params, {"inner": inner, "since_sync": since}, aux
+
+
+class DCASGD:
+    """Delay-compensated async SGD (ref: transpiler/distribute_transpiler.py:174
+    — the `dc_asgd` transpiler mode where the pserver applies each late
+    gradient compensated for its staleness; Zheng et al. 2017). The
+    compensation is the diagonal curvature surrogate:
+
+        g_comp = g + lambda * g ⊙ g ⊙ (w_server − w_stale)
+
+    i.e. a first-order correction of the stale gradient toward the value
+    it would have had at the server's CURRENT weights.
+
+    TPU-first redesign: no pserver thread — staleness is modeled
+    functionally under `shard_map` with divergent dp replicas (like
+    LocalSGD/GeoSGD): each group trains on its last PULLED copy (stale for
+    up to `pull_steps` steps) while the shared anchor (= the pserver copy)
+    integrates every group's compensated gradient each step; groups re-pull
+    the anchor every `pull_steps` steps. `lambda_=0` degrades to plain
+    async SGD — the convergence tests compare against exactly that.
+    """
+
+    def __init__(self, lr, pull_steps, lambda_=1.0, axis_name="dp"):
+        self.lr = lr
+        self.pull_steps = pull_steps
+        self.lambda_ = lambda_
+        self.axis_name = axis_name
+
+    def init(self, params):
+        return {"anchor": params,
+                "since_pull": jnp.zeros((), jnp.int32)}
+
+    def step(self, loss_fn, params, state, *args, **kwargs):
+        """One async round under shard_map: gradient at the stale local
+        copy, compensated server update, periodic pull."""
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, *args, **kwargs)
+        anchor = state["anchor"]
+        comp = _tmap(
+            lambda g, a, p: g + self.lambda_ * g * g * (a - p),
+            grads, anchor, params)
+        mean_comp = _tmap(lambda d: lax.pcast(
+            lax.pmean(d, self.axis_name), self.axis_name, to="varying"),
+            comp)
+        anchor = _tmap(lambda a, d: a - self.lr * d, anchor, mean_comp)
+        since = state["since_pull"] + 1
+        do_pull = since >= self.pull_steps
+        params = lax.cond(do_pull, lambda o: o[1], lambda o: o[0],
+                          (params, anchor))
+        since = jnp.where(do_pull, 0, since)
+        return loss, params, {"anchor": anchor, "since_pull": since}, aux
 
 
 class GeoSGD:

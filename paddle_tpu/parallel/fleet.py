@@ -39,6 +39,9 @@ class DistributedStrategy:
     gradient_merge_steps: int = 1
     local_sgd_steps: int = 0     # >0: LocalSGD with this sync period
     geo_sgd_steps: int = 0       # >0: Geo-SGD delta sync period
+    dc_asgd_steps: int = 0       # >0: DC-ASGD with this pull period
+    dc_asgd_lambda: float = 1.0  # delay-compensation strength
+    dc_asgd_lr: float = 0.0      # server lr (0 -> optimizer's lr attr)
     dgc: bool = False            # top-k compressed grads
     dgc_sparsity: float = 0.99
 
@@ -108,8 +111,11 @@ class Fleet:
         shard_map)."""
         strategy = strategy or self._strategy or DistributedStrategy()
         self._strategy = strategy
-        enforce(not (strategy.local_sgd_steps and strategy.geo_sgd_steps),
-                "local_sgd_steps and geo_sgd_steps are mutually exclusive")
+        enforce(sum(bool(x) for x in (strategy.local_sgd_steps,
+                                      strategy.geo_sgd_steps,
+                                      strategy.dc_asgd_steps)) <= 1,
+                "local_sgd_steps / geo_sgd_steps / dc_asgd_steps are "
+                "mutually exclusive")
         if strategy.dgc:
             from paddle_tpu.optimizer.wrappers import DGCMomentum
             enforce(isinstance(optimizer, DGCMomentum),
@@ -131,6 +137,14 @@ class Fleet:
             return LocalSGD(optimizer, strategy.local_sgd_steps)
         if strategy.geo_sgd_steps:
             return GeoSGD(optimizer, strategy.geo_sgd_steps)
+        if strategy.dc_asgd_steps:
+            from paddle_tpu.parallel.communicator import DCASGD
+            lr = strategy.dc_asgd_lr
+            if not lr:  # optimizer.lr is a schedule; sample its step-0 value
+                sched = getattr(optimizer, "lr", None)
+                lr = float(sched(0)) if callable(sched) else 0.01
+            return DCASGD(lr, strategy.dc_asgd_steps,
+                          lambda_=strategy.dc_asgd_lambda)
         return optimizer
 
     # -- convenience: one-call data-parallel trainer --

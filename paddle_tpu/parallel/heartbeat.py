@@ -8,10 +8,12 @@ FLAGS_rpc_retry_times).
 
 TPU-first: XLA collectives have no per-message deadline — liveness is
 tracked out-of-band. `HeartBeatMonitor` is in-process (thread) fed by worker
-pings; `FileHeartbeat` extends it across processes via mtime files on a
-shared dir (the typical multi-host TPU pod setup), replacing the reference's
-grad-arrival sniffing. `barrier_with_timeout` is the bounded-wait barrier
-the RPC layer's batch barriers provided.
+pings; `KVHeartbeat`/`KVMonitor` ride the jax.distributed coordination
+service (the DCN control fabric every multi-host job already has — no
+shared filesystem needed, skew-free sequence-change ages, bounded set
+retries); `FileHeartbeat` remains for single-host shared-dir setups.
+`kv_barrier`/`barrier_with_timeout` are the bounded-wait barriers the RPC
+layer's batch barriers provided.
 """
 
 import os
@@ -129,6 +131,158 @@ class FileHeartbeat:
                 age = now - os.path.getmtime(p)
                 out[w] = (STALLED if age > timeout_s else RUNNING, age)
         return out
+
+
+def _kv_client():
+    """The jax.distributed coordination-service client — the DCN control
+    fabric every multi-host job already has (launch.init_distributed).
+    This is the transport the reference's HeartBeatMonitor rode the RPC
+    layer for; no shared filesystem is required."""
+    from jax._src import distributed
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:
+        from paddle_tpu.core.enforce import EnforceError
+        raise EnforceError(
+            "jax.distributed is not initialized — call "
+            "paddle_tpu.parallel.launch.init_distributed() first "
+            "(KV heartbeat rides the coordination service)")
+    return client
+
+
+def _kv_set(client, key, value, retries=3, backoff_s=0.1):
+    """Set with bounded retries (ref FLAGS_rpc_retry_times semantics)."""
+    last = None
+    for attempt in range(retries):
+        try:
+            try:
+                client.key_value_set(key, value, allow_overwrite=True)
+            except TypeError:  # older jaxlib: no allow_overwrite kwarg
+                try:
+                    client.key_value_delete(key)
+                except Exception:
+                    pass
+                client.key_value_set(key, value)
+            return
+        except Exception as e:  # transient coordination-service failure
+            last = e
+            time.sleep(backoff_s * (2 ** attempt))
+    raise last
+
+
+class PeerFailureError(RuntimeError):
+    """The coordination service itself reported a dead/crashed task — the
+    transport's connection-level liveness fired before any heartbeat
+    timeout. This IS failure detection (just without per-worker
+    attribution); elastic controllers treat it like a stall of unknown
+    rank."""
+
+
+def _kv_try_get(client, key):
+    try:
+        return client.key_value_try_get(key)
+    except Exception as e:
+        if "NOT_FOUND" in str(e) or isinstance(e, KeyError):
+            return None  # key absent: worker not started yet
+        raise PeerFailureError(
+            f"coordination service error while reading '{key}' — a peer "
+            f"task likely died (connection-level detection): {e}") from e
+
+
+class KVHeartbeat:
+    """Worker side of the DCN heartbeat: ping() bumps a sequence number in
+    the jax.distributed KV store under `<tag>/worker_<i>`.
+
+    The monitor (KVMonitor) tracks when it FIRST SAW each sequence change
+    with its own clock, so cross-host clock skew never enters the age
+    computation — the skew-free analog of the reference pserver observing
+    grad arrival times (heart_beat_monitor.h:38 Update on recv)."""
+
+    def __init__(self, worker, tag="hb", client=None, retries=3):
+        self.worker = worker
+        self.key = f"{tag}/worker_{worker}"
+        self.client = client if client is not None else _kv_client()
+        self.retries = retries
+        self._seq = 0
+
+    def ping(self):
+        self._seq += 1
+        _kv_set(self.client, self.key, f"{self._seq}:{RUNNING}",
+                retries=self.retries)
+
+    def complete(self):
+        self._seq += 1
+        _kv_set(self.client, self.key, f"{self._seq}:{COMPLETED}",
+                retries=self.retries)
+
+
+class KVMonitor:
+    """Monitor side: scan() reads every worker's key and flags RUNNING
+    workers whose sequence number has not advanced within `timeout_s` as
+    STALLED (on_stall(worker, age) fires once per stall). Works from any
+    process in the job — typically rank 0, the pserver successor."""
+
+    def __init__(self, num_workers, timeout_s=None, tag="hb", client=None,
+                 on_stall=None, clock=time.monotonic):
+        self.num_workers = num_workers
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else F.get_flag("dist_heartbeat_timeout_s"))
+        self.tag = tag
+        self.client = client if client is not None else _kv_client()
+        self.on_stall = on_stall
+        self._clock = clock
+        self._seen = {}     # worker -> (seq, first_seen_time)
+        self._stalled = set()
+
+    def scan(self):
+        """Returns {worker: (state, age_s)}."""
+        now = self._clock()
+        out = {}
+        for w in range(self.num_workers):
+            raw = _kv_try_get(self.client, f"{self.tag}/worker_{w}")
+            if raw is None:
+                out[w] = (UNINITED, 0.0)
+                continue
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            seq_s, _, state = raw.partition(":")
+            seq = int(seq_s)
+            prev = self._seen.get(w)
+            if prev is None or prev[0] != seq:
+                self._seen[w] = (seq, now)
+                self._stalled.discard(w)
+            if state == COMPLETED:
+                out[w] = (COMPLETED, 0.0)
+                continue
+            age = now - self._seen[w][1]
+            if age > self.timeout_s:
+                if w not in self._stalled:
+                    self._stalled.add(w)
+                    if self.on_stall is not None:
+                        self.on_stall(w, age)
+                out[w] = (STALLED, age)
+            else:
+                out[w] = (RUNNING, age)
+        return out
+
+
+def kv_barrier(name, timeout_s=300.0, client=None):
+    """Deadline-bounded barrier on the coordination service (the RPC
+    batch_barrier with FLAGS_rpc_deadline, minus the RPC layer). Raises
+    TimeoutError for slow peers and PeerFailureError for dead ones — an
+    elastic controller keeps waiting on the former and evicts/restarts on
+    the latter (same classification as KVMonitor.scan)."""
+    client = client if client is not None else _kv_client()
+    try:
+        client.wait_at_barrier(name, int(timeout_s * 1000))
+    except Exception as e:
+        msg = str(e)
+        if "DEADLINE_EXCEEDED" in msg or "timed out" in msg.lower():
+            raise TimeoutError(
+                f"kv_barrier '{name}' timed out after {timeout_s}s: "
+                f"{msg}") from e
+        raise PeerFailureError(
+            f"kv_barrier '{name}': coordination service error — a peer "
+            f"task likely died: {msg}") from e
 
 
 def barrier_with_timeout(directory, worker, num_workers, timeout_s=300.0,

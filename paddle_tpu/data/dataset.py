@@ -104,6 +104,17 @@ class FileDataset:
         self.decode = decode or native.unpack_numpy_record
 
     def _read(self, files, num_threads):
+        # remote (gs://-like) entries are staged to the local cache at
+        # read time — the C++ reader needs real POSIX paths (ref fs.cc's
+        # download-to-tmp pattern); local paths pass through untouched.
+        # Shards download CONCURRENTLY (num_threads-wide, matching the
+        # reader's own parallelism) so first-record latency is bounded by
+        # the largest shard, not the sum.
+        from paddle_tpu.io import fs as _fs
+        if any(_fs.split_scheme(f)[0] is not None for f in files):
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=max(num_threads, 1)) as ex:
+                files = list(ex.map(_fs.ensure_local, files))
         rd = self._native.NativeRecordReader(files, num_threads=num_threads)
         try:
             for rec in rd:

@@ -1,5 +1,14 @@
-"""I/O: checkpointing + inference export (ref: python/paddle/fluid/io.py)."""
+"""I/O: checkpointing + inference export (ref: python/paddle/fluid/io.py)
++ the pluggable remote filesystem layer (ref: framework/io/fs.cc)."""
 
+from paddle_tpu.io import fs
+from paddle_tpu.io.fs import (
+    MemFS,
+    ensure_local,
+    fs_exists,
+    fs_open,
+    register_filesystem,
+)
 from paddle_tpu.io.checkpoint import (
     CheckpointManager,
     latest_step,

@@ -81,7 +81,25 @@ class CheckpointManager:
     available)."""
 
     def __init__(self, path, max_to_keep=3, save_interval_steps=1):
-        self.path = os.path.abspath(path)
+        from paddle_tpu.io import fs as _fs
+        scheme, _rest = _fs.split_scheme(path)
+        if scheme is not None:
+            # remote checkpointing (ref fs.cc hdfs_*, hdfs.py): orbax runs
+            # against a deterministic local staging dir (same path ->
+            # same staging across processes on a host, so a restarted
+            # worker restores what it staged) and every saved step is
+            # mirrored to the remote tree; restore pulls missing steps.
+            import hashlib
+            import tempfile
+            self._remote = str(path).rstrip("/")
+            self._fs = _fs
+            tag = hashlib.sha1(self._remote.encode()).hexdigest()[:16]
+            self.path = os.path.join(tempfile.gettempdir(),
+                                     "pt_ckpt_staging", tag)
+            os.makedirs(self.path, exist_ok=True)
+        else:
+            self._remote = None
+            self.path = os.path.abspath(path)
         self.max_to_keep = max_to_keep
         self.save_interval = save_interval_steps
         if _HAS_ORBAX:
@@ -93,10 +111,49 @@ class CheckpointManager:
         else:
             self._mgr = None
 
+    def _mirror_save(self, step):
+        """Push the completed step dir to the remote tree and prune remote
+        steps past the keep window (the local GC already ran)."""
+        if self._remote is None:
+            return
+        self.wait()  # the async save must be durable before mirroring
+        self._fs.put_tree(os.path.join(self.path, str(step)),
+                          f"{self._remote}/{step}")
+        local = {d for d in os.listdir(self.path) if d.isdigit()}
+        for name in self._fs.listdir(self._remote):
+            if name.isdigit() and name not in local:
+                self._fs.remove_tree(f"{self._remote}/{name}")
+
+    def _remote_steps(self):
+        if self._remote is None or not self._fs.fs_exists(self._remote):
+            return []
+        return [int(n) for n in self._fs.listdir(self._remote)
+                if n.isdigit()]
+
+    def _fetch_remote(self, step):
+        """Pull a step dir from the remote tree into staging if absent
+        locally (fresh host resuming someone else's checkpoint)."""
+        if self._remote is None:
+            return
+        local = os.path.join(self.path, str(step))
+        if not os.path.isdir(local):
+            self._fs.get_tree(f"{self._remote}/{step}", local)
+            if self._mgr is not None:
+                # orbax scanned the staging dir at construction; rebuild so
+                # it sees the newly fetched step
+                self._mgr.close()
+                self._mgr = ocp.CheckpointManager(
+                    self.path,
+                    options=ocp.CheckpointManagerOptions(
+                        max_to_keep=self.max_to_keep,
+                        save_interval_steps=self.save_interval))
+
     def save(self, step, state):
         if self._mgr is not None:
-            self._mgr.save(step, args=ocp.args.StandardSave(state))
-            return True
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+            if saved:
+                self._mirror_save(step)
+            return saved
         if step % self.save_interval == 0:
             save_persistables(state, self.path, step)
             steps = sorted(int(d) for d in os.listdir(self.path)
@@ -104,10 +161,22 @@ class CheckpointManager:
             for old in steps[:-self.max_to_keep]:
                 import shutil
                 shutil.rmtree(os.path.join(self.path, str(old)))
+            self._mirror_save(step)
             return True
         return False
 
     def restore(self, template, step=None):
+        if step is None and self._remote is not None:
+            # the REMOTE tree is authoritative: the deterministic staging
+            # dir survives across experiments on a host, and a stale local
+            # step outranking a reset remote would silently resume the
+            # wrong run's weights
+            cand = self._remote_steps()
+            step = max(cand) if cand else None
+            if step is None:
+                return None, None
+        if step is not None:
+            self._fetch_remote(step)
         if self._mgr is not None:
             step = step if step is not None else self._mgr.latest_step()
             if step is None:

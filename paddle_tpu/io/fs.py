@@ -1,0 +1,280 @@
+"""Pluggable filesystem layer — remote/object-store IO for datasets and
+checkpoints.
+
+Ref: /root/reference/paddle/fluid/framework/io/fs.cc (localfs_* + hdfs_*
+shell commands behind one open/exists/list surface) and
+python/paddle/fluid/incubate/fleet/utils/hdfs.py (HDFSClient). The
+reference shells out to `hadoop fs`; production TPU pods read from object
+stores (gs://, s3://) instead — same need, different fabric.
+
+TPU-first shape: ONE registry keyed by URL scheme. `LocalFS` ships;
+`MemFS` is the in-process reference implementation (used by tests and as
+the template for real gs/hdfs adapters — a real adapter only implements
+the same 6 primitives). Consumers never dispatch on scheme themselves:
+
+    from paddle_tpu.io import fs
+    with fs.fs_open("gs://bucket/part-0000", "rb") as f: ...
+    local = fs.ensure_local("gs://bucket/part-0000")  # for native readers
+
+`register_filesystem("gs", MyGcsFS())` plugs in a real backend; nothing
+else in the framework changes (FileDataset and CheckpointManager go
+through this module).
+"""
+
+import os
+import shutil
+import tempfile
+import threading
+
+_REGISTRY = {}
+_LOCK = threading.Lock()
+
+
+def split_scheme(path):
+    """'gs://b/k' -> ('gs', 'b/k'); '/local/p' -> (None, '/local/p').
+
+    Windows drive letters ('C:/x') and bare relative paths have no '://'
+    and fall through to local."""
+    if "://" in str(path):
+        scheme, _, rest = str(path).partition("://")
+        return scheme, rest
+    return None, str(path)
+
+
+def register_filesystem(scheme, fs):
+    """Plug a FileSystem implementation in for a URL scheme."""
+    with _LOCK:
+        _REGISTRY[scheme] = fs
+
+
+def get_filesystem(path):
+    """(FileSystem, path) for a possibly scheme-prefixed path."""
+    scheme, _ = split_scheme(path)
+    if scheme is None:
+        return _LOCAL, path
+    with _LOCK:
+        fs = _REGISTRY.get(scheme)
+    if fs is None:
+        from paddle_tpu.core.enforce import EnforceError
+        raise EnforceError(
+            f"no filesystem registered for scheme '{scheme}://' — call "
+            f"paddle_tpu.io.fs.register_filesystem({scheme!r}, impl) "
+            "(see MemFS for the 6-primitive template)")
+    return fs, path
+
+
+class LocalFS:
+    """POSIX passthrough (ref fs.cc localfs_*)."""
+
+    def open(self, path, mode="rb"):
+        if "w" in mode or "a" in mode:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def isdir(self, path):
+        return os.path.isdir(path)
+
+    def listdir(self, path):
+        return sorted(os.listdir(path))
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+_LOCAL = LocalFS()
+
+
+class MemFS:
+    """In-process object store: the test double AND the reference
+    implementation a real remote adapter copies (same 6 primitives over a
+    flat key space with implicit directories — object-store semantics)."""
+
+    def __init__(self):
+        self._blobs = {}
+        self._lock = threading.Lock()
+
+    def _key(self, path):
+        return split_scheme(path)[1].rstrip("/")
+
+    def open(self, path, mode="rb"):
+        import io
+        k = self._key(path)
+        if "r" in mode and "w" not in mode:
+            with self._lock:
+                if k not in self._blobs:
+                    raise FileNotFoundError(path)
+                data = self._blobs[k]
+            return io.BytesIO(data) if "b" in mode else \
+                io.StringIO(data.decode())
+        fsref = self
+
+        class _Writer(io.BytesIO):
+            def close(self2):
+                with fsref._lock:
+                    fsref._blobs[k] = self2.getvalue()
+                super(_Writer, self2).close()
+
+            def __exit__(self2, *a):
+                self2.close()
+
+        if "b" not in mode:
+            class _TextWriter(io.StringIO):
+                def close(self2):
+                    with fsref._lock:
+                        fsref._blobs[k] = self2.getvalue().encode()
+                    super(_TextWriter, self2).close()
+
+                def __exit__(self2, *a):
+                    self2.close()
+            return _TextWriter()
+        return _Writer()
+
+    def exists(self, path):
+        k = self._key(path)
+        with self._lock:
+            return k in self._blobs or any(
+                b.startswith(k + "/") for b in self._blobs)
+
+    def isdir(self, path):
+        k = self._key(path)
+        with self._lock:
+            return any(b.startswith(k + "/") for b in self._blobs)
+
+    def listdir(self, path):
+        k = self._key(path)
+        pre = k + "/" if k else ""
+        with self._lock:
+            names = {b[len(pre):].split("/", 1)[0]
+                     for b in self._blobs if b.startswith(pre)}
+        return sorted(names)
+
+    def makedirs(self, path):
+        pass  # directories are implicit (object-store semantics)
+
+    def remove(self, path):
+        k = self._key(path)
+        with self._lock:
+            for b in [b for b in self._blobs
+                      if b == k or b.startswith(k + "/")]:
+                del self._blobs[b]
+
+
+def fs_open(path, mode="rb"):
+    """Open a local or scheme-prefixed path through the registry."""
+    fs, p = get_filesystem(path)
+    return fs.open(p, mode)
+
+
+def fs_exists(path):
+    fs, p = get_filesystem(path)
+    return fs.exists(p)
+
+
+_CACHE_DIR = None
+
+
+def _cache_dir():
+    global _CACHE_DIR
+    if _CACHE_DIR is None:
+        _CACHE_DIR = tempfile.mkdtemp(prefix="pt_fs_cache_")
+    return _CACHE_DIR
+
+
+def ensure_local(path, cache_dir=None):
+    """A REAL local path for `path`: identity for local paths; for remote
+    ones, download into the cache (once per path) and return the copy —
+    what the C++ native reader / orbax need. (Ref fs.cc's download-to-tmp
+    pattern in fleet utils.)
+
+    The cache is per-process by default (a mkdtemp dir; pass `cache_dir`
+    to share/persist it) and never evicts — callers staging large corpora
+    should point cache_dir at managed scratch space and `clear_cache()`
+    between epochs/datasets if disk is tight."""
+    import hashlib
+    scheme, rest = split_scheme(path)
+    if scheme is None:
+        return path
+    # collision-free key: basename for humans + full-path hash for truth
+    # ('a/b__c' and 'a/b/c' must not share a cache slot)
+    digest = hashlib.sha1(str(path).encode()).hexdigest()[:16]
+    name = os.path.basename(rest.rstrip("/")) or "blob"
+    base = os.path.join(cache_dir or _cache_dir(), scheme,
+                        f"{digest}_{name}")
+    if not os.path.exists(base):
+        fs, _ = get_filesystem(path)
+        os.makedirs(os.path.dirname(base), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(base),
+                                   prefix=name + ".")
+        try:
+            with fs.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            os.replace(tmp, base)  # atomic publish; unique tmp per caller
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+    return base
+
+
+def clear_cache():
+    """Drop the process-wide ensure_local cache directory."""
+    global _CACHE_DIR
+    if _CACHE_DIR is not None and os.path.isdir(_CACHE_DIR):
+        shutil.rmtree(_CACHE_DIR, ignore_errors=True)
+    _CACHE_DIR = None
+
+
+def put_tree(local_dir, remote_dir):
+    """Mirror a local directory tree to a (remote) destination."""
+    fs, _ = get_filesystem(remote_dir)
+    for root, _dirs, files in os.walk(local_dir):
+        rel = os.path.relpath(root, local_dir)
+        for name in files:
+            dst = remote_dir.rstrip("/") + (
+                "/" if rel == "." else f"/{rel}/") + name
+            with open(os.path.join(root, name), "rb") as src, \
+                    fs.open(dst, "wb") as out:
+                shutil.copyfileobj(src, out)
+
+
+def get_tree(remote_dir, local_dir):
+    """Mirror a (remote) directory tree into a local directory. Raises
+    FileNotFoundError when the source does not exist — a silent empty
+    mirror would poison downstream latest-step discovery."""
+    fs, p = get_filesystem(remote_dir)
+    if not fs.exists(p):
+        raise FileNotFoundError(remote_dir)
+
+    def walk(rdir, ldir):
+        os.makedirs(ldir, exist_ok=True)
+        for name in fs.listdir(rdir):
+            rpath = rdir.rstrip("/") + "/" + name
+            lpath = os.path.join(ldir, name)
+            if fs.isdir(rpath):
+                walk(rpath, lpath)
+            else:
+                with fs.open(rpath, "rb") as src, open(lpath, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
+
+    walk(remote_dir, local_dir)
+
+
+def remove_tree(path):
+    fs, p = get_filesystem(path)
+    fs.remove(p)
+
+
+def listdir(path):
+    fs, p = get_filesystem(path)
+    return fs.listdir(p)

@@ -1,0 +1,149 @@
+"""Seq2seq NMT through the composable cell/decoder protocol.
+
+Ref: the reference's machine-translation recipe built on layers/rnn.py
+(RNNCell -> BeamSearchDecoder -> dynamic_decode, rnn.py:440/791) and the
+seq2seq book example. Here: GRU encoder (nn.RNN) -> custom attention cell
+(the protocol's whole point: the decoder has never seen this cell) ->
+beam-search decode.
+
+Task: translate "copy-reverse" sequences (target = reversed source) —
+learnable in seconds on CPU, and decode quality is exactly measurable.
+
+Run: python examples/nmt_seq2seq.py [--steps 300]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+VOCAB = 20
+BOS, EOS = 1, 2
+SEQ = 6
+
+
+class AttentionGRUCell(nn.RNNCell):
+    """GRU cell + dot-product attention over the encoder outputs — a
+    CUSTOM cell (not part of the framework) driving the stock
+    BeamSearchDecoder, which is the protocol contract under test.
+    State = (h, encoder_outputs): the memory rides in the state pytree so
+    the decoder's beam-tiling handles it automatically."""
+
+    def __init__(self, emb_dim, hidden):
+        super().__init__()
+        self.hidden = hidden
+        self.gru = nn.GRUCell(emb_dim + hidden, hidden)
+        self.attn_q = nn.Linear(hidden, hidden, bias=False)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden,), (SEQ, self.hidden))
+
+    def forward(self, inputs, states):
+        h, enc = states                                  # [N,H], [N,T,H]
+        q = self.attn_q(h)                               # [N, H]
+        w = jax.nn.softmax(jnp.einsum("nh,nth->nt", q, enc), -1)
+        ctx = jnp.einsum("nt,nth->nh", w, enc)
+        out, h = self.gru(jnp.concatenate([inputs, ctx], -1), h)
+        return out, (h, enc)
+
+
+class Seq2Seq(nn.Module):
+    def __init__(self, emb_dim=32, hidden=64):
+        super().__init__()
+        self.src_emb = nn.Embedding(VOCAB, emb_dim)
+        self.tgt_emb = nn.Embedding(VOCAB, emb_dim)
+        self.encoder = nn.RNN(nn.GRUCell(emb_dim, hidden))
+        self.cell = AttentionGRUCell(emb_dim, hidden)
+        self.proj = nn.Linear(hidden, VOCAB)
+
+    def encode(self, src):
+        enc, h = self.encoder(self.src_emb(src))
+        return enc, h
+
+    def forward(self, src, tgt_in):
+        """Teacher-forced training logits [B, T, V]."""
+        enc, h = self.encode(src)
+        xs = jnp.moveaxis(self.tgt_emb(tgt_in), 1, 0)
+
+        def step(carry, x_t):
+            out, carry = self.cell(x_t, carry)
+            return carry, out
+
+        _, outs = jax.lax.scan(step, (h, enc), xs)
+        return self.proj(jnp.moveaxis(outs, 0, 1))
+
+
+def make_batch(rng, n):
+    body = rng.randint(3, VOCAB, (n, SEQ - 1))
+    src = np.concatenate([body, np.full((n, 1), EOS)], 1)
+    tgt = np.concatenate([body[:, ::-1], np.full((n, 1), EOS)], 1)
+    tgt_in = np.concatenate([np.full((n, 1), BOS), tgt[:, :-1]], 1)
+    return jnp.asarray(src), jnp.asarray(tgt_in), jnp.asarray(tgt)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--beam", type=int, default=4)
+    args = ap.parse_args()
+
+    model = Seq2Seq()
+    variables = model.init(jax.random.key(0))
+    opt = pt.optimizer.Adam(2e-3)
+    ostate = opt.init(variables["params"])
+    rng = np.random.RandomState(0)
+
+    def loss_fn(p, src, tgt_in, tgt):
+        logits = model.apply({"params": p, "state": {}}, src, tgt_in)
+        return jnp.mean(pt.ops.loss.softmax_with_cross_entropy(
+            logits, tgt[..., None]))
+
+    @jax.jit
+    def train_step(p, o, src, tgt_in, tgt):
+        l, g = jax.value_and_grad(loss_fn)(p, src, tgt_in, tgt)
+        p, o = opt.apply_gradients(p, g, o)
+        return l, p, o
+
+    t0 = time.time()
+    params = variables["params"]
+    for i in range(args.steps):
+        src, tgt_in, tgt = make_batch(rng, 64)
+        l, params, ostate = train_step(params, ostate, src, tgt_in, tgt)
+        if i % 100 == 0 or i == args.steps - 1:
+            print(f"step {i} loss {float(l):.4f}")
+    print(f"trained in {time.time() - t0:.1f}s")
+
+    # --- beam-search decode through the protocol -----------------------
+    src, _, tgt = make_batch(rng, 32)
+    full = {"params": params, "state": {}}
+    enc, h = model.apply(full, src, method="encode")
+    cell_vars = {"params": params["cell"], "state": {}}
+    dec = nn.BeamSearchDecoder(
+        model.cell, start_token=BOS, end_token=EOS, beam_size=args.beam,
+        embedding_fn=lambda tok: model.apply(
+            full, tok, method=lambda t: model.tgt_emb(t)),
+        output_fn=lambda out: model.apply(
+            full, out, method=lambda o: model.proj(o)),
+        vocab_size=VOCAB, cell_variables=cell_vars)
+    seqs, scores = nn.dynamic_decode(dec, (h, enc), max_step_num=SEQ + 2)
+    best = np.asarray(seqs)[:, 0, :SEQ]
+    acc = float((best == np.asarray(tgt)).mean())
+    print(f"beam={args.beam} token accuracy vs reference reversal: "
+          f"{acc:.3f}")
+    assert acc > 0.9, acc
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,5 +34,7 @@ from paddle_tpu.nn.layers import (
 
 from paddle_tpu.nn.heads import MultiBoxHead
 from paddle_tpu.nn.moe import MoE, top_k_gating
+from paddle_tpu.nn.rnn import (RNN, BeamSearchDecoder, Decoder, GRUCell,
+                               LSTMCell, RNNCell, dynamic_decode)
 
 Layer = Module  # reference naming alias (dygraph.Layer)

@@ -1,0 +1,272 @@
+"""Composable RNN cells + decoding protocol.
+
+Ref: /root/reference/python/paddle/fluid/layers/rnn.py:30-960 — the
+RNNCell protocol (`call(inputs, states)`, `get_initial_states`,
+`state_shape`), GRUCell:144 / LSTMCell:214, the `rnn()` driver :278, the
+Decoder protocol :391 (initialize/step/finalize), BeamSearchDecoder:440
+and dynamic_decode:791. That stack lets a user plug ANY custom cell into
+beam search; the functional twins (`ops/rnn.py` lstm/gru/beam_search_*)
+cover the fused fast path, this module restores the pluggable protocol.
+
+TPU-first: everything static-shape, `dynamic_decode` is one `lax.scan` to
+`max_step_num` with a `finished` mask (the reference's while_op +
+LoD-array writes become a masked scan); `BeamSearchDecoder.step` reuses
+the static `beam_search_step` op inside the scan.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu import initializer as I
+from paddle_tpu.nn.module import Module
+from paddle_tpu.ops.rnn import beam_search_step, gru_cell, lstm_cell
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class RNNCell(Module):
+    """Cell protocol (ref rnn.py:30 RNNCell): subclass and implement
+    `forward(inputs, states) -> (outputs, new_states)` plus `state_shape`
+    (a pytree of per-example state shapes, batch dim excluded). Any such
+    cell drives `RNN`, `BeamSearchDecoder` and `dynamic_decode`."""
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must define state_shape")
+
+    def get_initial_states(self, batch_size, dtype=jnp.float32):
+        """Zero states shaped [batch, *shape] (ref rnn.py:66). A shape
+        leaf is a tuple of ints — e.g. LSTM's ((H,), (H,)) is a pair of
+        shape leaves, GRU's (H,) a single one."""
+        def is_shape(x):
+            return isinstance(x, tuple) and \
+                all(isinstance(i, int) for i in x)
+
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros((batch_size,) + tuple(s), dtype),
+            self.state_shape, is_leaf=is_shape)
+
+
+class GRUCell(RNNCell):
+    """ref rnn.py:144 GRUCell (origin_mode False = the gru op's default)."""
+
+    def __init__(self, input_size, hidden_size, dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.param("w_ih", (input_size, 3 * hidden_size), I.xavier(), dtype)
+        self.param("w_hh", (hidden_size, 3 * hidden_size), I.xavier(), dtype)
+        self.param("b_ih", (3 * hidden_size,), I.zeros(), dtype)
+        self.param("b_hh", (3 * hidden_size,), I.zeros(), dtype)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states):
+        h = gru_cell(inputs, states, self.p("w_ih"), self.p("w_hh"),
+                     self.p("b_ih"), self.p("b_hh"))
+        return h, h
+
+
+class LSTMCell(RNNCell):
+    """ref rnn.py:214 LSTMCell; states = (h, c)."""
+
+    def __init__(self, input_size, hidden_size, forget_bias=0.0,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self.param("w_ih", (input_size, 4 * hidden_size), I.xavier(), dtype)
+        self.param("w_hh", (hidden_size, 4 * hidden_size), I.xavier(), dtype)
+        self.param("b", (4 * hidden_size,), I.zeros(), dtype)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states):
+        h, c = states
+        h, c = lstm_cell(inputs, h, c, self.p("w_ih"), self.p("w_hh"),
+                         self.p("b"), forget_bias=self.forget_bias)
+        return h, (h, c)
+
+
+class RNN(Module):
+    """Drive any RNNCell over a time axis (ref rnn.py:278 `rnn()`).
+    x: [B, T, D] -> (outputs [B, T, H...], final_states). `lengths` masks
+    padded steps (state freezes past a sequence's end, like the
+    reference's sequence_length handling)."""
+
+    def __init__(self, cell, is_reverse=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+
+    def forward(self, x, initial_states=None, lengths=None):
+        b, t = x.shape[0], x.shape[1]
+        states = (initial_states if initial_states is not None
+                  else self.cell.get_initial_states(b, x.dtype))
+        xs = jnp.moveaxis(x, 1, 0)                       # [T, B, D]
+        if self.is_reverse:
+            xs = xs[::-1]
+        steps = jnp.arange(t - 1, -1, -1) if self.is_reverse \
+            else jnp.arange(t)
+
+        def step(states, inp):
+            x_t, t_i = inp
+            out, new_states = self.cell(x_t, states)
+            if lengths is not None:
+                valid = (t_i < lengths)
+                new_states = _tmap(
+                    lambda n, o: jnp.where(
+                        valid.reshape((-1,) + (1,) * (n.ndim - 1)), n, o),
+                    new_states, states)
+                out = out * valid.reshape(
+                    (-1,) + (1,) * (out.ndim - 1)).astype(out.dtype)
+            return new_states, out
+
+        states, outs = lax.scan(step, states, (xs, steps))
+        if self.is_reverse:
+            outs = outs[::-1]
+        return jnp.moveaxis(outs, 0, 1), states
+
+
+class Decoder:
+    """Decoding protocol (ref rnn.py:391): initialize() -> (inputs,
+    states, finished); step(time, inputs, states) -> (outputs, states,
+    next_inputs, finished). Drive with `dynamic_decode`."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states):
+        """Post-process stacked per-step outputs (identity by default)."""
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over ANY RNNCell (ref rnn.py:440).
+
+    cell: an RNNCell; embedding_fn(token_ids [N]) -> [N, D] step inputs;
+    output_fn(cell_out [N, H]) -> [N, V] logits (the projection to vocab).
+    The decoder tiles every state/batch tensor to batch*beam rows and
+    reuses the static `beam_search_step` op for selection; gather of
+    parent beams rides jnp.take along the flat row axis.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn, output_fn, vocab_size, cell_variables=None):
+        self.cell = cell
+        self.cell_variables = cell_variables
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.vocab_size = vocab_size
+
+    def _run_cell(self, x, states):
+        """Invoke the cell: through apply() with its own variables when
+        given (standalone decoding), else directly (the decoder is being
+        driven inside an enclosing Module.apply, e.g. a seq2seq model
+        whose child the cell is)."""
+        if self.cell_variables is not None:
+            return self.cell.apply(self.cell_variables, x, states)
+        return self.cell(x, states)
+
+    def tile_beam(self, x):
+        """[B, ...] -> [B*K, ...] (ref tile_beam_merge_with_batch:412)."""
+        k = self.beam_size
+        return jnp.repeat(x, k, axis=0)
+
+    def initialize(self, initial_states):
+        """initial_states: per-example cell states [B, ...] (e.g. the
+        encoder's final state); they are beam-tiled here."""
+        b = jax.tree_util.tree_leaves(initial_states)[0].shape[0]
+        k = self.beam_size
+        states = _tmap(self.tile_beam, initial_states)
+        tokens = jnp.full((b * k,), self.start_token, jnp.int32)
+        # only beam 0 live at t=0 so the k copies don't fill the beam
+        scores = jnp.tile(jnp.concatenate(
+            [jnp.zeros((1,)), jnp.full((k - 1,), -1e9)]), (b,))
+        finished = jnp.zeros((b * k,), bool)
+        return tokens, (states, scores), finished
+
+    def step(self, time, inputs, states_and_scores, finished):
+        cell_states, scores = states_and_scores
+        b_k = inputs.shape[0]
+        b = b_k // self.beam_size
+        k = self.beam_size
+        out, new_states = self._run_cell(self.embedding_fn(inputs),
+                                         cell_states)
+        logp = jax.nn.log_softmax(self.output_fn(out), axis=-1)
+        tokens, new_scores, parent = beam_search_step(
+            scores.reshape(b, k), logp.reshape(b, k, self.vocab_size), k,
+            eos_id=self.end_token, done=finished.reshape(b, k))
+        flat_parent = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        new_states = _tmap(lambda s: jnp.take(s, flat_parent, axis=0),
+                           new_states)
+        next_tokens = tokens.reshape(-1)
+        finished = jnp.take(finished, flat_parent, 0) | \
+            (next_tokens == self.end_token)
+        outputs = {"token": tokens, "parent": parent}
+        return outputs, (new_states, new_scores.reshape(-1)), \
+            next_tokens, finished
+
+    def finalize(self, outputs, final_states):
+        """Backtrace parent pointers into sequences [B, K, T] + scores
+        [B, K] (ref beam_search_decode_op.cc's LoD backtrace, done as a
+        reverse scan over the stacked parents)."""
+        tokens = outputs["token"]        # [T, B, K]
+        parents = outputs["parent"]      # [T, B, K]
+        t, b, k = tokens.shape
+
+        def back(beam_idx, inp):
+            tok_t, par_t = inp
+            tok = jnp.take_along_axis(tok_t, beam_idx, axis=1)
+            beam_idx = jnp.take_along_axis(par_t, beam_idx, axis=1)
+            return beam_idx, tok
+
+        init = jnp.tile(jnp.arange(k)[None], (b, 1))
+        _, seq_rev = lax.scan(back, init, (tokens[::-1], parents[::-1]))
+        seqs = jnp.moveaxis(seq_rev[::-1], 0, 2)         # [B, K, T]
+        _, scores = final_states
+        return seqs, scores.reshape(b, k)
+
+
+def dynamic_decode(decoder, initial_states, max_step_num,
+                   return_length=False):
+    """Run a Decoder to `max_step_num` steps (ref rnn.py:791). One
+    lax.scan with a finished mask — steps after every beam finishes still
+    execute (static shape) but cannot change scores (beam_search_step
+    pins finished beams to eos at zero cost).
+
+    Returns decoder.finalize's (outputs, final_state-ish) pair —
+    for BeamSearchDecoder: (sequences [B, K, T], scores [B, K])
+    (+ lengths [B, K] when return_length)."""
+    inputs0, states0, finished0 = decoder.initialize(initial_states)
+
+    def step(carry, time):
+        inputs, states, finished = carry
+        outputs, states, inputs, finished = decoder.step(
+            time, inputs, states, finished)
+        return (inputs, states, finished), outputs
+
+    (_, final_states, _), outputs = lax.scan(
+        step, (inputs0, states0, finished0), jnp.arange(max_step_num))
+    seqs, scores = decoder.finalize(outputs, final_states)
+    if return_length:
+        eos_mask = seqs == decoder.end_token
+        lengths = jnp.where(
+            eos_mask.any(-1),
+            jnp.argmax(eos_mask, axis=-1) + 1,   # include the eos token
+            seqs.shape[-1])
+        return seqs, scores, lengths.astype(jnp.int32)
+    return seqs, scores

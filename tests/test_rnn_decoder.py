@@ -1,0 +1,144 @@
+"""Composable cell/decoder protocol (ref layers/rnn.py:30-960): cells
+drive RNN; any custom cell plugs into BeamSearchDecoder/dynamic_decode."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.ops import rnn as R
+
+
+class TestCells:
+    def test_gru_cell_matches_functional_gru(self):
+        rng = np.random.RandomState(0)
+        cell = nn.GRUCell(4, 8)
+        layer = nn.RNN(cell)
+        v = layer.init(jax.random.key(0))
+        x = jnp.asarray(rng.randn(2, 5, 4).astype(np.float32))
+        outs, h = layer.apply(v, x)
+        p = v["params"]["cell"]
+        ref_outs, ref_h = R.gru(x, jnp.zeros((2, 8)), p["w_ih"], p["w_hh"],
+                                p["b_ih"], p["b_hh"])
+        np.testing.assert_allclose(np.asarray(outs), np.asarray(ref_outs),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), np.asarray(ref_h),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_lstm_cell_state_shape_and_lengths(self):
+        rng = np.random.RandomState(1)
+        cell = nn.LSTMCell(3, 6)
+        assert cell.state_shape == ((6,), (6,))
+        layer = nn.RNN(cell)
+        v = layer.init(jax.random.key(0))
+        x = jnp.asarray(rng.randn(2, 4, 3).astype(np.float32))
+        lengths = jnp.asarray([2, 4])
+        outs, (h, c) = layer.apply(v, x, lengths=lengths)
+        # sequence 0 ends at t=2: outputs past it are zero, state frozen
+        np.testing.assert_allclose(np.asarray(outs)[0, 2:], 0.0)
+        outs2, (h2, _) = layer.apply(v, x[:, :2], lengths=lengths)
+        np.testing.assert_allclose(np.asarray(h)[0], np.asarray(h2)[0],
+                                   rtol=1e-5, atol=1e-5)
+
+
+class MarkovCell(nn.RNNCell):
+    """Custom stateless cell: next-token logits depend only on the current
+    token (one-hot input) — a Markov chain whose optimal decode is
+    brute-forceable. The point of the protocol test: this cell was never
+    seen by the decoder implementation."""
+
+    def __init__(self, vocab):
+        super().__init__()
+        self.vocab = vocab
+
+    @property
+    def state_shape(self):
+        return (1,)
+
+    def forward(self, inputs, states):
+        return inputs, states
+
+
+class TestBeamSearchDecoder:
+    def _markov(self, v, seed):
+        rng = np.random.RandomState(seed)
+        logits = jnp.asarray(rng.randn(v, v).astype(np.float32)) * 2.0
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def _decode(self, logp, k, t, b=1):
+        v = logp.shape[0]
+        cell = nn.MarkovCell(v) if hasattr(nn, "MarkovCell") else \
+            MarkovCell(v)
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=0, end_token=v - 1, beam_size=k,
+            embedding_fn=lambda tok: jax.nn.one_hot(tok, v),
+            output_fn=lambda out: out @ logp, vocab_size=v,
+            cell_variables=cell.init(jax.random.key(0)))
+        init = cell.get_initial_states(b)
+        return nn.dynamic_decode(dec, init, max_step_num=t)
+
+    def test_full_beam_equals_brute_force(self):
+        # beam_size == vocab: beam search is exhaustive; best hypothesis
+        # must equal the brute-force argmax over all token sequences
+        v, t = 4, 3
+        logp = self._markov(v, seed=2)
+        seqs, scores = jax.jit(lambda: self._decode(logp, v, t))()
+        lp = np.asarray(logp)
+        eos = v - 1
+        best_score, best_seq = -1e18, None
+        import itertools
+        for cand in itertools.product(range(v), repeat=t):
+            s, prev, done = 0.0, 0, False
+            for tok in cand:
+                if done:
+                    if tok != eos:
+                        break       # finished beams only extend with eos
+                    continue
+                s += lp[prev, tok]
+                prev = tok
+                done = tok == eos
+            else:
+                if s > best_score:
+                    best_score, best_seq = s, cand
+        np.testing.assert_allclose(float(scores[0, 0]), best_score,
+                                   rtol=1e-5)
+        assert tuple(np.asarray(seqs)[0, 0]) == best_seq
+
+    def test_matches_functional_beam_search_decode(self):
+        # the protocol path and the fused op produce identical hypotheses
+        v, k, t, b = 6, 3, 5, 2
+        logp = self._markov(v, seed=3)
+        seqs, scores = self._decode(logp, k, t, b=b)
+
+        def log_probs_fn(tokens, state):
+            return logp[tokens], state
+
+        ref_seqs, ref_scores = R.beam_search_decode(
+            log_probs_fn, {"d": jnp.zeros((b * k, 1))}, bos_id=0,
+            eos_id=v - 1, beam_size=k, max_len=t, batch_size=b,
+            vocab_size=v)
+        np.testing.assert_allclose(np.asarray(scores),
+                                   np.asarray(ref_scores), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(seqs),
+                                      np.asarray(ref_seqs))
+
+    def test_return_length(self):
+        v, k, t = 4, 2, 6
+        logp = self._markov(v, seed=4)
+        # make eos absorbing and attractive so beams finish early
+        logp = logp.at[:, v - 1].set(2.0)
+        logp = jax.nn.log_softmax(logp, axis=-1)
+        cell = MarkovCell(v)
+        dec = nn.BeamSearchDecoder(
+            cell, start_token=0, end_token=v - 1, beam_size=k,
+            embedding_fn=lambda tok: jax.nn.one_hot(tok, v),
+            output_fn=lambda out: out @ logp, vocab_size=v,
+            cell_variables=cell.init(jax.random.key(0)))
+        seqs, scores, lengths = nn.dynamic_decode(
+            dec, cell.get_initial_states(1), max_step_num=t,
+            return_length=True)
+        ln = int(np.asarray(lengths)[0, 0])
+        assert 1 <= ln < t
+        assert int(np.asarray(seqs)[0, 0, ln - 1]) == v - 1

@@ -11,6 +11,8 @@ TPU-first: everything is expressed as dense gathers / reduce_windows /
 conv_general_dilated so XLA can tile onto the MXU; no per-pixel scalar loops.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -154,16 +156,11 @@ def conv3d_transpose(x, weight, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
-@register_op("max_pool2d_with_index")
-def max_pool2d_with_index(x, pool_size, pool_stride=1, pool_padding=0):
-    """ref: pool_with_index_op.cc — returns (pooled, flat argmax index into
-    each image's HxW plane), as the reference's unpool consumes."""
-    ks = (pool_size,) * 2 if isinstance(pool_size, int) else tuple(pool_size)
-    st = (pool_stride,) * 2 if isinstance(pool_stride, int) \
-        else tuple(pool_stride)
-    pd = (pool_padding,) * 2 if isinstance(pool_padding, int) \
-        else tuple(pool_padding)
+def _maxpool_index_fwd_raw(x, ks, st, pd):
     N, C, H, W = x.shape
+    # the index plane is ALWAYS float32 (exact integers to 2^24) — casting
+    # it to a bf16/f16 operand dtype would silently corrupt argmax indices
+    # past 256/2048; only the value operand's init takes x's dtype
     idx_plane = jnp.arange(H * W, dtype=jnp.float32).reshape(1, 1, H, W)
     idx_plane = jnp.broadcast_to(idx_plane, x.shape)
 
@@ -177,9 +174,48 @@ def max_pool2d_with_index(x, pool_size, pool_stride=1, pool_padding=0):
     strides = (1, 1) + st
     pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
     vals, idxs = lax.reduce_window(
-        (x, idx_plane), (-jnp.inf, jnp.float32(-1)),
+        (x, idx_plane),
+        (jnp.asarray(-jnp.inf, x.dtype), jnp.float32(-1)),
         lambda a, b: select(a, b), window, strides, pads)
     return vals, idxs.astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _maxpool_index_core(x, ks, st, pd, x_shape, dtype_name):
+    return _maxpool_index_fwd_raw(x, ks, st, pd)
+
+
+def _maxpool_index_core_fwd(x, ks, st, pd, x_shape, dtype_name):
+    vals, idxs = _maxpool_index_fwd_raw(x, ks, st, pd)
+    return (vals, idxs), idxs
+
+
+def _maxpool_index_core_bwd(ks, st, pd, x_shape, dtype_name, idxs, g):
+    # paired-tuple reduce_window has no JAX derivative rule — the VJP IS
+    # the unpool scatter (index gradients are zero), so reuse it: route
+    # dvals to each window's argmax position.
+    dvals = g[0].astype(dtype_name)
+    H, W = x_shape[2], x_shape[3]
+    return (unpool(dvals, idxs, (H, W)),)
+
+
+_maxpool_index_core.defvjp(_maxpool_index_core_fwd, _maxpool_index_core_bwd)
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, pool_size, pool_stride=1, pool_padding=0):
+    """ref: pool_with_index_op.cc — returns (pooled, flat argmax index into
+    each image's HxW plane), as the reference's unpool consumes.
+    Differentiable in the pooled values (custom VJP scatters to the argmax
+    positions; found by the registry grad sweep — the raw paired
+    reduce_window has no derivative rule)."""
+    ks = (pool_size,) * 2 if isinstance(pool_size, int) else tuple(pool_size)
+    st = (pool_stride,) * 2 if isinstance(pool_stride, int) \
+        else tuple(pool_stride)
+    pd = (pool_padding,) * 2 if isinstance(pool_padding, int) \
+        else tuple(pool_padding)
+    return _maxpool_index_core(x, ks, st, pd, tuple(x.shape),
+                               str(x.dtype))
 
 
 @register_op("unpool")

@@ -413,3 +413,31 @@ class TestDeformablePSRoIPool:
         assert np.any(np.asarray(gx) != 0.0)
         assert np.all(np.isfinite(np.asarray(gt)))
         assert np.any(np.asarray(gt) != 0.0)
+
+
+def test_max_pool_index_bf16_and_grad():
+    """bf16 operands must pool correctly with EXACT argmax indices (the
+    index plane stays float32 — bf16 cannot represent integers > 256),
+    and the custom VJP must scatter to the right pixels."""
+    from paddle_tpu.ops.vision import max_pool2d_with_index, unpool
+    rng = np.random.RandomState(0)
+    x32 = jnp.asarray(rng.randn(1, 1, 32, 32).astype(np.float32))
+    xb = x32.astype(jnp.bfloat16)
+    vb, ib = max_pool2d_with_index(xb, 2, pool_stride=2)
+    assert vb.dtype == jnp.bfloat16
+    # every index points at a pixel whose (bf16) value IS the pooled max —
+    # i.e. indices are exact positions, not bf16-rounded integers (ties
+    # may legitimately resolve differently than in f32)
+    ibn = np.asarray(ib).reshape(-1)
+    assert ibn.max() > 256 and ibn.min() >= 0
+    flat_b = np.asarray(xb.astype(jnp.float32)).reshape(-1)
+    np.testing.assert_array_equal(
+        flat_b[ibn], np.asarray(vb.astype(jnp.float32)).reshape(-1))
+    g = jax.grad(lambda x_: jnp.sum(
+        max_pool2d_with_index(x_, 2, pool_stride=2)[0].astype(
+            jnp.float32) ** 2))(xb)
+    assert g.dtype == jnp.bfloat16
+    # the gradient lands exactly on the argmax pixels of the bf16 forward
+    ref = unpool((2.0 * vb.astype(jnp.float32)), ib, (32, 32))
+    np.testing.assert_allclose(np.asarray(g, np.float32), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)

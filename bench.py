@@ -67,6 +67,25 @@ def _timed_steps(step_once, steps):
 
     t1, _ = run(steps)
     t2, lv = run(2 * steps)
+    prof_dir = os.environ.get("PT_BENCH_PROFILE")
+    if prof_dir:
+        # one-shot per-fusion breakdown (the r2 MFU investigation flow,
+        # automated): PT_BENCH_PROFILE=/tmp/prof python bench.py ...
+        import jax
+        with jax.profiler.trace(prof_dir):
+            run(steps)
+        try:
+            from paddle_tpu.profiler import trace_op_table
+            rows = trace_op_table(prof_dir, steps=steps, top=25)
+            if not rows:  # CPU run: the device lane is named differently
+                rows = trace_op_table(prof_dir, device_filter="CPU",
+                                      steps=steps, top=25)
+            for row in rows:
+                print(f"PROF {row['per_step_us']:>10.1f}us "
+                      f"x{row['count']:>4} {row['name'][:90]}",
+                      file=sys.stderr)
+        except Exception as e:  # profiling must never sink the bench row
+            print(f"PROF failed: {e}", file=sys.stderr)
     return max(t2 - t1, 1e-9) / steps, lv
 
 

@@ -37,12 +37,24 @@ say "bench resnet50 with maxpool scatter backward"
 PT_FLAGS_maxpool_custom_vjp=1 PT_BENCH_WALL=420 timeout 460 \
   python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
 
+say "bench resnet50 batch 256 (HBM-residency probe from the r2 plan)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model resnet50 --steps 10 \
+  --batch 256 2>&1 | tee -a "$LOG"
+
 say "bench transformer_big"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model transformer_big \
   --steps 10 2>&1 | tee -a "$LOG"
 
 say "bench gpt"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench ernie"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model ernie --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench ctr (DeepFM sparse pull-push)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model ctr --steps 10 \
   2>&1 | tee -a "$LOG"
 
 say "$(date -u +%FT%TZ) tpu_day1 done — record rows in BASELINE.md; flip"

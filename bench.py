@@ -71,10 +71,10 @@ def _timed_steps(step_once, steps):
     if prof_dir:
         # one-shot per-fusion breakdown (the r2 MFU investigation flow,
         # automated): PT_BENCH_PROFILE=/tmp/prof python bench.py ...
-        import jax
-        with jax.profiler.trace(prof_dir):
-            run(steps)
         try:
+            import jax
+            with jax.profiler.trace(prof_dir):
+                run(steps)
             from paddle_tpu.profiler import trace_op_table
             rows = trace_op_table(prof_dir, steps=steps, top=25)
             if not rows:  # CPU run: the device lane is named differently

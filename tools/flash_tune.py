@@ -1,0 +1,109 @@
+"""Flash-attention block-size autotune on silicon.
+
+The Pallas flash kernels default to (block_q, block_k) = (512, 512) —
+chosen analytically, never measured on the chip (the round-3 tunnel
+outage). This sweeps the block grid at the flagship shapes and prints the
+fastest configuration per (shape, causal) so the defaults can be flipped
+with evidence.
+
+Usage:  timeout 560 python tools/flash_tune.py [--quick] [--interpret]
+Each row: fwd and fwd+bwd wall time (dispatch-latency-cancelled, same
+two-run trick as bench.py), best marked with '*'.
+"""
+
+import argparse
+import itertools
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="one shape, fewer block pairs")
+    ap.add_argument("--interpret", action="store_true",
+                    help="CPU plumbing self-check (timings meaningless)")
+    args = ap.parse_args()
+
+    import os
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    # share bench.py's persistent compile cache — this tool compiles up to
+    # 36 distinct kernels, the exact cost the cache exists to amortize
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _enable_compile_cache
+    _enable_compile_cache()
+
+    from paddle_tpu.ops.pallas import on_tpu
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    if args.interpret:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"pallas_interpret": True})
+    elif not on_tpu():
+        print("NOT A TPU — pass --interpret for the CPU plumbing check")
+        sys.exit(2)
+
+    # flagship shapes: BERT-base (B=64, H=12, T=512, D=64) and GPT-small
+    # (B=16, H=12, T=1024? max_position dependent) — trimmed under --quick
+    shapes = [("bert_base", 64, 12, 512, 64, False),
+              ("gpt_small", 16, 12, 512, 64, True)]
+    blocks = [128, 256, 512]
+    if args.quick:
+        shapes = shapes[:1]
+        blocks = [128, 512]
+    if args.interpret:  # plumbing check only: tiny shape, 2 block pairs
+        shapes = [("tiny", 1, 2, 128, 64, True)]
+        blocks = [64, 128]
+
+    def timed(f, *xs, n=10):
+        out = f(*xs)
+        jax.tree_util.tree_map(
+            lambda t: t.block_until_ready() if hasattr(
+                t, "block_until_ready") else t, out)
+
+        def run(k):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(k):
+                r = f(*xs)
+            jax.tree_util.tree_map(
+                lambda t: float(jnp.sum(t)) if hasattr(t, "dtype") else t,
+                r)  # host fetch = true barrier on the tunnel
+            return time.perf_counter() - t0
+
+        t1 = run(n)
+        t2 = run(2 * n)
+        return max(t2 - t1, 1e-9) / n
+
+    rng = np.random.RandomState(0)
+    for name, b, h, t, d, causal in shapes:
+        q = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+        rows = []
+        print(f"\n{name} [B={b} H={h} T={t} D={d} causal={causal}]",
+              flush=True)
+        for bq, bk in itertools.product(blocks, blocks):
+            fwd = jax.jit(lambda q_, bq=bq, bk=bk: flash_attention(
+                q_, q_, q_, causal=causal, block_q=bq, block_k=bk))
+            bwd = jax.jit(jax.grad(lambda q_, bq=bq, bk=bk: jnp.sum(
+                flash_attention(q_, q_, q_, causal=causal, block_q=bq,
+                                block_k=bk))))
+            n = 2 if args.interpret else 10
+            tf = timed(fwd, q, n=n)
+            tb = timed(bwd, q, n=n)
+            rows.append((bq, bk, tf, tb))
+            # print as measured: a timeout mid-sweep keeps partial data
+            print(f"  bq={bq:<4} bk={bk:<4} fwd {tf * 1e3:8.3f} ms   "
+                  f"fwd+bwd {tb * 1e3:8.3f} ms", flush=True)
+        bq, bk, tf, tb = min(rows, key=lambda r: r[3])
+        print(f"  best fwd+bwd: bq={bq} bk={bk} ({tb * 1e3:.3f} ms; "
+              f"fwd {tf * 1e3:.3f} ms)", flush=True)
+    print("\nflip the flash_attention defaults to the best pair if it "
+          "beats (512, 512) consistently")
+
+
+if __name__ == "__main__":
+    main()

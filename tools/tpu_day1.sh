@@ -25,6 +25,9 @@ fi
 say "pallas smoke (parity + timings)"
 timeout 560 python tools/tpu_smoke.py 2>&1 | tee -a "$LOG"
 
+say "flash block-size autotune"
+timeout 560 python tools/flash_tune.py --quick 2>&1 | tee -a "$LOG"
+
 say "bench bert (flash+mask default)"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model bert --steps 10 \
   2>&1 | tee -a "$LOG"

@@ -312,3 +312,46 @@ class TestMaxPoolCustomVJP:
             out_cv, dx_cv = self._grads(x, True, data_format="NCHW", **kw)
             np.testing.assert_allclose(out_cv, out_ref, rtol=1e-6)
             np.testing.assert_allclose(dx_cv, dx_ref, rtol=1e-6, atol=1e-6)
+
+
+def test_conv_custom_vjp_resnet50_config_sweep():
+    """conv_custom_vjp parity vs jax's native conv gradients at EVERY
+    distinct conv configuration ResNet-50 actually runs (NHWC): the 7x7/s2
+    stem, 3x3 s1/s2 block convs, 1x1 s1/s2 projections. The silicon MFU
+    plan flips this flag on; a wrong dgrad at any one shape would corrupt
+    training while looking fine at the smoke shapes."""
+    from paddle_tpu.core.flags import get_flag, set_flags
+    from paddle_tpu.ops import nn as F
+    rng = np.random.RandomState(0)
+    # (kh, kw, stride, pad, cin, cout) — ResNet-50's distinct configs,
+    # channel counts trimmed (shape logic, not arithmetic volume)
+    configs = [
+        (7, 7, 2, 3, 3, 8),    # stem
+        (1, 1, 1, 0, 8, 8),    # bottleneck reduce
+        (3, 3, 1, 1, 8, 8),    # bottleneck spatial
+        (1, 1, 1, 0, 8, 16),   # bottleneck expand
+        (1, 1, 2, 0, 8, 16),   # downsample projection
+        (3, 3, 2, 1, 8, 8),    # stage-entry spatial stride
+    ]
+    for kh, kw, s, p, cin, cout in configs:
+        x = jnp.asarray(rng.randn(2, 14, 14, cin).astype(np.float32))
+        w = jnp.asarray(rng.randn(kh, kw, cin, cout).astype(np.float32)
+                        * 0.1)
+
+        def loss(x_, w_):
+            return jnp.sum(F.conv2d(x_, w_, stride=s, padding=p,
+                                    data_format="NHWC") ** 2)
+
+        old = get_flag("conv_custom_vjp")
+        try:
+            set_flags({"conv_custom_vjp": True})
+            gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+            set_flags({"conv_custom_vjp": False})
+            rx, rw = jax.grad(loss, argnums=(0, 1))(x, w)
+        finally:
+            set_flags({"conv_custom_vjp": old})
+        tag = f"k{kh}x{kw} s{s} p{p} {cin}->{cout}"
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                                   rtol=2e-4, atol=2e-4, err_msg=tag)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                                   rtol=2e-4, atol=2e-4, err_msg=tag)

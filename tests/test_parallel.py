@@ -590,3 +590,41 @@ def test_ulysses_flash_kernel_interpret():
         set_flags({"pallas_interpret": False})
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+class TestCompressedPsum:
+    """compressed_psum: bounded-error bandwidth-compressed allreduce
+    (EQuARX direction) — bf16 and int8 variants vs the exact sum."""
+
+    def _run(self, compress):
+        from paddle_tpu.parallel.collective import compressed_psum
+        mesh = pt.parallel.make_mesh({"dp": 8})
+        x = jax.random.normal(jax.random.key(0), (8, 64, 32), jnp.float32)
+        f = shard_map(
+            lambda x_: compressed_psum(x_[0], "dp", compress)[None],
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        out = np.asarray(f(x))
+        exact = np.asarray(x).sum(0)
+        # every replica holds the same compressed sum
+        for i in range(1, 8):
+            np.testing.assert_allclose(out[i], out[0], atol=0)
+        return out[0], exact, float(np.abs(np.asarray(x)).max())
+
+    def test_none_is_exact(self):
+        got, exact, _ = self._run("none")
+        np.testing.assert_allclose(got, exact, rtol=1e-6, atol=1e-6)
+
+    def test_bf16_error_bounded(self):
+        got, exact, _ = self._run("bf16")
+        scale = np.abs(exact).max()
+        assert np.max(np.abs(got - exact)) < 0.02 * scale
+
+    def test_int8_error_bounded(self):
+        got, exact, xmax = self._run("int8")
+        # per-element error <= n_replicas * scale/127 (rounding each term)
+        assert np.max(np.abs(got - exact)) <= 8 * xmax / 127 + 1e-6
+
+    def test_unknown_compress_raises(self):
+        from paddle_tpu.core.enforce import EnforceError
+        with pytest.raises(EnforceError, match="unknown compress"):
+            self._run("fp4")

@@ -1,0 +1,149 @@
+// C API implementation — thin marshaling over pt::Predictor
+// (ref inference/capi/pd_predictor.cc's role).
+
+#include "pt_predictor_c.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pt_predictor.h"
+
+namespace {
+
+struct PredictorHandle {
+  std::unique_ptr<pt::Predictor> impl;
+};
+
+void SetErr(char* err_buf, size_t err_len, const std::string& msg) {
+  if (!err_buf || err_len == 0) return;
+  size_t n = msg.size() < err_len - 1 ? msg.size() : err_len - 1;
+  memcpy(err_buf, msg.data(), n);
+  err_buf[n] = '\0';
+}
+
+}  // namespace
+
+extern "C" {
+
+PT_Predictor* PT_PredictorCreate(const char* model_dir,
+                                 const char* plugin_path,
+                                 int device_ordinal, char* err_buf,
+                                 size_t err_len) {
+  if (!model_dir) {
+    SetErr(err_buf, err_len, "model_dir is required");
+    return nullptr;
+  }
+  pt::PredictorConfig cfg;
+  cfg.model_dir = model_dir;
+  cfg.plugin_path = plugin_path ? plugin_path : "";
+  cfg.device_ordinal = device_ordinal;
+  std::string err;
+  auto pred = pt::Predictor::Create(cfg, &err);
+  if (!pred) {
+    SetErr(err_buf, err_len, err);
+    return nullptr;
+  }
+  auto* h = new PredictorHandle{std::move(pred)};
+  return reinterpret_cast<PT_Predictor*>(h);
+}
+
+int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
+                    size_t n_inputs, PT_Tensor** outputs,
+                    size_t* n_outputs, char* err_buf, size_t err_len) {
+  if (!pred) {
+    SetErr(err_buf, err_len, "null predictor");
+    return 1;
+  }
+  auto* h = reinterpret_cast<PredictorHandle*>(pred);
+  std::vector<pt::Tensor> ins(n_inputs);
+  for (size_t i = 0; i < n_inputs; ++i) {
+    const PT_Tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > PT_MAX_DIMS) {
+      SetErr(err_buf, err_len, "input ndim out of range");
+      return 1;
+    }
+    if (!t.data && t.nbytes > 0) {
+      SetErr(err_buf, err_len, "input data is NULL with nbytes > 0");
+      return 1;
+    }
+    ins[i].dtype = t.dtype;
+    ins[i].dims.assign(t.dims, t.dims + t.ndim);
+    ins[i].data.assign(t.data, t.data + t.nbytes);
+  }
+  std::vector<pt::Tensor> outs;
+  std::string err;
+  if (!h->impl->Run(ins, &outs, &err)) {
+    SetErr(err_buf, err_len, err);
+    return 1;
+  }
+  // library-owned flat allocation: one PT_Tensor array, per-tensor malloc'd
+  // data buffers (PT_OutputsFree releases both)
+  auto* arr = static_cast<PT_Tensor*>(
+      calloc(outs.size() ? outs.size() : 1, sizeof(PT_Tensor)));
+  if (!arr) {
+    SetErr(err_buf, err_len, "out of memory");
+    return 1;
+  }
+  for (size_t i = 0; i < outs.size(); ++i) {
+    PT_Tensor& o = arr[i];
+    o.dtype = outs[i].dtype;
+    if (outs[i].dims.size() > PT_MAX_DIMS) {
+      PT_OutputsFree(arr, i);
+      SetErr(err_buf, err_len, "output ndim exceeds PT_MAX_DIMS");
+      return 1;
+    }
+    o.ndim = static_cast<int32_t>(outs[i].dims.size());
+    for (size_t d = 0; d < outs[i].dims.size(); ++d)
+      o.dims[d] = outs[i].dims[d];
+    o.nbytes = outs[i].data.size();
+    o.data = static_cast<uint8_t*>(malloc(o.nbytes ? o.nbytes : 1));
+    if (!o.data) {
+      PT_OutputsFree(arr, i);
+      SetErr(err_buf, err_len, "out of memory");
+      return 1;
+    }
+    memcpy(o.data, outs[i].data.data(), o.nbytes);
+  }
+  *outputs = arr;
+  *n_outputs = outs.size();
+  return 0;
+}
+
+int PT_PredictorTrainStep(PT_Predictor* pred, float* loss, char* err_buf,
+                          size_t err_len) {
+  if (!pred) {
+    SetErr(err_buf, err_len, "null predictor");
+    return 1;
+  }
+  auto* h = reinterpret_cast<PredictorHandle*>(pred);
+  std::string err;
+  if (!h->impl->TrainStep(loss, &err)) {
+    SetErr(err_buf, err_len, err);
+    return 1;
+  }
+  return 0;
+}
+
+size_t PT_PredictorNumParams(const PT_Predictor* pred) {
+  if (!pred) return 0;
+  return reinterpret_cast<const PredictorHandle*>(pred)->impl->num_params();
+}
+
+size_t PT_PredictorNumOutputs(const PT_Predictor* pred) {
+  if (!pred) return 0;
+  return reinterpret_cast<const PredictorHandle*>(pred)->impl->num_outputs();
+}
+
+void PT_OutputsFree(PT_Tensor* outputs, size_t n_outputs) {
+  if (!outputs) return;
+  for (size_t i = 0; i < n_outputs; ++i) free(outputs[i].data);
+  free(outputs);
+}
+
+void PT_PredictorFree(PT_Predictor* pred) {
+  delete reinterpret_cast<PredictorHandle*>(pred);
+}
+
+}  // extern "C"

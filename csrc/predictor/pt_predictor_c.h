@@ -1,0 +1,67 @@
+/* pt_predictor C API — the pure-C binding over the pt_predictor library.
+ *
+ * Ref parity: /root/reference/paddle/fluid/inference/capi/ (c_api.h
+ * PD_NewPredictor / PD_PredictorRun / PD_DeletePredictor over C structs) —
+ * the ABI-stable surface non-C++ deployments (Go/Rust/Python-ctypes)
+ * link against. Same memory contract: input buffers are caller-owned and
+ * only read during the call; output buffers are library-owned and freed
+ * with PT_OutputsFree.
+ *
+ * Every function reports failure by return code (0 = OK) plus a
+ * NUL-terminated message copied into err_buf (when err_buf != NULL).
+ */
+
+#ifndef PT_PREDICTOR_C_H_
+#define PT_PREDICTOR_C_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PT_MAX_DIMS 8
+
+typedef struct PT_Predictor PT_Predictor; /* opaque */
+
+/* dtype is a PJRT_Buffer_Type value (pjrt_c_api.h: 4 = S32, 11 = F32). */
+typedef struct {
+  uint32_t dtype;
+  int32_t ndim;
+  int64_t dims[PT_MAX_DIMS];
+  uint8_t* data; /* input: caller-owned; output: library-owned */
+  size_t nbytes;
+} PT_Tensor;
+
+/* Compile the exported artifact dir and stage its params on the device.
+ * plugin_path may be NULL/"" for validate-only mode (Run/TrainStep fail,
+ * the inspection calls work). Returns NULL on failure with err_buf set. */
+PT_Predictor* PT_PredictorCreate(const char* model_dir,
+                                 const char* plugin_path,
+                                 int device_ordinal, char* err_buf,
+                                 size_t err_len);
+
+/* Serving call on [staged params..., inputs...]. On success, *outputs is
+ * a library-allocated array of *n_outputs tensors (free with
+ * PT_OutputsFree). Returns 0 on success. */
+int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
+                    size_t n_inputs, PT_Tensor** outputs,
+                    size_t* n_outputs, char* err_buf, size_t err_len);
+
+/* One training step on a save_train_program artifact; *loss receives the
+ * step loss. Returns 0 on success. */
+int PT_PredictorTrainStep(PT_Predictor* pred, float* loss, char* err_buf,
+                          size_t err_len);
+
+size_t PT_PredictorNumParams(const PT_Predictor* pred);
+size_t PT_PredictorNumOutputs(const PT_Predictor* pred);
+
+void PT_OutputsFree(PT_Tensor* outputs, size_t n_outputs);
+void PT_PredictorFree(PT_Predictor* pred);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PT_PREDICTOR_C_H_ */

@@ -190,6 +190,22 @@ PJRT_Buffer_Type TypeFromName(const std::string& n) {
 PyObject* g_helper = nullptr;
 PJRT_Client g_client;
 
+// RAII GIL guard: the host may be a live Python process whose ctypes
+// call released the GIL (the C-API e2e tests), a plain C++ process where
+// we initialized Python ourselves, or any thread of either. After
+// EnsurePython() releases the init thread state, PyGILState_Ensure is
+// uniformly correct everywhere.
+struct GilGuard {
+  PyGILState_STATE st;
+  bool active;
+  GilGuard() : active(Py_IsInitialized() != 0) {
+    if (active) st = PyGILState_Ensure();
+  }
+  ~GilGuard() {
+    if (active) PyGILState_Release(st);
+  }
+};
+
 PJRT_Error* EnsurePython() {
   if (g_helper != nullptr) return nullptr;
   setenv("JAX_PLATFORMS", "cpu", 1);
@@ -203,19 +219,38 @@ PJRT_Error* EnsurePython() {
               RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD)) {
     dlopen("libpython3.12.so", RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
   }
-  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  bool we_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized = true;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
   PyObject* mod = PyModule_New("pycpu_helper");
-  if (!mod) return PyError("module");
+  // on any failure: balance the ensure AND, when we initialized Python
+  // ourselves, hand back the init thread's GIL — otherwise the caller
+  // keeps it forever and every later GilGuard deadlocks
+  auto fail = [&](PJRT_Error* e) {
+    PyGILState_Release(st);
+    if (we_initialized) PyEval_SaveThread();
+    return e;
+  };
+  if (!mod) return fail(PyError("module"));
   PyObject* dict = PyModule_GetDict(mod);
   PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
   PyObject* res = PyRun_String(kHelperSrc, Py_file_input, dict, dict);
   if (!res) {
     Py_DECREF(mod);
-    return PyError("helper init (is PYTHONPATH set to the venv "
-                   "site-packages?)");
+    return fail(PyError("helper init (is PYTHONPATH set to the venv "
+                        "site-packages?)"));
   }
   Py_DECREF(res);
   g_helper = mod;
+  PyGILState_Release(st);
+  if (we_initialized) {
+    // release the GIL the init thread implicitly holds so that all entry
+    // points (from any thread) can PyGILState_Ensure symmetrically
+    PyEval_SaveThread();
+  }
   return nullptr;
 }
 
@@ -285,6 +320,7 @@ PJRT_Error* ClientAddressableDevices(
 }
 
 PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  GilGuard gil;
   PJRT_Error* e = nullptr;
   PyObject* text = PyUnicode_FromStringAndSize(args->program->code,
                                                args->program->code_size);
@@ -305,6 +341,7 @@ PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
 
 PJRT_Error* BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
+  GilGuard gil;
   const char* dname = DtypeName(args->type);
   if (!dname)
     return MakeError("unsupported PJRT_Buffer_Type " +
@@ -359,6 +396,7 @@ PJRT_Error* ExecutableNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
 
 PJRT_Error* LoadedExecutableExecute(
     PJRT_LoadedExecutable_Execute_Args* args) {
+  GilGuard gil;
   if (args->num_devices != 1)
     return MakeError("pycpu_pjrt supports exactly one device");
   PJRT_Error* e = nullptr;
@@ -391,6 +429,7 @@ PJRT_Error* LoadedExecutableExecute(
 }
 
 PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  GilGuard gil;
   PJRT_Buffer* b = args->src;
   if (args->dst == nullptr) {  // size query
     args->dst_size = b->nbytes;
@@ -424,6 +463,7 @@ PJRT_Error* BufferElementType(PJRT_Buffer_ElementType_Args* args) {
 }
 
 PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  GilGuard gil;
   Py_XDECREF(args->buffer->arr);
   delete args->buffer;
   return nullptr;

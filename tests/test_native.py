@@ -392,3 +392,148 @@ class TestPredictorEndToEnd:
         assert r.returncode == 0, r.stderr[-2000:]
         outs = read_params_bin(dump)
         np.testing.assert_allclose(outs[0], expected, rtol=2e-2, atol=2e-2)
+
+
+class TestCAPI:
+    """The pure-C binding (pt_predictor_c.h; ref inference/capi/) driven
+    from Python through ctypes — the exact path a Go/Rust deployment
+    takes: C structs in, library-owned outputs out."""
+
+    def _lib(self):
+        import ctypes
+        path = os.path.join(REPO, "csrc", "build", "libptpredictor.so")
+        if not os.path.exists(path):
+            pytest.skip("libptpredictor not built")
+        lib = ctypes.CDLL(path)
+
+        class PT_Tensor(ctypes.Structure):
+            _fields_ = [("dtype", ctypes.c_uint32),
+                        ("ndim", ctypes.c_int32),
+                        ("dims", ctypes.c_int64 * 8),
+                        ("data", ctypes.POINTER(ctypes.c_uint8)),
+                        ("nbytes", ctypes.c_size_t)]
+
+        lib.PT_PredictorCreate.restype = ctypes.c_void_p
+        lib.PT_PredictorCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_size_t]
+        lib.PT_PredictorRun.restype = ctypes.c_int
+        lib.PT_PredictorRun.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(PT_Tensor), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.POINTER(PT_Tensor)),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p,
+            ctypes.c_size_t]
+        lib.PT_PredictorNumParams.restype = ctypes.c_size_t
+        lib.PT_PredictorNumParams.argtypes = [ctypes.c_void_p]
+        lib.PT_OutputsFree.argtypes = [ctypes.POINTER(PT_Tensor),
+                                       ctypes.c_size_t]
+        lib.PT_PredictorFree.argtypes = [ctypes.c_void_p]
+        return lib, PT_Tensor
+
+    def test_create_errors_are_reported(self, tmp_path):
+        import ctypes
+        lib, _ = self._lib()
+        err = ctypes.create_string_buffer(512)
+        h = lib.PT_PredictorCreate(str(tmp_path).encode(), b"", 0, err, 512)
+        assert not h
+        assert b"cannot open" in err.value
+
+    def test_validate_only_inspection(self, tmp_path):
+        import ctypes
+        lib, _ = self._lib()
+        import paddle_tpu as pt
+        from paddle_tpu.models.mnist import MLP
+        m = MLP(num_classes=3, in_dim=4)
+        v = m.init(jax.random.key(0))
+        path = str(tmp_path / "exp")
+        pt.io.save_inference_model(
+            path, lambda p, x: m.apply({"params": p, "state": {}}, x),
+            (jnp.ones((2, 4)),), v["params"])
+        err = ctypes.create_string_buffer(512)
+        h = lib.PT_PredictorCreate(path.encode(), b"", 0, err, 512)
+        assert h, err.value
+        assert lib.PT_PredictorNumParams(h) == 6
+        lib.PT_PredictorFree(h)
+
+    def test_run_matches_python_forward(self, tmp_path):
+        """Full C-API serving e2e in a CHILD interpreter: the pycpu plugin
+        embeds CPython and cannot be initialized inside this pytest
+        process (same reason the CLI e2e tests use subprocess)."""
+        plugin = os.path.join(REPO, "csrc", "build", "libpycpu_pjrt.so")
+        lib_path = os.path.join(REPO, "csrc", "build", "libptpredictor.so")
+        if not (os.path.exists(plugin) and os.path.exists(lib_path)):
+            pytest.skip("library or pycpu plugin not built")
+        import paddle_tpu as pt
+        from paddle_tpu.models.mnist import MLP
+        m = MLP(num_classes=5, in_dim=8)
+        v = m.init(jax.random.key(0))
+        x = np.random.RandomState(0).rand(3, 8).astype(np.float32)
+        path = str(tmp_path / "exp")
+        pt.io.save_inference_model(
+            path, lambda p, xx: m.apply({"params": p, "state": {}}, xx),
+            (jnp.asarray(x),), v["params"])
+        expected = np.asarray(m.apply(
+            {"params": v["params"], "state": {}}, jnp.asarray(x)))
+        np.save(str(tmp_path / "x.npy"), x)
+        np.save(str(tmp_path / "expected.npy"), expected)
+
+        script = tmp_path / "capi_driver.py"
+        script.write_text(f"""
+import ctypes, sys
+import numpy as np
+
+class PT_Tensor(ctypes.Structure):
+    _fields_ = [("dtype", ctypes.c_uint32), ("ndim", ctypes.c_int32),
+                ("dims", ctypes.c_int64 * 8),
+                ("data", ctypes.POINTER(ctypes.c_uint8)),
+                ("nbytes", ctypes.c_size_t)]
+
+lib = ctypes.CDLL({lib_path!r})
+lib.PT_PredictorCreate.restype = ctypes.c_void_p
+lib.PT_PredictorCreate.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.c_size_t]
+lib.PT_PredictorRun.restype = ctypes.c_int
+lib.PT_PredictorRun.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(PT_Tensor), ctypes.c_size_t,
+    ctypes.POINTER(ctypes.POINTER(PT_Tensor)),
+    ctypes.POINTER(ctypes.c_size_t), ctypes.c_char_p, ctypes.c_size_t]
+lib.PT_OutputsFree.argtypes = [ctypes.POINTER(PT_Tensor), ctypes.c_size_t]
+lib.PT_PredictorFree.argtypes = [ctypes.c_void_p]
+
+x = np.load({str(tmp_path / 'x.npy')!r})
+expected = np.load({str(tmp_path / 'expected.npy')!r})
+err = ctypes.create_string_buffer(1024)
+h = lib.PT_PredictorCreate({path!r}.encode(), {plugin!r}.encode(), 0,
+                           err, 1024)
+assert h, err.value
+buf = ctypes.create_string_buffer(x.tobytes(), x.nbytes)
+inp = PT_Tensor()
+inp.dtype = 11                      # PJRT_Buffer_Type_F32
+inp.ndim = 2
+inp.dims[0], inp.dims[1] = x.shape
+inp.data = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+inp.nbytes = x.nbytes
+outs = ctypes.POINTER(PT_Tensor)()
+n = ctypes.c_size_t()
+rc = lib.PT_PredictorRun(h, ctypes.byref(inp), 1, ctypes.byref(outs),
+                         ctypes.byref(n), err, 1024)
+assert rc == 0, err.value
+assert n.value == 1
+o = outs[0]
+assert o.dtype == 11 and o.ndim == 2, (o.dtype, o.ndim)
+assert (o.dims[0], o.dims[1]) == expected.shape
+got = np.frombuffer(ctypes.string_at(o.data, o.nbytes),
+                    np.float32).reshape(expected.shape)
+np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
+lib.PT_OutputsFree(outs, n.value)
+lib.PT_PredictorFree(h)
+print("CAPI_E2E_OK")
+""")
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = _site_packages()
+        r = subprocess.run(["python", str(script)], capture_output=True,
+                           text=True, timeout=420, env=env)
+        assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+        assert "CAPI_E2E_OK" in r.stdout

@@ -56,6 +56,10 @@ int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
     SetErr(err_buf, err_len, "null predictor");
     return 1;
   }
+  if (!outputs || !n_outputs || (!inputs && n_inputs > 0)) {
+    SetErr(err_buf, err_len, "null inputs/outputs pointer");
+    return 1;
+  }
   auto* h = reinterpret_cast<PredictorHandle*>(pred);
   std::vector<pt::Tensor> ins(n_inputs);
   for (size_t i = 0; i < n_inputs; ++i) {

@@ -94,7 +94,14 @@ PJRT_Error* PyError(const char* what) {
 }
 
 const char* kHelperSrc = R"PY(
+import os
 import sys
+# scrub INSIDE Python: in a host-Python process (ctypes C-API callers)
+# the interpreter's os.environ snapshot predates our C setenv calls, so
+# the axon/TPU hooks must be disarmed here or `import jax` can reach for
+# a wedged tunnel and hang
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 try:
     import numpy as np
 except Exception as _e:
@@ -102,6 +109,8 @@ except Exception as _e:
         f"numpy import failed in embedded interpreter: {_e!r} "
         f"[sys.prefix={sys.prefix} sys.path={sys.path}]") from _e
 import jax
+# note: _dev below selects the CPU backend EXPLICITLY (jax.devices('cpu')),
+# so a host that already imported jax against another platform still works
 from jax._src.lib import xla_client
 from jaxlib._jax import DeviceList
 import ml_dtypes

@@ -1,0 +1,128 @@
+"""Per-op latency harness.
+
+Ref: /root/reference/paddle/fluid/operators/benchmark/op_tester.cc (config-
+driven single-op latency runs) and operators/jit/benchmark.cc — the
+reference ships harnesses, no stored numbers (BASELINE.md "Per-op
+latency" row). Same contract here: a harness that times single ops on
+the local chip and emits JSON lines; results land in BASELINE.md when
+captured on silicon.
+
+Usage:
+  python tools/op_bench.py                  # default op set
+  python tools/op_bench.py --ops matmul,conv2d --n 50
+  python tools/op_bench.py --list
+
+Timing uses the same two-run dispatch-latency cancellation as bench.py
+(the tunneled chip's block_until_ready returns early; a host scalar
+fetch is the true barrier).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _case_builders(rng, jnp):
+    """name -> builder() -> (fn, args, flop_count or None). Builders are
+    LAZY: only selected cases materialize their (possibly ~GB) device
+    inputs; --list touches nothing. fn(*args): inputs are REAL jit
+    arguments — a nullary closure would let XLA constant-fold the whole
+    computation away."""
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.ops import nn as F
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm_fused
+
+    f32 = lambda *s: jnp.asarray(rng.rand(*s).astype("float32"))
+    bf16 = lambda *s: f32(*s).astype(jnp.bfloat16)
+    m = 4096
+
+    return {
+        "matmul_4096_bf16": lambda: (
+            lambda x, y: x @ y, (bf16(m, m), bf16(m, m)), 2 * m ** 3),
+        "conv2d_3x3_b64_56x56_c64_nhwc": lambda: (
+            lambda x, w: F.conv2d(x, w, padding=1, data_format="NHWC"),
+            (bf16(64, 56, 56, 64), bf16(3, 3, 64, 64)),
+            2 * 64 * 56 * 56 * 64 * 64 * 9),
+        "layer_norm_fused_8192x1024": lambda: (
+            layer_norm_fused, (f32(8192, 1024), f32(1024), f32(1024)),
+            None),
+        "flash_attention_b8_h12_t1024_d64": lambda: (
+            lambda qq: flash_attention(qq, qq, qq, causal=True),
+            (bf16(8, 12, 1024, 64),), 4 * 8 * 12 * 1024 * 1024 * 64),
+        "embedding_gather_100k_x_64k": lambda: (
+            lambda t, i: jnp.take(t, i, axis=0),
+            (f32(100_000, 512),
+             jnp.asarray(rng.randint(0, 100_000, (65536,))
+                         .astype("int32"))), None),
+        "softmax_xent_8192x32000": lambda: (
+            L.softmax_with_cross_entropy,
+            (f32(8192, 32000), jnp.zeros((8192, 1), jnp.int32)), None),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--n", type=int, default=20)
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from bench import _enable_compile_cache, peak_flops
+    _enable_compile_cache()
+
+    rng = np.random.RandomState(0)
+    cases = _case_builders(rng, jnp)
+    if args.list:
+        print("\n".join(cases))
+        return
+    names = (args.ops.split(",") if args.ops else list(cases))
+    unknown = [n for n in names if n not in cases]
+    if unknown:
+        print(f"unknown ops {unknown}; --list shows choices",
+              file=sys.stderr)
+        sys.exit(2)
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    def timed(f, fargs, n):
+        out = f(*fargs)
+        jax.tree_util.tree_map(
+            lambda t: t.block_until_ready()
+            if hasattr(t, "block_until_ready") else t, out)
+
+        def run(k):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(k):
+                r = f(*fargs)
+            leaf = jax.tree_util.tree_leaves(r)[0]
+            float(jnp.sum(leaf))        # host fetch = true barrier
+            return time.perf_counter() - t0
+
+        t1 = run(n)
+        t2 = run(2 * n)
+        return max(t2 - t1, 1e-9) / n
+
+    for name in names:
+        fn, fargs, flops = cases[name]()
+        jit_fn = jax.jit(fn)
+        dt = timed(jit_fn, fargs, args.n)
+        row = {"op": name, "ms": round(dt * 1e3, 4)}
+        if flops:
+            row["tflops"] = round(flops / dt / 1e12, 2)
+            row["mfu"] = round(flops / dt / peak_flops(), 4)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

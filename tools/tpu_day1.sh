@@ -28,6 +28,9 @@ timeout 560 python tools/tpu_smoke.py 2>&1 | tee -a "$LOG"
 say "flash block-size autotune"
 timeout 560 python tools/flash_tune.py --quick 2>&1 | tee -a "$LOG"
 
+say "per-op latency harness"
+timeout 560 python tools/op_bench.py --n 20 2>&1 | tee -a "$LOG"
+
 say "bench bert (flash+mask default)"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model bert --steps 10 \
   2>&1 | tee -a "$LOG"

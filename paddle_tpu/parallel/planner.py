@@ -16,6 +16,9 @@ Planning rules (applied per-param, in order):
   * tp: params whose name matches `tp_patterns` (or, with
     tp_auto=True, any >=2-D param) shard their largest tp-divisible dim
     over the "tp" axis — reference DistFCConfig's intent, generalized.
+  * ep (FIRST, wins over tp/fsdp): params matching ep_patterns shard
+    their leading [E, ...] expert-stack dim over the "ep" axis (the
+    pserver table-shard successor; nn/moe.py convention).
   * fsdp: remaining params above `fsdp_min_size` shard their largest
     divisible dim over the "fsdp" axis (ZeRO-3).
   * otherwise replicated (pure DP; grads all-reduce over "dp" like the
@@ -83,12 +86,16 @@ class DistributionPlanner:
     """Plan shardings for an arbitrary captured program's params/inputs."""
 
     def __init__(self, mesh, tp_patterns=(), tp_auto=False,
-                 fsdp_min_size=None):
+                 fsdp_min_size=None, ep_patterns=()):
         self.mesh = mesh
         self.axes = dict(mesh.shape)
         self.tp_patterns = [re.compile(p) for p in tp_patterns]
         self.tp_auto = tp_auto
         self.fsdp_min_size = fsdp_min_size
+        # expert-parallel: params matching these patterns shard their
+        # LEADING dim (the [E, ...] expert stack convention, nn/moe.py)
+        # over the "ep" axis — the pserver table-shard successor rule
+        self.ep_patterns = [re.compile(p) for p in ep_patterns]
 
     def _largest_divisible_dim(self, shape, n):
         cands = [(d, i) for i, d in enumerate(shape) if d % n == 0 and d > 1]
@@ -100,26 +107,42 @@ class DistributionPlanner:
         entries = {}
         tp = self.axes.get("tp", 1)
         fsdp = self.axes.get("fsdp", 1)
+        ep = self.axes.get("ep", 1)
         for path, leaf in jax.tree_util.tree_leaves_with_path(params):
             name = _path_name(path)
             shape = tuple(getattr(leaf, "shape", ()))
             spec = [None] * len(shape)
             reason = "replicated (dp)"
-            if tp > 1 and len(shape) >= 2 and (
+            if ep > 1 and shape and any(
+                    rx.search(name) for rx in self.ep_patterns):
+                if shape[0] % ep == 0:
+                    spec[0] = "ep"
+                    reason = f"ep: expert dim 0 over {ep}"
+                else:
+                    # explicit match that cannot shard: make the skip
+                    # inspectable (every planner decision is)
+                    reason = (f"ep SKIPPED: dim 0 ({shape[0]}) not "
+                              f"divisible by ep={ep}")
+            if "ep" not in spec and tp > 1 and len(shape) >= 2 and (
                     self.tp_auto
                     or any(rx.search(name) for rx in self.tp_patterns)):
                 dim = self._largest_divisible_dim(shape, tp)
                 if dim is not None:
                     spec[dim] = "tp"
-                    reason = f"tp: dim {dim} over {tp}"
+                    suffix = ("; " + reason
+                              if reason.startswith("ep SKIPPED") else "")
+                    reason = f"tp: dim {dim} over {tp}" + suffix
             min_size = (self.fsdp_min_size if self.fsdp_min_size is not None
                         else 0)  # None = shard everything over fsdp
-            if "tp" not in spec and fsdp > 1 and shape and \
+            if "tp" not in spec and "ep" not in spec and fsdp > 1 \
+                    and shape and \
                     _size(shape) >= min_size:
                 dim = self._largest_divisible_dim(shape, fsdp)
                 if dim is not None:
                     spec[dim] = "fsdp"
-                    reason = f"fsdp: dim {dim} over {fsdp}"
+                    suffix = ("; " + reason
+                              if reason.startswith("ep SKIPPED") else "")
+                    reason = f"fsdp: dim {dim} over {fsdp}" + suffix
             entries[name] = PlanEntry(name, tuple(spec), reason)
         return entries
 

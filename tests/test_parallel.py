@@ -628,3 +628,34 @@ class TestCompressedPsum:
         from paddle_tpu.core.enforce import EnforceError
         with pytest.raises(EnforceError, match="unknown compress"):
             self._run("fp4")
+
+
+def test_planner_expert_parallel_rule():
+    """DistributionPlanner ep_patterns: expert-stacked params shard their
+    leading [E, ...] dim over "ep" and WIN over the fsdp sweep; the gate
+    stays fsdp-eligible; an explicit ep match with an indivisible expert
+    dim records an inspectable skip."""
+    from paddle_tpu.parallel.planner import DistributionPlanner
+    mesh = pt.parallel.make_mesh({"ep": 4, "fsdp": 2})
+    params = {"blocks": {"0": {"mlp": {
+        "w_gate": jnp.zeros((16, 4)),
+        "w1": jnp.zeros((4, 16, 32)),
+        "b1": jnp.zeros((4, 32)),
+        "w2": jnp.zeros((4, 32, 16)),
+        "b2": jnp.zeros((4, 16)),
+    }}}, "odd": jnp.zeros((6, 16, 32))}
+    planner = DistributionPlanner(
+        mesh, ep_patterns=(r"mlp/(w|b)[12]$", r"^odd$"),
+        fsdp_min_size=1)
+    plan = planner.plan(params)
+    e = plan.entries
+    for name in ("blocks/0/mlp/w1", "blocks/0/mlp/b1",
+                 "blocks/0/mlp/w2", "blocks/0/mlp/b2"):
+        assert e[name].spec[0] == "ep", (name, e[name])
+        assert "fsdp" not in e[name].spec, (name, e[name])
+    # non-matching param still gets the fsdp sweep
+    assert "fsdp" in e["blocks/0/mlp/w_gate"].spec
+    # 6 experts on ep=4: explicit match skipped, reason says so, fsdp
+    # takes over on a divisible dim
+    assert "ep SKIPPED" in e["odd"].reason, e["odd"]
+    assert "fsdp" in e["odd"].spec

@@ -55,6 +55,10 @@ say "bench gpt"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
   2>&1 | tee -a "$LOG"
 
+say "bench gpt long-context (seq 2048, single-chip flash)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
+  --seq 2048 --batch 4 2>&1 | tee -a "$LOG"
+
 say "bench ernie"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model ernie --steps 10 \
   2>&1 | tee -a "$LOG"

@@ -39,9 +39,13 @@ class TrainerConfig:
     # failure detection (ref heart_beat_monitor.h:38): None = auto-on when
     # jax.process_count() > 1 and a heartbeat_dir is available
     heartbeat: bool = None
+    heartbeat_transport: str = "file"  # "file" (shared dir) | "kv"
+                                       # (jax.distributed KV store — no
+                                       # shared FS; DCN-grade)
     heartbeat_dir: str = None      # shared dir for cross-process mtimes
     heartbeat_timeout_s: float = None   # default: dist_heartbeat_timeout_s
     heartbeat_interval_s: float = None  # default: dist_heartbeat_interval_s
+    heartbeat_kv_client: object = None  # test injection (FakeKV)
     on_peer_stall: callable = None      # (worker, age_s) -> None
     # checkpoint/resume (ref: the Fluid trainer's save_checkpoint flow,
     # io.py save_persistables + executor.py train loop integration)
@@ -128,25 +132,49 @@ class Trainer:
         monitor peers in the background, flagging silent RUNNING workers.
         Returns (ping, finish) callables (no-ops when disabled)."""
         cfg = self.cfg
+        enforce(cfg.heartbeat_transport in ("file", "kv"),
+                f"heartbeat_transport must be 'file' or 'kv', got "
+                f"{cfg.heartbeat_transport!r}")
         enabled = cfg.heartbeat
+        kv_mode = cfg.heartbeat_transport == "kv"
         if enabled is None:
             enabled = (jax.process_count() > 1
-                       and cfg.heartbeat_dir is not None)
-        if enabled:
+                       and (kv_mode or cfg.heartbeat_dir is not None))
+        if enabled and not kv_mode:
             enforce(cfg.heartbeat_dir is not None,
                     "TrainerConfig(heartbeat=True) requires heartbeat_dir "
-                    "(a shared directory all workers can reach)")
+                    "(a shared directory all workers can reach) — or set "
+                    "heartbeat_transport='kv' to ride the jax.distributed "
+                    "KV store with no shared FS")
         if not enabled:
             return (lambda: None), (lambda ok=True: None)
         from paddle_tpu.core import flags as F
-        from paddle_tpu.parallel.heartbeat import STALLED, FileHeartbeat
+        from paddle_tpu.parallel.heartbeat import (STALLED, FileHeartbeat,
+                                                   KVHeartbeat, KVMonitor,
+                                                   PeerFailureError)
         nw = num_workers if num_workers is not None else jax.process_count()
         wid = worker_id if worker_id is not None else jax.process_index()
         timeout = (cfg.heartbeat_timeout_s if cfg.heartbeat_timeout_s
                    is not None else F.get_flag("dist_heartbeat_timeout_s"))
         interval = (cfg.heartbeat_interval_s if cfg.heartbeat_interval_s
                     is not None else F.get_flag("dist_heartbeat_interval_s"))
-        hb = FileHeartbeat(cfg.heartbeat_dir, wid)
+        if kv_mode:
+            hb = KVHeartbeat(wid, client=cfg.heartbeat_kv_client)
+            kv_mon = KVMonitor(nw, timeout_s=timeout,
+                               client=cfg.heartbeat_kv_client)
+
+            def scan_once():
+                try:
+                    return kv_mon.scan()
+                except PeerFailureError as e:
+                    # connection-level death: attribution unavailable —
+                    # report as worker -1 once
+                    return {-1: (STALLED, float("inf"))}                         if -1 not in stalled else {}
+        else:
+            hb = FileHeartbeat(cfg.heartbeat_dir, wid)
+
+            def scan_once():
+                return FileHeartbeat.scan(cfg.heartbeat_dir, nw, timeout)
         hb.ping()
         last_ping = [time.monotonic()]
 
@@ -164,15 +192,16 @@ class Trainer:
 
         def monitor():
             while not stop.wait(interval):
-                for w, (st, age) in FileHeartbeat.scan(
-                        cfg.heartbeat_dir, nw, timeout).items():
+                for w, (st, age) in scan_once().items():
                     if w != wid and st == STALLED and w not in stalled:
                         stalled.add(w)
                         if cfg.on_peer_stall is not None:
                             cfg.on_peer_stall(w, age)
                         else:
-                            print(f"[trainer] WARNING: worker {w} silent "
-                                  f"for {age:.1f}s (> {timeout}s)")
+                            desc = ("transport reported peer death"
+                                    if age == float("inf") else
+                                    f"silent for {age:.1f}s (> {timeout}s)")
+                            print(f"[trainer] WARNING: worker {w} {desc}")
 
         t = threading.Thread(target=monitor, daemon=True,
                              name="trainer-heartbeat")

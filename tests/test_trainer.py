@@ -316,3 +316,40 @@ class TestTrainerCheckpointResume:
         assert ds2.pos == 3
         assert stats2["run_steps"] == 2
         assert float(state2["w"]) == 3 + 3 + 4
+
+
+def test_kv_transport_peer_stall_detected():
+    """heartbeat_transport='kv': the DCN-grade path — no shared dir; a
+    peer whose KV sequence stops advancing is flagged mid-train."""
+    import time as _time
+
+    from test_elastic import FakeKV
+    from paddle_tpu.parallel.heartbeat import KVHeartbeat
+    from paddle_tpu.static.trainer import Trainer, TrainerConfig
+
+    kv = FakeKV()
+    # peer (worker 1) pinged once and went silent
+    KVHeartbeat(1, client=kv).ping()
+    stalls = []
+
+    def slow_reader():
+        for i in range(6):
+            _time.sleep(0.05)
+            yield (np.ones((2, 2), np.float32),)
+
+    def step(state, x):
+        return jnp.sum(x) * 0.0 + state, state + 1.0
+
+    cfg = TrainerConfig(
+        heartbeat=True, heartbeat_transport="kv", heartbeat_kv_client=kv,
+        heartbeat_timeout_s=0.15, heartbeat_interval_s=0.05,
+        on_peer_stall=lambda w, age: stalls.append((w, age)),
+        num_ingest_threads=1)
+    tr = Trainer(step, cfg)
+    state, stats = tr.train(jnp.zeros(()), lambda: slow_reader(),
+                            num_workers=2, worker_id=0)
+    assert stats["steps"] == 6
+    assert stalls and stalls[0][0] == 1
+    assert tr.stalled_peers == {1}
+    # worker 0's own key shows COMPLETED in the store after clean exit
+    assert kv.store["hb/worker_0"].endswith("COMPLETED")

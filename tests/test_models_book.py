@@ -299,8 +299,10 @@ class TestSentiment:
 
 
 @pytest.mark.slow
-def test_examples_run(tmp_path):
-    """The examples/ scripts are living documentation — keep them running."""
+def _run_example(script, args, timeout=300):
+    """Run an examples/ script on the 8-device CPU mesh (shared by the
+    example-regression tests; PALLAS_AXON_POOL_IPS is dropped so a wedged
+    tunnel can never hang the subprocess at interpreter startup)."""
     import os
     import subprocess
     import sys
@@ -308,24 +310,44 @@ def test_examples_run(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                PYTHONPATH=repo)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
-        [sys.executable, os.path.join(repo, "examples", "train_resnet.py"),
-         "--steps", "4", "--batch", "8", "--ckpt", str(tmp_path / "ck")],
-        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
-    assert r.returncode == 0, r.stderr[-1500:]
+        [sys.executable, os.path.join(repo, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=repo)
+    assert r.returncode == 0, (script, r.stderr[-1500:])
+    return r
+
+
+def test_examples_run(tmp_path):
+    """The examples/ scripts are living documentation — keep them running."""
+    r = _run_example("train_resnet.py",
+                     ["--steps", "4", "--batch", "8",
+                      "--ckpt", str(tmp_path / "ck")])
     assert "checkpoint saved" in r.stdout
-    r = subprocess.run(
-        [sys.executable,
-         os.path.join(repo, "examples", "train_ctr_sparse.py"),
-         "--steps", "3", "--batch", "16"],
-        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
-    assert r.returncode == 0, r.stderr[-1500:]
-    r = subprocess.run(
-        [sys.executable,
-         os.path.join(repo, "examples", "distributed_dp_tp.py")],
-        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
-    assert r.returncode == 0, r.stderr[-1500:]
+    _run_example("train_ctr_sparse.py", ["--steps", "3", "--batch", "16"])
+    r = _run_example("distributed_dp_tp.py", [])
     assert "plan (first entries):" in r.stdout
+
+
+@pytest.mark.slow
+def test_examples_run_decode_and_detection(tmp_path):
+    """The remaining example scripts: KV-cache decoding, the NMT decoder
+    protocol, SSD detection, BERT pretraining (trainer+checkpoint+flash,
+    ckpt-every 2 so saves actually fire inside 4 steps)."""
+    r = _run_example("generate_gpt.py",
+                     ["--max-new", "6", "--prompt-len", "6"], timeout=560)
+    assert "tok/s" in r.stdout
+    r = _run_example("nmt_seq2seq.py", ["--steps", "300"], timeout=560)
+    assert r.stdout.rstrip().endswith("OK")
+    _run_example("train_ssd.py",
+                 ["--steps", "4", "--batch", "2", "--tiny"], timeout=560)
+    bck = str(tmp_path / "bck")
+    _run_example("pretrain_bert_flash.py",
+                 ["--steps", "4", "--batch", "2", "--seq", "32", "--tiny",
+                  "--ckpt-dir", bck, "--ckpt-every", "2"], timeout=560)
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert any(d.isdigit() for d in os.listdir(bck)), os.listdir(bck)
 
 
 class TestGPT:

@@ -94,10 +94,10 @@ int main(int argc, char** argv) {
     return 0;
   }
 
-  // Serving modes feed the artifact's example inputs (inputs.bin).
-  std::vector<pt::Tensor> inputs;
-  if (!pt::LoadPTPB(model_dir + "/inputs.bin", &inputs, &err))
-    inputs.clear();  // zero-input programs are fine
+  // Serving modes feed the artifact's example inputs (inputs.bin),
+  // already loaded+validated by Create — a CORRUPT inputs.bin died there
+  // with a clear message; absent just means a zero-input program.
+  const std::vector<pt::Tensor>& inputs = pred->fixed_inputs();
 
   if (!dump_outputs.empty()) {
     std::vector<pt::Tensor> outs;

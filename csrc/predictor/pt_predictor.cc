@@ -490,6 +490,9 @@ size_t Predictor::num_params() const { return impl_->params.size(); }
 size_t Predictor::num_fixed_inputs() const {
   return impl_->fixed_inputs.size();
 }
+const std::vector<Tensor>& Predictor::fixed_inputs() const {
+  return impl_->fixed_inputs;
+}
 size_t Predictor::num_outputs() const { return impl_->n_outputs; }
 bool Predictor::has_device() const { return impl_->exe != nullptr; }
 

@@ -85,6 +85,9 @@ class Predictor {
   // Artifact facts.
   size_t num_params() const;
   size_t num_fixed_inputs() const;   // inputs.bin entries (train artifacts)
+  // the artifact's example/fixed inputs (inputs.bin), already validated
+  // at Create — serving callers can Run() these directly
+  const std::vector<Tensor>& fixed_inputs() const;
   size_t num_outputs() const;        // program output arity (0 until Create
                                      //   compiled with a plugin)
   bool has_device() const;

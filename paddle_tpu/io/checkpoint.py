@@ -113,16 +113,18 @@ class CheckpointManager:
 
     def _mirror_save(self, step):
         """Push the completed step dir to the remote tree and prune remote
-        steps past the keep window (the local GC already ran)."""
+        steps past the keep window — by STEP-NUMBER retention, never by
+        mirroring the local dir listing: a fresh host stages only the
+        steps it touched, and pruning 'whatever is not local' would wipe
+        valid remote history (or, before any restore, ALL of it)."""
         if self._remote is None:
             return
         self.wait()  # the async save must be durable before mirroring
         self._fs.put_tree(os.path.join(self.path, str(step)),
                           f"{self._remote}/{step}")
-        local = {d for d in os.listdir(self.path) if d.isdigit()}
-        for name in self._fs.listdir(self._remote):
-            if name.isdigit() and name not in local:
-                self._fs.remove_tree(f"{self._remote}/{name}")
+        remote_steps = sorted(self._remote_steps())
+        for old in remote_steps[:-self.max_to_keep]:
+            self._fs.remove_tree(f"{self._remote}/{old}")
 
     def _remote_steps(self):
         if self._remote is None or not self._fs.fs_exists(self._remote):

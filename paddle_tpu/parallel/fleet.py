@@ -138,7 +138,17 @@ class Fleet:
         if strategy.geo_sgd_steps:
             return GeoSGD(optimizer, strategy.geo_sgd_steps)
         if strategy.dc_asgd_steps:
+            from paddle_tpu.optimizer.optimizers import SGD
             from paddle_tpu.parallel.communicator import DCASGD
+            # DC-ASGD's server update IS plain SGD (the reference DCAsgd
+            # is built on SGD) — silently replacing a different optimizer
+            # or a decaying schedule would degrade training with no sign
+            enforce(isinstance(optimizer, SGD) or strategy.dc_asgd_lr,
+                    "dc_asgd_steps replaces the optimizer with the "
+                    "DC-ASGD server rule (plain SGD, fixed lr — ref "
+                    "distribute_transpiler dc_asgd mode). Pass an SGD "
+                    "optimizer, or set strategy.dc_asgd_lr explicitly "
+                    "to acknowledge the fixed server lr")
             lr = strategy.dc_asgd_lr
             if not lr:  # optimizer.lr is a schedule; sample its step-0 value
                 sched = getattr(optimizer, "lr", None)

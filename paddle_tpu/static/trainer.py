@@ -168,8 +168,10 @@ class Trainer:
                     return kv_mon.scan()
                 except PeerFailureError as e:
                     # connection-level death: attribution unavailable —
-                    # report as worker -1 once
-                    return {-1: (STALLED, float("inf"))}                         if -1 not in stalled else {}
+                    # surface as worker -1 (the monitor loop's latch
+                    # dedups the callback)
+                    print(f"[trainer] coordination-service failure: {e}")
+                    return {-1: (STALLED, float("inf"))}
         else:
             hb = FileHeartbeat(cfg.heartbeat_dir, wid)
 

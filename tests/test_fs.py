@@ -134,3 +134,33 @@ class TestCheckpointRemote:
             mgr.save(1, state)
             restored, step = mgr.restore(state)
         assert step == 1
+
+    def test_fresh_host_save_preserves_remote_history(self, memfs):
+        """Remote prune is by step-number retention, NOT by mirroring the
+        local staging listing: a fresh host that saves before restoring
+        everything must not wipe valid remote steps (found in the round-4
+        high-effort review — the mirror-based prune deleted them all)."""
+        url = "mem://bucket/ck_hist"
+        staging = self._staging_of(url)
+        shutil.rmtree(staging, ignore_errors=True)
+        state = {"w": jnp.arange(3.0)}
+        with pt.io.CheckpointManager(url, max_to_keep=3) as mgr:
+            for s in (1, 2):
+                mgr.save(s, {"w": state["w"] + s})
+        # fresh host: empty staging; restores ONLY the latest step, then
+        # trains and saves a new one
+        shutil.rmtree(staging, ignore_errors=True)
+        with pt.io.CheckpointManager(url, max_to_keep=3) as mgr2:
+            restored, step = mgr2.restore(state)
+            assert step == 2
+            mgr2.save(3, {"w": restored["w"] + 1})
+        steps = sorted(n for n in fs.listdir(url) if n.isdigit())
+        assert steps == ["1", "2", "3"], steps     # history intact
+        # and retention still applies once the window overflows
+        shutil.rmtree(staging, ignore_errors=True)
+        with pt.io.CheckpointManager(url, max_to_keep=3) as mgr3:
+            _, step = mgr3.restore(state)
+            mgr3.save(4, {"w": jnp.arange(3.0)})
+        steps = sorted(int(n) for n in fs.listdir(url) if n.isdigit())
+        assert steps == [2, 3, 4], steps
+        shutil.rmtree(staging, ignore_errors=True)

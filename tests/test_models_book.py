@@ -298,7 +298,6 @@ class TestSentiment:
                                    atol=1e-6)
 
 
-@pytest.mark.slow
 def _run_example(script, args, timeout=300):
     """Run an examples/ script on the 8-device CPU mesh (shared by the
     example-regression tests; PALLAS_AXON_POOL_IPS is dropped so a wedged
@@ -318,6 +317,7 @@ def _run_example(script, args, timeout=300):
     return r
 
 
+@pytest.mark.slow
 def test_examples_run(tmp_path):
     """The examples/ scripts are living documentation — keep them running."""
     r = _run_example("train_resnet.py",

@@ -75,7 +75,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from bench import _enable_compile_cache, peak_flops
     _enable_compile_cache()
 

@@ -35,9 +35,9 @@ say "bench bert (flash+mask default)"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model bert --steps 10 \
   2>&1 | tee -a "$LOG"
 
-say "bench resnet50 (NHWC bf16 + conv_custom_vjp)"
-PT_BENCH_WALL=420 timeout 460 python bench.py --model resnet50 --steps 10 \
-  2>&1 | tee -a "$LOG"
+say "bench resnet50 (NHWC bf16 + conv_custom_vjp) + per-fusion profile"
+PT_BENCH_PROFILE=/tmp/pt_prof_resnet PT_BENCH_WALL=420 timeout 460 \
+  python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
 
 say "bench resnet50 with maxpool scatter backward"
 PT_FLAGS_maxpool_custom_vjp=1 PT_BENCH_WALL=420 timeout 460 \

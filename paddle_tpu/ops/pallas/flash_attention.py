@@ -26,9 +26,14 @@ score matrix). A recompute-based fallback (jax.checkpoint over the chunked
 XLA formulation) remains behind the `flash_pallas_bwd=False` flag as the
 escape hatch.
 
-lse/delta are carried as [B*H, Tq] with block (1, block_q) so the lane
-dimension is block_q (a [block_q, 1] layout would pad the single lane to
-128 and waste VMEM/bandwidth).
+lse/delta are carried as [B*H, 1, Tq] with block (1, 1, block_q) so the
+lane dimension is block_q (a [block_q, 1] layout would pad the single lane
+to 128 and waste VMEM/bandwidth). The singleton middle dim matters on real
+silicon: Mosaic requires the last two dims of every block to be divisible
+by (8, 128) or equal to the array dims — a 2-D [B*H, Tq] array with block
+(1, block_q) fails that check (the leading 1 is neither a multiple of 8
+nor equal to B*H), which interpret mode does not enforce. Same story for
+the [B, Tk] kv mask, carried as [B, 1, Tk].
 """
 
 import functools
@@ -132,7 +137,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
                              tq=tq, tk=tk, causal=causal,
                              causal_offset=causal_offset,
-                             mask_row=mask_ref[...] if has_mask else None)
+                             mask_row=mask_ref[0] if has_mask else None)
         if valid is not None:
             s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:]                            # [BQ, 1]
@@ -168,7 +173,22 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
         # every path (chunked_attention matches)
         o_ref[0] = jnp.where(l > 0, acc_scr[:] / l_safe, 0.0).astype(
             o_ref.dtype)
-        lse_ref[...] = jnp.transpose(m_scr[:] + jnp.log(l_safe), (1, 0))
+        lse_ref[0] = jnp.transpose(m_scr[:] + jnp.log(l_safe), (1, 0))
+
+
+def _legal_block(block, t, interpret=False):
+    """Largest Mosaic-tileable block ≤ the request. lse/delta/mask ride
+    with the block size in the lane dimension, which Mosaic accepts only
+    when it is a multiple of 128 or covers the whole sequence — a perf
+    knob, never semantics, so silently legalize rather than fall back.
+    Interpret mode does NOT legalize: the interpreter has no tiling rule,
+    and the CPU suite's small-block cases (block 8/16/32 at T ≤ 128) are
+    what exercise the multi-block online-softmax, tail-masking, and
+    causal block-skip paths."""
+    b = min(block, t)
+    if interpret or b == t or b % 128 == 0:
+        return b
+    return (b // 128) * 128 if b >= 128 else min(t, 128)
 
 
 def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
@@ -182,8 +202,8 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
     q3 = q.reshape(bh, tq, d)
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    block_q = _legal_block(block_q, tq, interpret)
+    block_k = _legal_block(block_k, tk, interpret)
     grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
     has_mask = kv_mask is not None
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
@@ -198,19 +218,19 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
     operands = [q3, k3, v3]
     if has_mask:
         in_specs.append(pl.BlockSpec(
-            (1, block_k), lambda bhi, qi, ki: (bhi // h, ki)))
-        operands.append(kv_mask.astype(jnp.int32))
+            (1, 1, block_k), lambda bhi, qi, ki: (bhi // h, 0, ki)))
+        operands.append(kv_mask.astype(jnp.int32).reshape(b, 1, tk))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -258,15 +278,15 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
         v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
         do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
-        lse = _tail_zero_row(lse_ref[...], qi, block_q, tq)
-        dlt = _tail_zero_row(dlt_ref[...], qi, block_q, tq)
+        lse = _tail_zero_row(lse_ref[0], qi, block_q, tq)
+        dlt = _tail_zero_row(dlt_ref[0], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
                              tq=tq, tk=tk, causal=causal,
                              causal_offset=causal_offset,
-                             mask_row=mask_ref[...] if has_mask else None)
+                             mask_row=mask_ref[0] if has_mask else None)
         p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -311,15 +331,15 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
         v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
         do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
-        lse = _tail_zero_row(lse_ref[...], qi, block_q, tq)
-        dlt = _tail_zero_row(dlt_ref[...], qi, block_q, tq)
+        lse = _tail_zero_row(lse_ref[0], qi, block_q, tq)
+        dlt = _tail_zero_row(dlt_ref[0], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
                              tq=tq, tk=tk, causal=causal,
                              causal_offset=causal_offset,
-                             mask_row=mask_ref[...] if has_mask else None)
+                             mask_row=mask_ref[0] if has_mask else None)
         p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -361,15 +381,16 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
     do3 = do.reshape(bh, tq, d)
-    lse2 = lse.reshape(bh, tq)
-    dlt2 = delta.reshape(bh, tq)
-    block_q = min(block_q, tq)
-    block_k = min(block_k, tk)
+    lse2 = lse.reshape(bh, 1, tq)
+    dlt2 = delta.reshape(bh, 1, tq)
+    block_q = _legal_block(block_q, tq, interpret)
+    block_k = _legal_block(block_k, tk, interpret)
     nq = pl.cdiv(tq, block_q)
     nk = pl.cdiv(tk, block_k)
     offset = tk - tq
     has_mask = kv_mask is not None
-    mask_i32 = kv_mask.astype(jnp.int32) if has_mask else None
+    mask_i32 = (kv_mask.astype(jnp.int32).reshape(b, 1, tk)
+                if has_mask else None)
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, causal_offset=offset, tq=tq, tk=tk,
                   has_mask=has_mask)
@@ -378,13 +399,13 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
         pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
-        pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
     ]
     q_ops = [q3, k3, v3, do3, lse2, dlt2]
     if has_mask:
         q_specs.append(pl.BlockSpec(
-            (1, block_k), lambda bhi, qi, ki: (bhi // h, ki)))
+            (1, 1, block_k), lambda bhi, qi, ki: (bhi // h, 0, ki)))
         q_ops.append(mask_i32)
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, **common),
@@ -401,13 +422,13 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
         pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_q), lambda bhi, ki, qi: (bhi, qi)),
-        pl.BlockSpec((1, block_q), lambda bhi, ki, qi: (bhi, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, ki, qi: (bhi, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, ki, qi: (bhi, 0, qi)),
     ]
     kv_ops = [q3, k3, v3, do3, lse2, dlt2]
     if has_mask:
         kv_specs.append(pl.BlockSpec(
-            (1, block_k), lambda bhi, ki, qi: (bhi // h, ki)))
+            (1, 1, block_k), lambda bhi, ki, qi: (bhi // h, 0, ki)))
         kv_ops.append(mask_i32)
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, **common),

@@ -1,0 +1,56 @@
+"""Tunnel-safe device timing for the micro-bench tools.
+
+On the axon-tunneled TPU, `block_until_ready` returns before the device
+finishes and only a host fetch is a true barrier. Timing n *independent*
+dispatches and fetching the last result is NOT a barrier for the first
+n-1 (their executions can still be in flight), which is how op_bench r4
+printed 0.0 ms rows on day 1. The fix: run the n iterations inside one
+jitted `lax.scan` whose carry is threaded through
+`lax.optimization_barrier` together with the op's output — every
+iteration truly executes (no hoisting/CSE), the chain serializes them,
+and one final host fetch waits for all n. The per-step time is the
+(2n-run − n-run) difference so the fixed dispatch+fetch round trip
+cancels, same convention as bench.py `_timed_steps`.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _make_loop(f, n):
+    @jax.jit
+    def loop(*xs):
+        def body(xs, _):
+            y = f(*xs)
+            # barrier EVERY output leaf: chaining only one would let XLA
+            # dead-code-eliminate the others inside the loop
+            leaves = tuple(jax.tree_util.tree_leaves(y))
+            if leaves:
+                out = jax.lax.optimization_barrier(tuple(xs) + leaves)
+                xs = out[:len(xs)]
+            return xs, None
+        xs, _ = jax.lax.scan(body, tuple(xs), None, length=n)
+        return xs
+
+    return loop
+
+
+def device_time(f, args, n=10):
+    """Seconds per call of f(*args), device time, dispatch cancelled."""
+    # AOT-compile so warmup costs zero device iterations (a full-loop
+    # warmup would double the device work inside the day-1 timeout)
+    loop_n = _make_loop(f, n).lower(*args).compile()
+    loop_2n = _make_loop(f, 2 * n).lower(*args).compile()
+
+    def run(loop):
+        t0 = time.perf_counter()
+        out = loop(*args)
+        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))  # true barrier
+        return time.perf_counter() - t0
+
+    run(loop_n)      # executable-load warmup (n iterations, no compile)
+    t1 = run(loop_n)
+    t2 = run(loop_2n)
+    return max(t2 - t1, 1e-9) / n

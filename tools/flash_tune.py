@@ -14,7 +14,6 @@ two-run trick as bench.py), best marked with '*'.
 import argparse
 import itertools
 import sys
-import time
 
 
 def main():
@@ -59,25 +58,9 @@ def main():
         shapes = [("tiny", 1, 2, 128, 64, True)]
         blocks = [64, 128]
 
-    def timed(f, *xs, n=10):
-        out = f(*xs)
-        jax.tree_util.tree_map(
-            lambda t: t.block_until_ready() if hasattr(
-                t, "block_until_ready") else t, out)
+    from _timing import device_time
 
-        def run(k):
-            t0 = time.perf_counter()
-            r = None
-            for _ in range(k):
-                r = f(*xs)
-            jax.tree_util.tree_map(
-                lambda t: float(jnp.sum(t)) if hasattr(t, "dtype") else t,
-                r)  # host fetch = true barrier on the tunnel
-            return time.perf_counter() - t0
-
-        t1 = run(n)
-        t2 = run(2 * n)
-        return max(t2 - t1, 1e-9) / n
+    from paddle_tpu.ops.pallas.flash_attention import _legal_block
 
     rng = np.random.RandomState(0)
     for name, b, h, t, d, causal in shapes:
@@ -85,19 +68,40 @@ def main():
         rows = []
         print(f"\n{name} [B={b} H={h} T={t} D={d} causal={causal}]",
               flush=True)
+        seen_eff = set()
         for bq, bk in itertools.product(blocks, blocks):
-            fwd = jax.jit(lambda q_, bq=bq, bk=bk: flash_attention(
-                q_, q_, q_, causal=causal, block_q=bq, block_k=bk))
-            bwd = jax.jit(jax.grad(lambda q_, bq=bq, bk=bk: jnp.sum(
+            # report the block sizes that actually execute (the kernel
+            # legalizes sub-128 lanes); skip pairs that collapse to an
+            # already-measured effective config
+            ebq = _legal_block(bq, t, args.interpret)
+            ebk = _legal_block(bk, t, args.interpret)
+            if (ebq, ebk) in seen_eff:
+                continue
+            seen_eff.add((ebq, ebk))
+            if (ebq, ebk) != (bq, bk):
+                print(f"  (bq={bq} bk={bk} legalizes to {ebq},{ebk})",
+                      flush=True)
+            bq, bk = ebq, ebk
+            fwd = lambda q_, bq=bq, bk=bk: flash_attention(
+                q_, q_, q_, causal=causal, block_q=bq, block_k=bk)
+            bwd = jax.grad(lambda q_, bq=bq, bk=bk: jnp.sum(
                 flash_attention(q_, q_, q_, causal=causal, block_q=bq,
-                                block_k=bk))))
+                                block_k=bk)))
             n = 2 if args.interpret else 10
-            tf = timed(fwd, q, n=n)
-            tb = timed(bwd, q, n=n)
+            try:
+                tf = device_time(fwd, (q,), n=n)
+                tb = device_time(bwd, (q,), n=n)
+            except Exception as e:  # keep sweeping past a bad config
+                print(f"  bq={bq:<4} bk={bk:<4} ERROR "
+                      f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+                continue
             rows.append((bq, bk, tf, tb))
             # print as measured: a timeout mid-sweep keeps partial data
             print(f"  bq={bq:<4} bk={bk:<4} fwd {tf * 1e3:8.3f} ms   "
                   f"fwd+bwd {tb * 1e3:8.3f} ms", flush=True)
+        if not rows:
+            print("  (no config succeeded)", flush=True)
+            continue
         bq, bk, tf, tb = min(rows, key=lambda r: r[3])
         print(f"  best fwd+bwd: bq={bq} bk={bk} ({tb * 1e3:.3f} ms; "
               f"fwd {tf * 1e3:.3f} ms)", flush=True)

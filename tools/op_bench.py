@@ -12,15 +12,15 @@ Usage:
   python tools/op_bench.py --ops matmul,conv2d --n 50
   python tools/op_bench.py --list
 
-Timing uses the same two-run dispatch-latency cancellation as bench.py
-(the tunneled chip's block_until_ready returns early; a host scalar
-fetch is the true barrier).
+Timing: tools/_timing.device_time — a jitted scan chains the n calls
+through lax.optimization_barrier (independent dispatches fetched once are
+NOT a barrier on the tunnel) with bench.py's two-run dispatch-latency
+cancellation on top.
 """
 
 import argparse
 import json
 import sys
-import time
 
 
 def _case_builders(rng, jnp):
@@ -96,29 +96,11 @@ def main():
     dev = jax.devices()[0]
     print(f"# device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
 
-    def timed(f, fargs, n):
-        out = f(*fargs)
-        jax.tree_util.tree_map(
-            lambda t: t.block_until_ready()
-            if hasattr(t, "block_until_ready") else t, out)
-
-        def run(k):
-            t0 = time.perf_counter()
-            r = None
-            for _ in range(k):
-                r = f(*fargs)
-            leaf = jax.tree_util.tree_leaves(r)[0]
-            float(jnp.sum(leaf))        # host fetch = true barrier
-            return time.perf_counter() - t0
-
-        t1 = run(n)
-        t2 = run(2 * n)
-        return max(t2 - t1, 1e-9) / n
+    from _timing import device_time
 
     for name in names:
         fn, fargs, flops = cases[name]()
-        jit_fn = jax.jit(fn)
-        dt = timed(jit_fn, fargs, args.n)
+        dt = device_time(fn, fargs, n=args.n)
         row = {"op": name, "ms": round(dt * 1e3, 4)}
         if flops:
             row["tflops"] = round(flops / dt / 1e12, 2)

@@ -16,8 +16,11 @@ Usage:  timeout 560 python tools/tpu_smoke.py [--quick]
 
 import argparse
 import json
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -239,26 +242,12 @@ def main():
 
     # ---- 5. micro-timings ---------------------------------------------
     if not args.quick:
-        def timeit(f, *a, n=20):
-            r = f(*a)
-            jax.tree_util.tree_map(
-                lambda t: t.block_until_ready()
-                if hasattr(t, "block_until_ready") else t, r)
-            # two-run difference cancels the tunnel dispatch latency
-            t1 = time.perf_counter()
-            for _ in range(n):
-                r = f(*a)
-            _sync(r)
-            mid = time.perf_counter()
-            for _ in range(2 * n):
-                r = f(*a)
-            _sync(r)
-            end = time.perf_counter()
-            return max(end - mid - (mid - t1), 1e-9) / n
+        from _timing import device_time
 
-        def _sync(r):
-            leaf = jax.tree_util.tree_leaves(r)[0]
-            _ = float(jnp.sum(leaf))
+        def timeit(f, *a, n=20):
+            # chained-scan timing (see tools/_timing.py): independent
+            # dispatches fetched once are NOT a barrier on the tunnel
+            return device_time(f, a, n=n)
 
         q, k, v, go = qkvg(8, 12, 512, 64, seed=1)
         scale = 1.0 / 8.0

@@ -5,12 +5,21 @@ finishes and only a host fetch is a true barrier. Timing n *independent*
 dispatches and fetching the last result is NOT a barrier for the first
 n-1 (their executions can still be in flight), which is how op_bench r4
 printed 0.0 ms rows on day 1. The fix: run the n iterations inside one
-jitted `lax.scan` whose carry is threaded through
-`lax.optimization_barrier` together with the op's output — every
-iteration truly executes (no hoisting/CSE), the chain serializes them,
-and one final host fetch waits for all n. The per-step time is the
-(2n-run − n-run) difference so the fixed dispatch+fetch round trip
-cancels, same convention as bench.py `_timed_steps`.
+jitted `lax.scan` and make the value the host finally fetches
+*data-depend on every iteration's output* — a scalar accumulator in the
+carry that sums each iteration's first output leaf. Day 1 on silicon
+showed that routing outputs through `lax.optimization_barrier` alone is
+NOT enough: the barrier's unused output elements (and their producing
+computation) were still eliminated, and matmul/conv rows read 0.0 ms.
+A reduction the result depends on cannot be DCE'd or narrowed (XLA can
+rewrite slice-of-dot to a smaller dot, but not sum-of-dot), and it
+fuses into the producer's epilogue so it adds no extra HBM pass. The
+inputs still pass through the barrier so the op cannot be hoisted out
+of the loop or CSE'd across iterations.
+
+The per-step time is the (2n-run − n-run) difference so the fixed
+dispatch+fetch round trip cancels, same convention as bench.py
+`_timed_steps`.
 """
 
 import time
@@ -22,17 +31,22 @@ import jax.numpy as jnp
 def _make_loop(f, n):
     @jax.jit
     def loop(*xs):
-        def body(xs, _):
+        def body(carry, _):
+            xs, acc = carry
             y = f(*xs)
-            # barrier EVERY output leaf: chaining only one would let XLA
-            # dead-code-eliminate the others inside the loop
             leaves = tuple(jax.tree_util.tree_leaves(y))
             if leaves:
+                # acc consumes every leaf: the final fetch of acc forces
+                # every iteration's f to really execute on the device
+                acc = acc + sum(
+                    jnp.sum(l).astype(jnp.float32) for l in leaves)
                 out = jax.lax.optimization_barrier(tuple(xs) + leaves)
                 xs = out[:len(xs)]
-            return xs, None
-        xs, _ = jax.lax.scan(body, tuple(xs), None, length=n)
-        return xs
+            return (xs, acc), None
+
+        (xs, acc), _ = jax.lax.scan(
+            body, (tuple(xs), jnp.float32(0.0)), None, length=n)
+        return acc
 
     return loop
 
@@ -47,10 +61,11 @@ def device_time(f, args, n=10):
     def run(loop):
         t0 = time.perf_counter()
         out = loop(*args)
-        float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))  # true barrier
+        float(out)                       # host fetch = true barrier
         return time.perf_counter() - t0
 
-    run(loop_n)      # executable-load warmup (n iterations, no compile)
-    t1 = run(loop_n)
+    run(loop_n)       # executable-load warmup (n iterations, no compile)
+    run(loop_2n)      # same for the 2n executable — its load cost must
+    t1 = run(loop_n)  # not land inside the timed 2n run
     t2 = run(loop_2n)
     return max(t2 - t1, 1e-9) / n

@@ -1,0 +1,93 @@
+"""Isolate the fa_causal / fa_d128 silicon bwd mismatch seen in tpu_smoke.
+
+Hypothesis: the smoke baseline (chunked XLA vjp) runs its einsums at
+default TPU matmul precision (bf16 operand truncation on the MXU) while
+the Pallas kernels' f32 dots run at full f32, so the *baseline* carries
+~1e-2 absolute noise on causal shapes — a tolerance/baseline artifact,
+not a kernel bug. The causal cases concentrate softmax mass on fewer
+keys (larger p entries), amplifying the absolute error vs the non-causal
+cases that sit just under the 5e-3 tolerance.
+
+This probe computes, per failing config:
+  A = Pallas bwd grads (TPU silicon)
+  B = chunked vjp at default precision (the smoke baseline)
+  C = chunked vjp under jax.default_matmul_precision('float32')
+  R = chunked vjp on CPU float64 (ground truth)
+and prints max|X - R| for X in {A, B, C} plus max|B - C|.
+
+If |A-R| << |B-R| ~ |A-B|, the Pallas kernel is *more* accurate than the
+smoke baseline and the smoke should compare at highest precision.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from paddle_tpu.ops.pallas.flash_attention import (
+        chunked_attention, flash_attention)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
+    cpu = jax.devices("cpu")[0] if jax.devices("cpu") else None
+
+    rng = np.random.RandomState(0)
+    configs = [
+        ("fa_causal", dict(b=2, h=4, t=512, d=64, causal=True)),
+        ("fa_d128", dict(b=1, h=2, t=256, d=128, causal=True)),
+        ("fa_plain", dict(b=2, h=4, t=512, d=64, causal=False)),
+    ]
+    for name, cfg in configs:
+        b, h, t, d = cfg["b"], cfg["h"], cfg["t"], cfg["d"]
+        causal = cfg["causal"]
+        scale = 1.0 / np.sqrt(d)
+        q = rng.randn(b, h, t, d).astype(np.float32)
+        k = rng.randn(b, h, t, d).astype(np.float32)
+        v = rng.randn(b, h, t, d).astype(np.float32)
+        g = rng.randn(b, h, t, d).astype(np.float32)
+
+        def chunked_grads(qx, kx, vx, gx):
+            _, vjp = jax.vjp(lambda a, b_, c: chunked_attention(
+                a, b_, c, scale=scale, causal=causal), qx, kx, vx)
+            return vjp(gx)
+
+        def flash_grads(qx, kx, vx, gx):
+            _, vjp = jax.vjp(lambda a, b_, c: flash_attention(
+                a, b_, c, scale=scale, causal=causal), qx, kx, vx)
+            return vjp(gx)
+
+        # ground truth: chunked on CPU in float64
+        with jax.default_device(cpu):
+            R = jax.jit(chunked_grads)(
+                *(jnp.asarray(x, jnp.float64) for x in (q, k, v, g)))
+            R = [np.asarray(x, np.float64) for x in R]
+
+        qj, kj, vj, gj = (jnp.asarray(x) for x in (q, k, v, g))
+        A = [np.asarray(x, np.float64)
+             for x in jax.jit(flash_grads)(qj, kj, vj, gj)]
+        B = [np.asarray(x, np.float64)
+             for x in jax.jit(chunked_grads)(qj, kj, vj, gj)]
+        with jax.default_matmul_precision("float32"):
+            C = [np.asarray(x, np.float64)
+                 for x in jax.jit(chunked_grads)(qj, kj, vj, gj)]
+
+        names = ["dq", "dk", "dv"]
+        for i, gn in enumerate(names):
+            ar = float(np.max(np.abs(A[i] - R[i])))
+            br = float(np.max(np.abs(B[i] - R[i])))
+            cr = float(np.max(np.abs(C[i] - R[i])))
+            ab = float(np.max(np.abs(A[i] - B[i])))
+            print(f"{name} {gn}: |pallas-ref|={ar:.3e} "
+                  f"|chunked_default-ref|={br:.3e} "
+                  f"|chunked_f32-ref|={cr:.3e} |pallas-chunked|={ab:.3e}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

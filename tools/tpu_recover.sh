@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Tunnel-recovery bench sequence: the rows day 1 lost when the tunnel
+# wedged mid-run (2026-07-31 01:22 UTC), in priority order — flagship
+# BERT first, then the small causal-bwd precision probe, then the rest
+# of the BASELINE matrix. Each row re-probes via bench.py's built-in
+# aliveness check, so a wedged tunnel costs 75 s per row, not a hang.
+#
+# Usage: bash tools/tpu_recover.sh  (typically via tpu_watchdog.sh)
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tpu_recover.log
+say() { echo "== $*" | tee -a "$LOG"; }
+
+say "$(date -u +%FT%TZ) recover start"
+
+say "bench bert (flagship — lost on day 1)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model bert --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "causal bwd precision probe (fa_causal/fa_d128 smoke fails)"
+timeout 300 python tools/causal_bwd_probe.py 2>&1 | tee -a "$LOG"
+
+say "bench gpt"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench transformer_big"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model transformer_big \
+  --steps 10 2>&1 | tee -a "$LOG"
+
+say "bench ernie"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model ernie --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench ctr (DeepFM sparse pull-push)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model ctr --steps 10 \
+  2>&1 | tee -a "$LOG"
+
+say "bench gpt long-context (seq 2048)"
+PT_BENCH_WALL=420 timeout 460 python bench.py --model gpt --steps 10 \
+  --seq 2048 --batch 4 2>&1 | tee -a "$LOG"
+
+say "per-op latency harness (re-run with the DCE-proof timing fix)"
+timeout 560 python tools/op_bench.py --n 20 2>&1 | tee -a "$LOG"
+
+say "bench resnet50 WITHOUT conv_custom_vjp (isolate the VJP delta)"
+PT_FLAGS_conv_custom_vjp=0 PT_BENCH_WALL=420 timeout 460 \
+  python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
+
+# LAST on purpose: the day-1 run wedged the tunnel right after this row's
+# 240 s attempt-kill (a client killed mid-compile seems to wedge the
+# server side). Generous windows, one attempt, nothing scheduled after.
+say "bench resnet50 batch 256 (longer window — compile blew 240 s on day 1)"
+PT_BENCH_WALL=560 PT_BENCH_TIMEOUT=540 PT_BENCH_ATTEMPTS=1 timeout 600 \
+  python bench.py --model resnet50 --steps 10 --batch 256 \
+  2>&1 | tee -a "$LOG"
+
+say "$(date -u +%FT%TZ) recover done"

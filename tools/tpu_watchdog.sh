@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Probe the tunnel every PERIOD seconds; on recovery run the given
+# script (default tools/tpu_recover.sh) once, then keep watching so a
+# later recovery re-runs it (rows that already produced a number are
+# cheap to repeat thanks to the persistent compile cache).
+#
+# Usage: bash tools/tpu_watchdog.sh [script] [period_s] [max_runs]
+set -u
+cd "$(dirname "$0")/.."
+SCRIPT=${1:-tools/tpu_recover.sh}
+PERIOD=${2:-600}
+MAX=${3:-3}
+LOG=tools/tpu_watchdog.log
+runs=0
+while [ "$runs" -lt "$MAX" ]; do
+  if timeout 100 python -c "
+import jax, jax.numpy as jnp
+jax.devices()
+(jnp.ones((128,128))@jnp.ones((128,128))).block_until_ready()
+print('PROBE_OK')" 2>/dev/null | grep -q PROBE_OK; then
+    echo "$(date -u +%FT%TZ) tunnel up — running $SCRIPT" | tee -a "$LOG"
+    bash "$SCRIPT"
+    runs=$((runs + 1))
+  else
+    echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"
+  fi
+  sleep "$PERIOD"
+done

@@ -88,8 +88,3 @@ define_flag("reader_queue_size", 2, "Device prefetch depth for DataLoader.")
 # distributed
 define_flag("dist_heartbeat_interval_s", 10.0, "Heartbeat interval (DCN).")
 define_flag("dist_heartbeat_timeout_s", 300.0, "Peer failure timeout.")
-
-define_flag("maxpool_custom_vjp", False,
-            "Max-pool backward as argmax scatter-add instead of XLA's "
-            "SelectAndScatter (ResNet maxpool-grad hot spot; enable after "
-            "TPU validation via tools/tpu_smoke.py)")

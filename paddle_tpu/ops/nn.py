@@ -261,96 +261,18 @@ def _pool(x, pool_size, stride, padding, data_format, init, op, norm=None):
     return out
 
 
-def _maxpool_with_argmax(x, pool_size, stride, padding, data_format):
-    """(pooled, flat-argmax-into-HxW-plane) via a tuple reduce_window."""
-    if data_format == "NCHW":
-        n, c, h, w = x.shape
-        idx_plane = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
-    else:
-        n, h, w, c = x.shape
-        idx_plane = jnp.arange(h * w, dtype=jnp.int32).reshape(1, h, w, 1)
-    idx_plane = jnp.broadcast_to(idx_plane, x.shape)
-
-    def sel(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv > av
-        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
-
-    pool_size2, stride2 = _pair(pool_size), _pair(stride)
-    if data_format == "NCHW":
-        window = (1, 1) + pool_size2
-        strides = (1, 1) + stride2
-    else:
-        window = (1,) + pool_size2 + (1,)
-        strides = (1,) + stride2 + (1,)
-    p = _pair(padding) if not isinstance(padding, str) else None
-    if p is None:
-        pad = padding.upper()
-    elif data_format == "NCHW":
-        pad = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
-    else:
-        pad = [(0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0)]
-    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-           else jnp.iinfo(x.dtype).min)
-    vals, idxs = lax.reduce_window(
-        (x, idx_plane), (jnp.asarray(neg, x.dtype), jnp.int32(-1)),
-        sel, window, strides, pad)
-    return vals, idxs
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
-def _maxpool_cv(x, pool_size, stride, padding, data_format, in_shape):
-    return _maxpool_with_argmax(x, pool_size, stride, padding,
-                                data_format)[0]
-
-
-def _maxpool_cv_fwd(x, pool_size, stride, padding, data_format, in_shape):
-    vals, idxs = _maxpool_with_argmax(x, pool_size, stride, padding,
-                                      data_format)
-    return vals, idxs
-
-
-def _maxpool_cv_bwd(pool_size, stride, padding, data_format, in_shape, res,
-                    g):
-    """Backward as ONE scatter-add of output grads into the argmax
-    positions — replaces XLA's SelectAndScatter lowering (each overlapping
-    window re-scans its inputs), the maxpool-grad hot spot in the r2
-    ResNet-50 profile (BASELINE.md)."""
-    idxs = res
-    if data_format == "NCHW":
-        n, c, h, w = in_shape
-        flat_idx = idxs.reshape(n * c, -1)               # [N*C, Ho*Wo]
-        gflat = g.reshape(n * c, -1)
-    else:
-        n, h, w, c = in_shape
-        # move C next to N so each (n, c) plane scatters independently
-        flat_idx = idxs.transpose(0, 3, 1, 2).reshape(n * c, -1)
-        gflat = g.transpose(0, 3, 1, 2).reshape(n * c, -1)
-    dx = jnp.zeros((n * c, h * w), g.dtype)
-    rows = jnp.arange(n * c, dtype=jnp.int32)[:, None]
-    # all-padding windows carry a -1 sentinel; negative indices WRAP in
-    # jax scatter, so push them out of bounds where mode="drop" discards
-    flat_idx = jnp.where(flat_idx < 0, h * w, flat_idx)
-    dx = dx.at[rows, flat_idx].add(gflat, mode="drop")
-    if data_format == "NCHW":
-        dx = dx.reshape(n, c, h, w)
-    else:
-        dx = dx.reshape(n, c, h, w).transpose(0, 2, 3, 1)
-    return (dx,)
-
-
-_maxpool_cv.defvjp(_maxpool_cv_fwd, _maxpool_cv_bwd)
-
-
 @register_op("pool2d")
 def pool2d(x, pool_size=2, pool_type="max", stride=None, padding=0,
            global_pooling=False, exclusive=True, data_format="NCHW"):
     """ref: operators/pool_op.cc. exclusive avg excludes padding from count.
 
-    Max pooling's backward uses an argmax scatter-add instead of XLA's
-    SelectAndScatter when the `maxpool_custom_vjp` flag is set (the
-    maxpool-grad lowering was a measured hot spot in the ResNet-50 step)."""
+    Max pooling's backward is XLA's native SelectAndScatter. An
+    argmax scatter-add alternative (flag `maxpool_custom_vjp`) was
+    built in r3 and REMOVED after silicon measurement (2026-07-31):
+    duplicate-index scatters serialize on TPU — 327 ms/step vs
+    48 ms on the ResNet-50 bench — while the native lowering already
+    runs near the HBM roofline (874 us for the stem maxpool-grad,
+    ~530 GB/s). See BASELINE.md "Second silicon window"."""
     if global_pooling:
         axes = (2, 3) if data_format == "NCHW" else (1, 2)
         if pool_type == "max":
@@ -358,11 +280,6 @@ def pool2d(x, pool_size=2, pool_type="max", stride=None, padding=0,
         return jnp.mean(x, axis=axes, keepdims=True)
     stride = stride if stride is not None else pool_size
     if pool_type == "max":
-        from paddle_tpu.core.flags import get_flag
-        if get_flag("maxpool_custom_vjp"):
-            return _maxpool_cv(x, _pair(pool_size), _pair(stride), padding
-                               if isinstance(padding, str)
-                               else _pair(padding), data_format, x.shape)
         return _pool(x, pool_size, stride, padding, data_format,
                      -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
                      else jnp.iinfo(x.dtype).min, lax.max)

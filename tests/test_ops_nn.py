@@ -273,47 +273,6 @@ def test_conv_custom_vjp_escape_hatch_restores_jvp():
         set_flags({"conv_custom_vjp": True})
 
 
-class TestMaxPoolCustomVJP:
-    """maxpool_custom_vjp flag: argmax scatter-add backward must match
-    XLA's SelectAndScatter gradients exactly (overlapping windows included
-    — the ResNet stem's 3x3/s2/p1 case from the r2 profile)."""
-
-    def _grads(self, x, flag, **kw):
-        from paddle_tpu.core.flags import set_flags
-        from paddle_tpu.ops import nn as F
-        set_flags({"maxpool_custom_vjp": flag})
-        try:
-            out, vjp = jax.vjp(
-                lambda a: F.pool2d(a, pool_type="max", **kw), x)
-            g = jax.random.normal(jax.random.key(9), out.shape, out.dtype)
-            (dx,) = vjp(g)
-        finally:
-            set_flags({"maxpool_custom_vjp": False})
-        return np.asarray(out), np.asarray(dx)
-
-    @pytest.mark.parametrize("df", ["NCHW", "NHWC"])
-    def test_overlapping_windows_match(self, df):
-        rng = np.random.RandomState(0)
-        shape = (2, 3, 9, 9) if df == "NCHW" else (2, 9, 9, 3)
-        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
-        kw = dict(pool_size=3, stride=2, padding=1, data_format=df)
-        out_ref, dx_ref = self._grads(x, False, **kw)
-        out_cv, dx_cv = self._grads(x, True, **kw)
-        np.testing.assert_allclose(out_cv, out_ref, rtol=1e-6)
-        np.testing.assert_allclose(dx_cv, dx_ref, rtol=1e-6, atol=1e-6)
-
-    def test_non_overlapping_and_same_padding(self):
-        rng = np.random.RandomState(1)
-        x = jnp.asarray(rng.randn(1, 2, 8, 8).astype(np.float32))
-        for kw in (dict(pool_size=2, stride=2, padding=0),
-                   dict(pool_size=3, stride=3, padding="SAME")):
-            out_ref, dx_ref = self._grads(x, False, data_format="NCHW",
-                                          **kw)
-            out_cv, dx_cv = self._grads(x, True, data_format="NCHW", **kw)
-            np.testing.assert_allclose(out_cv, out_ref, rtol=1e-6)
-            np.testing.assert_allclose(dx_cv, dx_ref, rtol=1e-6, atol=1e-6)
-
-
 def test_conv_custom_vjp_resnet50_config_sweep():
     """conv_custom_vjp parity vs jax's native conv gradients at EVERY
     distinct conv configuration ResNet-50 actually runs (NHWC): the 7x7/s2

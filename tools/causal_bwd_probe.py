@@ -30,6 +30,9 @@ import jax.numpy as jnp
 
 
 def main():
+    # without x64 the "float64 ground truth" silently downcasts to f32
+    # and every |X - R| bottoms out at f32 rounding noise
+    jax.config.update("jax_enable_x64", True)
     from paddle_tpu.ops.pallas.flash_attention import (
         chunked_attention, flash_attention)
 

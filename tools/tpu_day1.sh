@@ -39,10 +39,6 @@ say "bench resnet50 (NHWC bf16 + conv_custom_vjp) + per-fusion profile"
 PT_BENCH_PROFILE=/tmp/pt_prof_resnet PT_BENCH_WALL=420 timeout 460 \
   python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
 
-say "bench resnet50 with maxpool scatter backward"
-PT_FLAGS_maxpool_custom_vjp=1 PT_BENCH_WALL=420 timeout 460 \
-  python bench.py --model resnet50 --steps 10 2>&1 | tee -a "$LOG"
-
 say "bench resnet50 batch 256 (HBM-residency probe from the r2 plan)"
 PT_BENCH_WALL=420 timeout 460 python bench.py --model resnet50 --steps 10 \
   --batch 256 2>&1 | tee -a "$LOG"
@@ -68,5 +64,4 @@ PT_BENCH_WALL=420 timeout 460 python bench.py --model ctr --steps 10 \
   2>&1 | tee -a "$LOG"
 
 say "$(date -u +%FT%TZ) tpu_day1 done — record rows in BASELINE.md; flip"
-say "maxpool_custom_vjp default if the scatter row wins; flip any flash"
-say "defaults guarded by smoke results"
+say "any flash defaults guarded by smoke results"

@@ -42,6 +42,13 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    if args.interpret:
+        # CPU self-check must not touch the axon tunnel at all — a wedged
+        # tunnel blocks jax.devices() forever (sitecustomize pre-imports
+        # jax with the axon platform; config override still works before
+        # the backend initializes)
+        jax.config.update("jax_platforms", "cpu")
+
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform}) "
           f"[init {time.time() - t0:.1f}s]", flush=True)
@@ -183,7 +190,9 @@ def main():
     check("conv_vjp_dx", _maxdiff(gxc, rxc), 5e-2)
     check("conv_vjp_dw", _maxdiff(gwc, rwc), 5e-2)
 
-    # ---- 4b. maxpool custom VJP (argmax scatter vs SelectAndScatter) ---
+    # ---- 4b. maxpool grad (native SelectAndScatter executes on silicon) -
+    # (the argmax-scatter alternative was removed after the 2026-07-31
+    # silicon run: duplicate-index scatters serialize on TPU, 327 ms/step)
     xm = jnp.asarray(rng.randn(32, 112, 112, 64).astype(np.float32))
 
 
@@ -191,14 +200,9 @@ def main():
         return jnp.sum(F.pool2d(x_, 3, "max", 2, padding=1,
                                 data_format="NHWC") ** 2)
 
-    set_flags({"maxpool_custom_vjp": True})
-    try:
-        mp_cv = jax.jit(jax.grad(mp_loss))(xm)
-        mp_cv.block_until_ready()
-    finally:
-        set_flags({"maxpool_custom_vjp": False})
     mp_ref = jax.jit(jax.grad(mp_loss))(xm)
-    check("maxpool_vjp_dx", _maxdiff(mp_cv, mp_ref), 1e-3)
+    mp_ref.block_until_ready()
+    check("maxpool_grad_runs", 0.0, 1e-3)
 
     # ---- 4c. ring flash attention fwd+bwd on silicon -------------------
     # a 1-device mesh runs the REAL ring code path (fori_loop + ppermute +
@@ -261,23 +265,16 @@ def main():
         t_fl = timeit(fl, q, k, v)
         t_ch = timeit(ch, q, k, v)
         t_flb = timeit(jax.jit(fl_bwd), q, k, v)
-        set_flags({"maxpool_custom_vjp": True})
-        try:
-            t_mp_cv = timeit(jax.jit(jax.grad(mp_loss)), xm)
-        finally:
-            set_flags({"maxpool_custom_vjp": False})
         t_mp_ref = timeit(jax.jit(jax.grad(mp_loss)), xm)
         results["timing_ms"] = {
             "flash_fwd": round(t_fl * 1e3, 3),
             "chunked_fwd": round(t_ch * 1e3, 3),
             "flash_fwd_bwd": round(t_flb * 1e3, 3),
-            "maxpool_grad_scatter": round(t_mp_cv * 1e3, 3),
             "maxpool_grad_selscatter": round(t_mp_ref * 1e3, 3),
         }
         print(f"timing b8 h12 t512 d64: flash {t_fl*1e3:.3f} ms, "
               f"chunked {t_ch*1e3:.3f} ms, flash f+b {t_flb*1e3:.3f} ms; "
-              f"maxpool-grad scatter {t_mp_cv*1e3:.3f} ms vs "
-              f"sel-scatter {t_mp_ref*1e3:.3f} ms",
+              f"maxpool-grad sel-scatter {t_mp_ref*1e3:.3f} ms",
               flush=True)
 
     print(json.dumps({"ok": not failed, "failed": failed,

@@ -68,6 +68,10 @@ define_flag("matmul_precision", "default", "jax.lax matmul precision.")
 # custom_vjp does not support forward-mode autodiff — disable for jvp/hessian
 define_flag("conv_custom_vjp", True,
             "Use the TPU-fast custom conv backward (no jvp support).")
+define_flag("resnet_s2d_stem", False,
+            "Compute the ResNet 7x7/s2 stem as an exact 4x4/s1 conv over "
+            "space-to-depth(2) input (NHWC only). Avoids the C=3 lane-"
+            "padding traffic on TPU; flip after silicon measurement.")
 # run Pallas kernels through the interpreter — engages the kernels even
 # off-TPU (CPU testing of kernel logic)
 define_flag("pallas_interpret", False,

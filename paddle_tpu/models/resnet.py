@@ -13,7 +13,33 @@ import jax.numpy as jnp
 
 from paddle_tpu import initializer as I
 from paddle_tpu import nn
+from paddle_tpu.core.flags import get_flag
 from paddle_tpu.ops import nn as F
+
+
+def _space_to_depth_nhwc(x, b=2):
+    """[N,H,W,C] -> [N,H/b,W/b,b*b*C]; channel order (di, dj, c)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // b, w // b, b * b * c)
+
+
+def _stem_s2d_weights(w):
+    """Rewrite the 7x7/s2 stem kernel [7,7,cin,cout] (HWIO) into the exact
+    4x4/s1 kernel over space-to-depth(2) input, [4,4,4*cin,cout].
+
+    The 7-tap/stride-2/pad-3 window [2o-3, 2o+3] is zero-padded on the
+    top/left to 8 taps covering [2o-4, 2o+3] = s2d rows o-2..o+1, i.e. a
+    4-tap stride-1 conv on the halved grid with padding (2, 1). This is the
+    standard TPU ResNet stem transform: a C=3 NHWC conv wastes almost the
+    whole (8,128) register tile on channel padding; C=12 at half the
+    spatial size quarters the padded-lane traffic. Numerically exact
+    (pure index rewrite, no approximation)."""
+    k, _, cin, cout = w.shape
+    assert k == 7, "s2d stem transform expects the 7x7 ImageNet stem"
+    w8 = jnp.pad(w, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    ws = w8.reshape(4, 2, 4, 2, cin, cout).transpose(0, 2, 1, 3, 4, 5)
+    return ws.reshape(4, 4, 4 * cin, cout)
 
 
 class ConvBN(nn.Module):
@@ -111,7 +137,17 @@ class ResNet(nn.Module):
     def forward(self, x):
         if self.data_format == "NHWC":
             x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW input -> NHWC compute
-        x = self.stem(x)
+        if (not self.small_input and self.data_format == "NHWC"
+                and get_flag("resnet_s2d_stem")):
+            w = _stem_s2d_weights(self.stem.conv.p("weight"))
+            # through F.conv2d so the backward uses the same conv_custom_vjp
+            # path as the 7x7 form — the s2d A/B on silicon must isolate the
+            # layout rewrite, not switch VJPs at the same time
+            x = F.conv2d(_space_to_depth_nhwc(x), w.astype(x.dtype),
+                         padding=((2, 1), (2, 1)), data_format="NHWC")
+            x = self.stem.bn(x)
+        else:
+            x = self.stem(x)
         if not self.small_input:
             x = F.pool2d(x, 3, "max", 2, padding=1,
                          data_format=self.data_format)

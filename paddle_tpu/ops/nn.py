@@ -152,7 +152,10 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         pad = padding.upper()  # 'SAME' | 'VALID'
     else:
         p = _pair(padding)
-        pad = [(p[0], p[0]), (p[1], p[1])]
+        if isinstance(p[0], (tuple, list)):  # per-side ((lo,hi),(lo,hi))
+            pad = [tuple(p[0]), tuple(p[1])]
+        else:
+            pad = [(p[0], p[0]), (p[1], p[1])]
     if groups == 1 and get_flag("conv_custom_vjp"):
         if data_format == "NHWC":
             x_sp = (x.shape[1], x.shape[2])

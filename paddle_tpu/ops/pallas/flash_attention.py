@@ -460,6 +460,11 @@ def chunked_attention(q, k, v, scale=None, causal=False, kv_mask=None,
     Same semantics as the Pallas path: kv_mask [B, Tk] (True = attend);
     fully-masked rows yield exactly zero output."""
     scale = scale if scale is not None else 1.0 / jnp.sqrt(q.shape[-1])
+    # accumulate in f32, except when fed f64 inputs (the precision-probe
+    # ground-truth path under jax_enable_x64) — then keep full f64 so the
+    # baseline really is higher-precision than the kernel under test
+    acc_dtype = jnp.float64 if q.dtype == jnp.float64 else jnp.float32
+    scale = jnp.asarray(scale, acc_dtype)
     b, h, tq, d = q.shape
     tk = k.shape[2]
     chunk = min(chunk_size, tk)
@@ -474,7 +479,7 @@ def chunked_attention(q, k, v, scale=None, causal=False, kv_mask=None,
         mc = jnp.pad(kv_mask.astype(bool), ((0, 0), (0, pad)),
                      constant_values=False)
         mc = mc.reshape(b, nchunks, chunk).transpose(1, 0, 2)  # [N, B, C]
-    qf = q.astype(jnp.float32)
+    qf = q.astype(acc_dtype)
     # bottom-right aligned causal (matches scaled_dot_product_attention)
     q_pos = jnp.arange(tq) + (tk - tq)
 
@@ -484,7 +489,7 @@ def chunked_attention(q, k, v, scale=None, causal=False, kv_mask=None,
             kb, vb, ci, mb = inp
         else:
             kb, vb, ci = inp
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(acc_dtype)) * scale
         k_pos = ci * chunk + jnp.arange(chunk)
         valid = jnp.broadcast_to((k_pos < tk)[None, None, None, :], s.shape)
         if causal:
@@ -499,12 +504,12 @@ def chunked_attention(q, k, v, scale=None, causal=False, kv_mask=None,
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, -1, keepdims=True)
         acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                       vb.astype(jnp.float32))
+                                       vb.astype(acc_dtype))
         return (m_new, l, acc), None
 
-    m0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
-    acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    m0 = jnp.full((b, h, tq, 1), NEG_INF, acc_dtype)
+    l0 = jnp.zeros((b, h, tq, 1), acc_dtype)
+    acc0 = jnp.zeros((b, h, tq, d), acc_dtype)
     xs = (kc, vc, jnp.arange(nchunks))
     if kv_mask is not None:
         xs = xs + (mc,)

@@ -1,0 +1,49 @@
+"""Space-to-depth stem transform parity (PT_FLAGS_resnet_s2d_stem).
+
+The 7x7/s2/p3 ImageNet stem conv re-expressed as a 4x4/s1 conv over
+space-to-depth(2) input must be numerically exact (index rewrite only).
+Ref: the reference builds the same stem via conv_bn_layer 7x7/s2
+(tests/book image classification recipes); the s2d form is the TPU-first
+lowering of it (C=3 NHWC convs waste the 128-lane register tile).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import flags
+from paddle_tpu.models.resnet import (
+    ResNet, _space_to_depth_nhwc, _stem_s2d_weights)
+
+
+def test_stem_s2d_matches_7x7_stride2():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+    w = jnp.asarray(rng.randn(7, 7, 3, 16).astype(np.float32))
+    ref = lax.conv_general_dilated(
+        x, w, (2, 2), ((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = lax.conv_general_dilated(
+        _space_to_depth_nhwc(x), _stem_s2d_weights(w), (1, 1),
+        ((2, 1), (2, 1)), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert ref.shape == got.shape
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_resnet_forward_invariant_under_s2d_flag():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 3, 64, 64).astype(np.float32))
+    model = ResNet(18, num_classes=10)
+    variables = model.init(jax.random.key(0))
+    old = flags.get_flag("resnet_s2d_stem")
+    try:
+        flags.set_flags({"resnet_s2d_stem": False})
+        base = model.apply(variables, x)
+        flags.set_flags({"resnet_s2d_stem": True})
+        s2d = model.apply(variables, x)
+    finally:
+        flags.set_flags({"resnet_s2d_stem": old})
+    np.testing.assert_allclose(np.asarray(base), np.asarray(s2d),
+                               atol=1e-4, rtol=1e-4)

@@ -202,7 +202,10 @@ def main():
 
     mp_ref = jax.jit(jax.grad(mp_loss))(xm)
     mp_ref.block_until_ready()
-    check("maxpool_grad_runs", 0.0, 1e-3)
+    # executed marker only: this exercises that select-and-scatter lowers and
+    # runs on silicon; numeric maxpool-grad parity is covered by the CPU suite
+    results["maxpool_grad_runs"] = {"ok": True}
+    print("PASS maxpool_grad_runs (executed)", flush=True)
 
     # ---- 4c. ring flash attention fwd+bwd on silicon -------------------
     # a 1-device mesh runs the REAL ring code path (fori_loop + ppermute +

@@ -125,12 +125,28 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
-    mlm_labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
     nsp_labels = jnp.asarray(rng.randint(0, 2, (batch,), dtype=np.int32))
-    mask = jnp.asarray((rng.rand(batch, seq) < 0.15).astype(np.float32))
+    # Masked-position gather (reference parity: the recipe gathers mask_pos
+    # before the vocab fc). PT_BENCH_FULL_MLM=1 restores the all-positions
+    # head for A/B.
+    full_mlm = os.environ.get("PT_BENCH_FULL_MLM", "0") == "1"
+    if full_mlm:
+        mask_pos = None
+        mlm_labels = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
+        mask = jnp.asarray((rng.rand(batch, seq) < 0.15).astype(np.float32))
+    else:
+        n_mask = max(1, int(0.15 * seq))
+        mask_pos = jnp.asarray(np.stack([
+            np.sort(rng.choice(seq, n_mask, replace=False))
+            for _ in range(batch)]).astype(np.int32))
+        mlm_labels = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch, n_mask), dtype=np.int32))
+        mask = jnp.ones((batch, n_mask), jnp.float32)
 
     def loss_fn(p, ids, mlm_l, nsp_l, m):
-        mlm_logits, nsp_logits = model.apply({"params": p, "state": {}}, ids)
+        mlm_logits, nsp_logits = model.apply({"params": p, "state": {}}, ids,
+                                             mask_positions=mask_pos)
         return pretrain_loss(mlm_logits, nsp_logits, mlm_l, nsp_l, m), 0.0
 
     def train_step(params, opt_state, ids, mlm_l, nsp_l, m):
@@ -297,7 +313,12 @@ def bench_resnet(steps, batch):
     from paddle_tpu.models.resnet import resnet50
     from paddle_tpu.ops import loss as L
 
-    model = resnet50(num_classes=1000)
+    # PT_BENCH_NHWC_FEED=1: feed bf16 NHWC batches straight from the host
+    # (what a TPU-first input pipeline produces) instead of the reference's
+    # f32 NCHW convention — removes the per-step transpose+cast copy.
+    nhwc_feed = os.environ.get("PT_BENCH_NHWC_FEED", "0") == "1"
+    model = resnet50(num_classes=1000,
+                     input_layout="NHWC" if nhwc_feed else "NCHW")
     variables = model.init(jax.random.key(0))
     params, state = variables["params"], variables["state"]
 
@@ -307,7 +328,11 @@ def bench_resnet(steps, batch):
     opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)
-    images = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32))
+    if nhwc_feed:
+        images = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32),
+                             dtype=jnp.bfloat16)
+    else:
+        images = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32))
     labels = jnp.asarray(rng.randint(0, 1000, (batch, 1), dtype=np.int32))
 
     def loss_fn(p, images, labels, state):

@@ -109,9 +109,18 @@ class BertForPretraining(nn.Module):
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size, act="tanh")
         self.nsp = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mask_positions=None):
+        """mask_positions [B, M] (int): gather only the masked positions
+        before the MLM transform + vocab projection, the way the reference
+        recipe gathers mask_pos before its fc — at 15% masking this skips
+        ~85% of the head's [*, H]x[H, V] MXU work and its backward. Returned
+        mlm_logits are then [B, M, V] (align labels/weights to the same
+        positions). None keeps the full [B, T, V] head."""
         h = self.encoder(input_ids, token_type_ids, attention_mask)
-        mlm_h = self.mlm_ln(self.mlm_transform(h))
+        hm = h if mask_positions is None else jnp.take_along_axis(
+            h, mask_positions[..., None], axis=1)
+        mlm_h = self.mlm_ln(self.mlm_transform(hm))
         # weight tying with token embedding (standard BERT)
         emb = self.encoder.tok_emb.p("weight")
         mlm_logits = mlm_h @ emb.T + self.p("mlm_bias")

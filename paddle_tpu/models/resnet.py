@@ -110,11 +110,15 @@ class ResNet(nn.Module):
     layout churn."""
 
     def __init__(self, depth=50, num_classes=1000, small_input=False,
-                 data_format="NHWC"):
+                 data_format="NHWC", input_layout="NCHW"):
         super().__init__()
         block, layers = _CONFIGS[depth]
         self.small_input = small_input
         self.data_format = data_format
+        # input_layout: layout of the *incoming* batch. Default NCHW per the
+        # reference convention (one transpose at the stem); a TPU-first input
+        # pipeline should feed NHWC directly and skip that per-step copy.
+        self.input_layout = input_layout
         df = data_format
         if small_input:  # CIFAR-style stem (ref: tests/book resnet_cifar10)
             self.stem = ConvBN(3, 64, 3, data_format=df)
@@ -135,7 +139,7 @@ class ResNet(nn.Module):
                             weight_init=I.uniform(-0.01, 0.01))
 
     def forward(self, x):
-        if self.data_format == "NHWC":
+        if self.data_format == "NHWC" and self.input_layout == "NCHW":
             x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW input -> NHWC compute
         if (not self.small_input and self.data_format == "NHWC"
                 and get_flag("resnet_s2d_stem")):

@@ -551,7 +551,7 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
-                    block_q=512, block_k=512):
+                    block_q=None, block_k=None):
     """Memory-efficient attention. q,k,v: [B, H, T, D]; kv_mask: [B, Tk]
     bool/0-1, True = attend (the key-padding mask of a padded batch).
 
@@ -564,6 +564,10 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
     Elsewhere: chunked XLA formulation (same math, same semantics).
     """
     from paddle_tpu.core.flags import get_flag
+    # default block sizes come from flags so a flash_tune.py sweep result
+    # applies fleet-wide via PT_FLAGS_flash_block_{q,k} (no code change)
+    block_q = block_q if block_q is not None else get_flag("flash_block_q")
+    block_k = block_k if block_k is not None else get_flag("flash_block_k")
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if (on_tpu() or get_flag("pallas_interpret")) and pltpu is not None:
         if q.shape[-1] % 64 == 0 and q.shape[2] % 8 == 0 \

@@ -393,3 +393,30 @@ class TestProgramDescRound3Ops:
         out = desc.build_fn()(x)["out"]
         np.testing.assert_allclose(
             np.asarray(out)[0, 0], np.log(3.0), rtol=1e-6)
+
+
+class TestMaskedMLMHead:
+    def test_masked_gather_head_matches_full_head(self):
+        """mask_positions must produce exactly the full head's logits at
+        those positions (reference parity: gather(mask_pos) before the
+        vocab fc)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+        cfg = BertConfig.tiny()
+        cfg.dropout = 0.0
+        model = BertForPretraining(cfg)
+        variables = model.init(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16),
+                                      dtype=np.int32))
+        pos = jnp.asarray(np.stack([np.sort(rng.choice(16, 3, replace=False))
+                                    for _ in range(2)]).astype(np.int32))
+        full, nsp_full = model.apply(variables, ids)
+        masked, nsp_m = model.apply(variables, ids, mask_positions=pos)
+        gathered = jnp.take_along_axis(full, pos[..., None], axis=1)
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(gathered),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(nsp_m), np.asarray(nsp_full),
+                                   atol=1e-6)

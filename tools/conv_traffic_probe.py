@@ -1,0 +1,101 @@
+"""Isolate WHERE ResNet-50's 234 MB/image HBM traffic (vs ~130 ideal) lives.
+
+Three micro-experiments on the real chip (see BASELINE.md roofline section):
+
+1. C-sweep: one ConvBN-relu fwd+bwd at C in {64, 128, 256} with spatial
+   sized so *logical* bytes moved are identical. If the (8,128) tile pads
+   C=64 lanes, the C=64 point runs at ~half the logical GB/s of C=128.
+2. Stem: the 7x7/s2 C=3->64 conv vs its exact space-to-depth rewrite.
+3. Input copy: the NCHW->NHWC transpose + f32->bf16 cast of a batch-128
+   image tensor (the per-step feed copy the NHWC_FEED bench row removes).
+
+Prints one JSON line per experiment with ms and logical GB/s.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tools._timing import device_time
+
+
+def run():
+    n_iter = int(os.environ.get("PT_PROBE_N", "10"))
+    # PT_PROBE_TINY=1: shrink every shape ~64x for a 1-core CPU code-path
+    # check (the numbers are meaningless off-silicon)
+    tiny = os.environ.get("PT_PROBE_TINY", "0") == "1"
+    B, BS, IMG = (2, 2, 32) if tiny else (32, 128, 224)
+    from paddle_tpu.models.resnet import (_space_to_depth_nhwc,
+                                          _stem_s2d_weights)
+    from paddle_tpu.ops import nn as F
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
+    rng = np.random.RandomState(0)
+
+    # ---- 1. C-sweep at constant logical bytes -------------------------
+    # 3x3 conv C->C, NHWC bf16, B=32. Logical activation bytes/call scale
+    # with B*H*W*C; hold H*W*C fixed at 56*56*256.
+    for c, hw in ((64, 112), (128, 79), (256, 56)):
+        hw = hw // 4 if tiny else hw
+        x = jnp.asarray(rng.randn(B, hw, hw, c), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(3, 3, c, c) * 0.05, jnp.bfloat16)
+
+        def fwd_bwd(x, w):
+            def loss(x, w):
+                y = F.conv2d(x, w, padding=1, data_format="NHWC")
+                return jnp.sum(jnp.maximum(y, 0.0).astype(jnp.float32))
+            return jax.grad(loss, argnums=(0, 1))(x, w)
+
+        t = device_time(fwd_bwd, (x, w), n=n_iter)
+        # fwd: read x, write y; dgrad: read dy, write dx; wgrad: read x+dy
+        # -> 6 activation-sized transfers of B*HW^2*C*2 bytes
+        gb = 6 * B * hw * hw * c * 2 / 1e9
+        print(json.dumps({"probe": f"convbn_c{c}_hw{hw}",
+                          "ms": round(t * 1e3, 3),
+                          "logical_gbps": round(gb / t, 1)}), flush=True)
+
+    # ---- 2. stem: 7x7/s2 C=3 vs s2d 4x4/s1 C=12 ----------------------
+    xs = jnp.asarray(rng.rand(BS, IMG, IMG, 3), jnp.bfloat16)
+    w7 = jnp.asarray(rng.randn(7, 7, 3, 64) * 0.05, jnp.bfloat16)
+
+    def stem7(x, w):
+        def loss(x, w):
+            y = F.conv2d(x, w, stride=2, padding=3, data_format="NHWC")
+            return jnp.sum(y.astype(jnp.float32))
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    def stem_s2d(x, w):
+        def loss(x, w):
+            y = F.conv2d(_space_to_depth_nhwc(x), _stem_s2d_weights(w),
+                         padding=((2, 1), (2, 1)), data_format="NHWC")
+            return jnp.sum(y.astype(jnp.float32))
+        return jax.grad(loss, argnums=(0, 1))(x, w)
+
+    for name, fn in (("stem7x7_c3", stem7), ("stem_s2d_c12", stem_s2d)):
+        t = device_time(fn, (xs, w7), n=n_iter)
+        gb = (BS * IMG * IMG * 3 * 2 * 3 + BS * (IMG // 2) ** 2 * 64 * 2 * 2) / 1e9
+        print(json.dumps({"probe": name, "ms": round(t * 1e3, 3),
+                          "logical_gbps": round(gb / t, 1)}), flush=True)
+
+    # ---- 3. the input feed copy --------------------------------------
+    xc = jnp.asarray(rng.rand(BS, 3, IMG, IMG).astype(np.float32))
+
+    def feed_copy(x):
+        return jnp.transpose(x, (0, 2, 3, 1)).astype(jnp.bfloat16)
+
+    t = device_time(feed_copy, (xc,), n=n_iter)
+    gb = BS * 3 * IMG * IMG * (4 + 2) / 1e9
+    print(json.dumps({"probe": "nchw_to_nhwc_bf16_copy",
+                      "ms": round(t * 1e3, 3),
+                      "logical_gbps": round(gb / t, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    run()

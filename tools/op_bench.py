@@ -106,6 +106,9 @@ def main():
             row["tflops"] = round(flops / dt / 1e12, 2)
             row["mfu"] = round(flops / dt / peak_flops(), 4)
         print(json.dumps(row), flush=True)
+    # completion marker: recovery scripts gate their captured-state on this
+    # (a mid-sweep timeout must NOT count as captured)
+    print(json.dumps({"op_bench": "complete"}), flush=True)
 
 
 if __name__ == "__main__":

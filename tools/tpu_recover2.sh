@@ -48,22 +48,37 @@ row gpt             python bench.py --model gpt --steps 10
 row gpt2048         python bench.py --model gpt --steps 10 --seq 2048 --batch 4
 row resnet50_novjp  env PT_FLAGS_conv_custom_vjp=0 python bench.py --model resnet50 --steps 10
 row resnet50_s2d    env PT_FLAGS_resnet_s2d_stem=1 python bench.py --model resnet50 --steps 10
+row resnet50_nhwc   env PT_BENCH_NHWC_FEED=1 python bench.py --model resnet50 --steps 10
+row resnet50_fast   env PT_FLAGS_resnet_s2d_stem=1 PT_BENCH_NHWC_FEED=1 python bench.py --model resnet50 --steps 10
+# per-fusion profile of the flagship row: the 0.43->0.45+ BERT tail attack
+# needs to know where the non-flash milliseconds live
+row bert_profile    env PT_BENCH_PROFILE=/tmp/pt_bert_prof python bench.py --model bert --steps 10
 
-if [ ! -f "$CAP/causal_probe.ok" ]; then
-  say "causal bwd precision probe"
-  if timeout 420 python tools/causal_bwd_probe.py 2>&1 | tee -a "$LOG" \
-      | grep -q "pallas-ref"; then
-    touch "$CAP/causal_probe.ok"
+# tool <marker-name> <success-pattern> <timeout> <cmd...>: run to completion,
+# THEN grep the captured output — `tee | grep -q` would SIGPIPE-kill the
+# tool after its first matching line and lose the rest of its data.
+tool() {
+  marker=$1; pattern=$2; tmo=$3; shift 3
+  if [ -f "$CAP/$marker.ok" ]; then
+    say "skip $marker (captured)"
+    return 0
   fi
-fi
+  say "tool $marker: $*"
+  out=$(timeout "$tmo" "$@" 2>&1)
+  echo "$out" >> "$LOG"
+  if echo "$out" | grep -q "$pattern"; then
+    touch "$CAP/$marker.ok"
+    say "captured $marker"
+  else
+    say "MISS $marker"
+  fi
+}
 
-if [ ! -f "$CAP/op_bench.ok" ]; then
-  say "per-op latency harness"
-  if timeout 560 python tools/op_bench.py --n 20 2>&1 | tee -a "$LOG" \
-      | grep -q '"ms"'; then
-    touch "$CAP/op_bench.ok"
-  fi
-fi
+# patterns are each tool's FINAL output line so a mid-run timeout is a MISS
+tool causal_probe "fa_plain dv"   420 python tools/causal_bwd_probe.py
+tool conv_traffic "nchw_to_nhwc"  420 python tools/conv_traffic_probe.py
+tool op_bench     "op_bench.*complete" 560 python tools/op_bench.py --n 20
+tool flash_tune   "flip the flash" 560 python tools/flash_tune.py --quick
 
 # riskiest compile LAST (blew a 240 s window on day 1)
 row resnet50_b256   python bench.py --model resnet50 --steps 10 --batch 256

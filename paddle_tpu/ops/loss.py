@@ -44,16 +44,21 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                                return_softmax=False):
     """ref: operators/softmax_with_cross_entropy_op.cc — fused stable form."""
     logz = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
-    log_probs = logits - logz
     if soft_label:
-        loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
+        loss = -jnp.sum(label * (logits - logz), axis=axis, keepdims=True)
     else:
+        # gather logits at the label BEFORE forming log-probs:
+        # -log_prob[y] == logz - logits[y]. Gathering from the (logits -
+        # logz) fusion would make XLA materialize the full [..., V] tensor
+        # just to read one element per row — at LM-head vocab sizes that is
+        # an extra GB-scale HBM pass.
         lbl = _squeeze_label(label)
         picked = jnp.take_along_axis(
-            log_probs, jnp.maximum(lbl, 0)[..., None], axis=axis)[..., 0]
-        loss = jnp.where(lbl == ignore_index, 0.0, -picked)[..., None]
+            logits, jnp.maximum(lbl, 0)[..., None], axis=axis)
+        loss = jnp.where(lbl == ignore_index, 0.0,
+                         (logz - picked)[..., 0])[..., None]
     if return_softmax:
-        return loss, jnp.exp(log_probs)
+        return loss, jnp.exp(logits - logz)
     return loss
 
 

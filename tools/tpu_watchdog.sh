@@ -1,13 +1,13 @@
 #!/usr/bin/env bash
 # Probe the tunnel every PERIOD seconds; on recovery run the given
-# script (default tools/tpu_recover.sh) once, then keep watching so a
-# later recovery re-runs it (rows that already produced a number are
-# cheap to repeat thanks to the persistent compile cache).
+# script (default tools/tpu_recover2.sh) once, then keep watching so a
+# later recovery re-runs it (recover2 skips rows already captured under
+# tools/captured/, so re-runs go straight to the missing rows).
 #
 # Usage: bash tools/tpu_watchdog.sh [script] [period_s] [max_runs]
 set -u
 cd "$(dirname "$0")/.."
-SCRIPT=${1:-tools/tpu_recover.sh}
+SCRIPT=${1:-tools/tpu_recover2.sh}
 PERIOD=${2:-600}
 MAX=${3:-3}
 LOG=tools/tpu_watchdog.log

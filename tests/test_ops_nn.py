@@ -41,6 +41,35 @@ class TestConv2D:
         check_output(lambda a, b: F.conv2d(a, b, stride=2, padding=1),
                      lambda a, b: np_conv2d(a, b, 2, 1), [x, w], atol=1e-4)
 
+    def test_per_side_padding(self):
+        """((lo,hi),(lo,hi)) padding — used by the s2d ResNet stem. Must
+        match explicit jnp.pad + VALID conv, on both the custom-VJP and
+        native paths."""
+        from paddle_tpu.core import flags
+        x, w = r((1, 2, 8, 8)), r((3, 2, 3, 3), 1)
+        xp = np.pad(x, ((0, 0), (0, 0), (2, 1), (1, 0)))
+        ref = np_conv2d(xp, w)
+        for custom in (True, False):
+            old = flags.get_flag("conv_custom_vjp")
+            try:
+                flags.set_flags({"conv_custom_vjp": custom})
+                out = F.conv2d(jnp.asarray(x), jnp.asarray(w),
+                               padding=((2, 1), (1, 0)))
+            finally:
+                flags.set_flags({"conv_custom_vjp": old})
+            np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4,
+                                       err_msg=f"custom_vjp={custom}")
+        # the custom backward swaps lo/hi pads for dgrad — finite-difference
+        # check the asymmetric case (the s2d stem trains through it)
+        old = flags.get_flag("conv_custom_vjp")
+        try:
+            flags.set_flags({"conv_custom_vjp": True})
+            check_grad(
+                lambda a, b: F.conv2d(a, b, padding=((2, 1), (1, 0))),
+                [r((1, 2, 6, 6)), r((2, 2, 3, 3), 1)], arg_idx=1)
+        finally:
+            flags.set_flags({"conv_custom_vjp": old})
+
     def test_groups(self):
         x, w = r((1, 4, 6, 6)), r((4, 2, 3, 3), 1)
         out = F.conv2d(jnp.asarray(x), jnp.asarray(w), groups=2)

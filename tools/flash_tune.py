@@ -64,7 +64,11 @@ def main():
 
     rng = np.random.RandomState(0)
     for name, b, h, t, d, causal in shapes:
-        q = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+        # bf16 inputs — the bench path runs flash under the amp bf16
+        # policy, and block optima can differ by dtype (VMEM footprint
+        # halves). Interpret mode keeps f32 (Mosaic-free plumbing check).
+        dtype = jnp.float32 if args.interpret else jnp.bfloat16
+        q = jnp.asarray(rng.randn(b, h, t, d), dtype)
         rows = []
         print(f"\n{name} [B={b} H={h} T={t} D={d} causal={causal}]",
               flush=True)

@@ -134,25 +134,40 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     """ref: operators/optimizers/momentum_op.h (velocity = mu*v + g;
-    p -= lr * (g + mu*v) if nesterov else lr*v)."""
+    p -= lr * (g + mu*v) if nesterov else lr*v).
+
+    state_dtype: storage dtype for the velocity slot (default: param
+    dtype). bf16 velocity halves the optimizer's HBM traffic — for
+    HBM-bound models (ResNet-50: ~100 MB of f32 velocity r+w per step)
+    that is ~1 ms/step on v5e at the cost of ~3 decimal digits on a
+    quantity that is itself a lossy running average. Update math still
+    runs in the param dtype."""
 
     def __init__(self, learning_rate=0.01, momentum=0.9, use_nesterov=False,
-                 **kw):
+                 state_dtype=None, **kw):
         super().__init__(learning_rate, **kw)
         self.mu = momentum
         self.nesterov = use_nesterov
+        self.state_dtype = state_dtype
 
     def slots(self, p):
-        return {"velocity": jnp.zeros_like(p)}
+        # zeros_like keeps the param's sharding for the slot (pjit init)
+        dt = self.state_dtype or p.dtype
+        return {"velocity": jnp.zeros_like(p, dtype=dt)}
 
     def _update_leaf(self, g, p, s, lr, step):
-        g = g.astype(p.dtype)
-        v = self.mu * s["velocity"] + g
+        # compute in the WIDER of (param, state) dtype so an f32
+        # state_dtype over bf16 params acts as a true master velocity,
+        # not f32 storage of a bf16-computed value
+        cd = jnp.promote_types(p.dtype, s["velocity"].dtype)
+        g = g.astype(cd)
+        v = self.mu * s["velocity"].astype(cd) + g
         if self.nesterov:
-            p = p - lr * (g + self.mu * v)
+            p = (p.astype(cd) - lr * (g + self.mu * v)).astype(p.dtype)
         else:
-            p = p - lr * v
-        return p, {"velocity": v}
+            p = (p.astype(cd) - lr * v).astype(p.dtype)
+        vd = self.state_dtype or p.dtype
+        return p, {"velocity": v.astype(vd)}
 
 
 class LarsMomentum(Optimizer):

@@ -387,8 +387,13 @@ def bench_resnet(steps, batch):
     params, state = variables["params"], variables["state"]
 
     policy = pt.amp.bf16_policy()
+    # PT_BENCH_BF16_VELOCITY=1: store momentum velocity in bf16 (halves
+    # optimizer-state HBM traffic; see Momentum.state_dtype)
+    vel_dt = (jnp.bfloat16
+              if os.environ.get("PT_BENCH_BF16_VELOCITY", "0") == "1"
+              else None)
     opt = pt.amp.decorate(
-        pt.optimizer.Momentum(0.1, 0.9), policy)
+        pt.optimizer.Momentum(0.1, 0.9, state_dtype=vel_dt), policy)
     opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)

@@ -140,8 +140,9 @@ class Momentum(Optimizer):
     dtype). bf16 velocity halves the optimizer's HBM traffic — for
     HBM-bound models (ResNet-50: ~100 MB of f32 velocity r+w per step)
     that is ~1 ms/step on v5e at the cost of ~3 decimal digits on a
-    quantity that is itself a lossy running average. Update math still
-    runs in the param dtype."""
+    quantity that is itself a lossy running average. Update math runs in
+    the WIDER of (param, state) dtype, so f32 state over bf16 params is
+    a true master velocity."""
 
     def __init__(self, learning_rate=0.01, momentum=0.9, use_nesterov=False,
                  state_dtype=None, **kw):
@@ -166,8 +167,8 @@ class Momentum(Optimizer):
             p = (p.astype(cd) - lr * (g + self.mu * v)).astype(p.dtype)
         else:
             p = (p.astype(cd) - lr * v).astype(p.dtype)
-        vd = self.state_dtype or p.dtype
-        return p, {"velocity": v.astype(vd)}
+        # single source of truth for storage dtype: whatever slots() chose
+        return p, {"velocity": v.astype(s["velocity"].dtype)}
 
 
 class LarsMomentum(Optimizer):

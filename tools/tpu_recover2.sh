@@ -47,7 +47,8 @@ row transformer_big python bench.py --model transformer_big --steps 10
 row gpt             python bench.py --model gpt --steps 10
 row resnet50_s2d    env PT_FLAGS_resnet_s2d_stem=1 python bench.py --model resnet50 --steps 10
 row resnet50_nhwc   env PT_BENCH_NHWC_FEED=1 python bench.py --model resnet50 --steps 10
-row resnet50_fast   env PT_FLAGS_resnet_s2d_stem=1 PT_BENCH_NHWC_FEED=1 python bench.py --model resnet50 --steps 10
+row resnet50_fast   env PT_FLAGS_resnet_s2d_stem=1 PT_BENCH_NHWC_FEED=1 PT_BENCH_BF16_VELOCITY=1 python bench.py --model resnet50 --steps 10
+row resnet50_bf16v  env PT_BENCH_BF16_VELOCITY=1 python bench.py --model resnet50 --steps 10
 row resnet50_novjp  env PT_FLAGS_conv_custom_vjp=0 python bench.py --model resnet50 --steps 10
 row gpt2048         python bench.py --model gpt --steps 10 --seq 2048 --batch 4
 row gpt_decode      python bench.py --model gpt_decode --steps 3 --batch 16

@@ -302,3 +302,35 @@ def test_momentum_state_dtype_bf16_tracks_f32():
     import numpy as np
     np.testing.assert_allclose(np.asarray(pr["w"]), np.asarray(pb["w"]),
                                atol=3e-2, rtol=3e-2)
+
+
+def test_adam_state_dtype_bf16_tracks_f32():
+    """bf16 moment storage must track f32-Adam closely over a short
+    horizon, and the state pytree must be dtype-stable across steps."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.optimizer import Adam
+
+    p0 = {"w": jnp.linspace(-1.0, 1.0, 64, dtype=jnp.float32)}
+    g = {"w": jnp.cos(jnp.arange(64, dtype=jnp.float32))}
+    ref_opt = Adam(1e-2)
+    bf_opt = Adam(1e-2, state_dtype=jnp.bfloat16)
+    pr, sr = dict(p0), ref_opt.init(p0)
+    pb, sb = dict(p0), bf_opt.init(p0)
+    for _ in range(5):
+        pr, sr = ref_opt.apply_gradients(pr, g, sr)
+        pb, sb = bf_opt.apply_gradients(pb, g, sb)
+        assert sb["slots"]["w"]["moment1"].dtype == jnp.bfloat16
+        assert sb["slots"]["w"]["moment2"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(pr["w"]), np.asarray(pb["w"]),
+                               atol=5e-3, rtol=5e-2)
+
+    # dtype stability for non-f32 params (the raw-f32 return of the old
+    # code made the state pytree change dtype after step 1)
+    pB = {"w": jnp.ones(8, jnp.bfloat16)}
+    oB = Adam(1e-2)
+    sB = oB.init(pB)
+    for _ in range(2):
+        pB, sB = oB.apply_gradients(pB, {"w": jnp.ones(8, jnp.bfloat16)}, sB)
+        assert sB["slots"]["w"]["moment1"].dtype == jnp.bfloat16
+        assert sB["slots"]["w"]["moment2"].dtype == jnp.bfloat16

@@ -22,6 +22,21 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _moment_slots(p, state_dtype):
+    """Adam-family moment slots. moment1 stores in state_dtype (or the
+    param dtype); moment2 is pinned to f32 whenever state_dtype is
+    narrower than 32 bits — beta2=0.999's 1e-3 relative decay step is
+    below bf16's half-ulp, so a narrow moment2 freezes at its historical
+    max instead of decaying. zeros_like keeps the param's sharding."""
+    m1_dt = state_dtype or p.dtype
+    if state_dtype is not None and jnp.finfo(state_dtype).bits < 32:
+        m2_dt = jnp.float32
+    else:
+        m2_dt = state_dtype or p.dtype
+    return {"moment1": jnp.zeros_like(p, dtype=m1_dt),
+            "moment2": jnp.zeros_like(p, dtype=m2_dt)}
+
+
 class Optimizer:
     """Base (ref: optimizer.py:54). Subclasses define slots() and
     _update_leaf(g, p, slots, lr, hyper) -> (new_p, new_slots)."""
@@ -115,6 +130,40 @@ class Optimizer:
                 state_in.get("nan_inf_steps", jnp.zeros((), jnp.int32))
                 + jnp.where(finite, 0, 1))
         return params, new_state
+
+    def _apply_gradients_decay_masked(self, params, grads, state, mask):
+        """Per-leaf weight-decay masking for decoupled-decay optimizers
+        (AdamW decay_mask_fn, Lamb exclude_from_weight_decay_fn). mask:
+        bool pytree, True = apply this optimizer's self.wd to the leaf.
+        Toggles self.wd around each leaf update — the decay lives inside
+        the subclass's _update_leaf."""
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        if self.regularization is not None:
+            grads = self.regularization(grads, params)
+        step = state["step"]
+        lr = self.lr(step)
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        flat_m = treedef.flatten_up_to(mask)
+        new_p, new_s = [], []
+        saved_wd = self.wd
+        try:
+            for g, p, s, use_decay in zip(flat_g, flat_p, flat_s, flat_m):
+                if g is None:
+                    new_p.append(p)
+                    new_s.append(s)
+                    continue
+                self.wd = saved_wd if use_decay else 0.0
+                np_, ns_ = self._update_leaf(g, p, s, lr, step)
+                new_p.append(np_)
+                new_s.append(ns_)
+        finally:
+            self.wd = saved_wd
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"step": step + 1,
+                 "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
 
     def minimize(self, loss_fn, params, state, *args, **kwargs):
         """ref: optimizer.py minimize :641 — returns
@@ -284,14 +333,15 @@ class RMSProp(Optimizer):
 class Adam(Optimizer):
     """ref: operators/optimizers/adam_op.h — bias-corrected.
 
-    state_dtype: storage dtype for both moment slots (default: param
-    dtype). bf16 moments halve the optimizer-state HBM traffic (BERT-base
-    Adam: ~880 MB of f32 moments r+w per step on v5e). bf16 shares f32's
-    normal exponent range (moment2 is safe down to ~1e-38), but its
-    subnormals bottom out ~9e-41 vs f32's ~1e-45 — gradients whose
-    squared EMA sits below ~1e-40 flush moment2 to zero, so keep f32
-    state for pathologically tiny-gradient regimes. Update math always
-    runs in f32; the slot dtype is only applied at store time."""
+    state_dtype: storage dtype for the moment1 slot (default: param
+    dtype). bf16 moment1 cuts the optimizer-state traffic by a quarter
+    (BERT-base Adam: ~880 MB of f32 moments r+w per step on v5e).
+    moment2 is PINNED to f32 whenever state_dtype is narrower than 32
+    bits: with beta2=0.999 the per-step relative decay (1e-3) is below
+    bf16's half-ulp (~2e-3), so a bf16 moment2 can never decay — it
+    freezes at its historical max and permanently suppresses the
+    effective lr. moment1's 1-beta1=0.1 step is safely representable.
+    Update math always runs in f32; slot dtypes apply at store time."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, state_dtype=None, **kw):
@@ -300,9 +350,7 @@ class Adam(Optimizer):
         self.state_dtype = state_dtype
 
     def slots(self, p):
-        dt = self.state_dtype or p.dtype
-        return {"moment1": jnp.zeros_like(p, dtype=dt),
-                "moment2": jnp.zeros_like(p, dtype=dt)}
+        return _moment_slots(p, self.state_dtype)
 
     def _update_leaf(self, g, p, s, lr, step):
         g = g.astype(jnp.float32)
@@ -338,33 +386,9 @@ class AdamW(Adam):
 
     def apply_gradients(self, params, grads, state):
         if self.decay_mask_fn is not None:
-            # temporarily zero decay for masked leaves via per-leaf decision
             mask = self.decay_mask_fn(params)
-            if self.grad_clip is not None:
-                grads = self.grad_clip(grads)
-            if self.regularization is not None:
-                grads = self.regularization(grads, params)
-            step = state["step"]
-            lr = self.lr(step)
-            flat_p, treedef = jax.tree_util.tree_flatten(params)
-            flat_g = treedef.flatten_up_to(grads)
-            flat_s = treedef.flatten_up_to(state["slots"])
-            flat_m = treedef.flatten_up_to(mask)
-            new_p, new_s = [], []
-            saved_wd = self.wd
-            for g, p, s, use_decay in zip(flat_g, flat_p, flat_s, flat_m):
-                if g is None:
-                    new_p.append(p)
-                    new_s.append(s)
-                    continue
-                self.wd = saved_wd if use_decay else 0.0
-                np_, ns_ = self._update_leaf(g, p, s, lr, step)
-                new_p.append(np_)
-                new_s.append(ns_)
-            self.wd = saved_wd
-            return (jax.tree_util.tree_unflatten(treedef, new_p),
-                    {"step": step + 1,
-                     "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
+            return self._apply_gradients_decay_masked(
+                params, grads, state, mask)
         return super().apply_gradients(params, grads, state)
 
 
@@ -413,25 +437,39 @@ class Ftrl(Optimizer):
 
 class Lamb(Optimizer):
     """ref: operators/optimizers/lamb_op.h — layer-wise adaptation for large
-    batch (BERT-scale)."""
+    batch (BERT-scale). state_dtype: same reduced-precision moment1
+    storage as Adam (f32 math, slot-dtype store, f32-pinned moment2).
+    exclude_from_weight_decay_fn(params) -> bool pytree, True = exclude
+    that leaf from weight decay (the BERT recipe excludes LayerNorm
+    scales and biases)."""
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6,
-                 exclude_from_weight_decay_fn=None, **kw):
+                 exclude_from_weight_decay_fn=None, state_dtype=None, **kw):
         super().__init__(learning_rate, **kw)
         self.wd = lamb_weight_decay
         self.b1, self.b2, self.eps = beta1, beta2, epsilon
         self.exclude_fn = exclude_from_weight_decay_fn
+        self.state_dtype = state_dtype
 
     def slots(self, p):
-        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+        return _moment_slots(p, self.state_dtype)
+
+    def apply_gradients(self, params, grads, state):
+        if self.exclude_fn is not None:
+            excl = self.exclude_fn(params)
+            mask = jax.tree_util.tree_map(lambda e: not e, excl)
+            return self._apply_gradients_decay_masked(
+                params, grads, state, mask)
+        return super().apply_gradients(params, grads, state)
 
     def _update_leaf(self, g, p, s, lr, step):
         g = g.astype(jnp.float32)
         pf = p.astype(jnp.float32)
         t = (step + 1).astype(jnp.float32)
-        m = self.b1 * s["moment1"] + (1 - self.b1) * g
-        v = self.b2 * s["moment2"] + (1 - self.b2) * jnp.square(g)
+        m = self.b1 * s["moment1"].astype(jnp.float32) + (1 - self.b1) * g
+        v = self.b2 * s["moment2"].astype(jnp.float32) \
+            + (1 - self.b2) * jnp.square(g)
         mhat = m / (1 - self.b1 ** t)
         vhat = v / (1 - self.b2 ** t)
         r = mhat / (jnp.sqrt(vhat) + self.eps) + self.wd * pf
@@ -439,7 +477,8 @@ class Lamb(Optimizer):
         rn = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
         return (pf - lr * trust * r).astype(p.dtype), \
-            {"moment1": m, "moment2": v}
+            {"moment1": m.astype(s["moment1"].dtype),
+             "moment2": v.astype(s["moment2"].dtype)}
 
 
 class Dpsgd(Optimizer):

@@ -321,7 +321,9 @@ def test_adam_state_dtype_bf16_tracks_f32():
         pr, sr = ref_opt.apply_gradients(pr, g, sr)
         pb, sb = bf_opt.apply_gradients(pb, g, sb)
         assert sb["slots"]["w"]["moment1"].dtype == jnp.bfloat16
-        assert sb["slots"]["w"]["moment2"].dtype == jnp.bfloat16
+        # moment2 pinned to f32: beta2=0.999's 1e-3 relative decay is
+        # below bf16's half-ulp, so a bf16 moment2 could never decay
+        assert sb["slots"]["w"]["moment2"].dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(pr["w"]), np.asarray(pb["w"]),
                                atol=5e-3, rtol=5e-2)
 
@@ -334,3 +336,31 @@ def test_adam_state_dtype_bf16_tracks_f32():
         pB, sB = oB.apply_gradients(pB, {"w": jnp.ones(8, jnp.bfloat16)}, sB)
         assert sB["slots"]["w"]["moment1"].dtype == jnp.bfloat16
         assert sB["slots"]["w"]["moment2"].dtype == jnp.bfloat16
+
+
+def test_bf16_moment2_would_freeze():
+    """Documents WHY moment2 is f32-pinned: a bf16 EMA with decay 0.999
+    cannot decrease (0.999*V rounds back to V at bf16 precision)."""
+    import jax.numpy as jnp
+    v = jnp.asarray(1.0, jnp.bfloat16)
+    decayed = (0.999 * v.astype(jnp.float32)).astype(jnp.bfloat16)
+    assert float(decayed) == float(v)  # the freeze the pin prevents
+
+
+def test_lamb_exclude_from_weight_decay():
+    """exclude_from_weight_decay_fn must actually zero decay on excluded
+    leaves (it was a silent no-op before r4)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.optimizer import Lamb
+
+    params = {"w": jnp.ones(4), "ln_scale": jnp.ones(4)}
+    grads = {"w": jnp.zeros(4), "ln_scale": jnp.zeros(4)}
+    o = Lamb(0.1, lamb_weight_decay=0.5,
+             exclude_from_weight_decay_fn=lambda p: {
+                 "w": False, "ln_scale": True})
+    st = o.init(params)
+    p, st = o.apply_gradients(params, grads, st)
+    # zero grads: decayed leaf moves (trust-scaled), excluded leaf doesn't
+    assert not np.allclose(np.asarray(p["w"]), 1.0)
+    np.testing.assert_allclose(np.asarray(p["ln_scale"]), 1.0)

@@ -341,7 +341,14 @@ class Adam(Optimizer):
     bf16's half-ulp (~2e-3), so a bf16 moment2 can never decay — it
     freezes at its historical max and permanently suppresses the
     effective lr. moment1's 1-beta1=0.1 step is safely representable.
-    Update math always runs in f32; slot dtypes apply at store time."""
+    Update math always runs in f32; slot dtypes apply at store time.
+
+    lazy_mode is accepted for reference API compatibility but is a
+    documented no-op: it exists in the reference to restrict updates to
+    rows touched by sparse (SelectedRows) gradients, and the TPU-first
+    sparse-row path here is `parallel.sparse.SparseTable` pull/push with
+    its own per-row optimizer, so dense Adam never sees row-sparse
+    gradients."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, state_dtype=None, **kw):

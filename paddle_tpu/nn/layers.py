@@ -318,8 +318,8 @@ class SpectralNorm(Module):
             if i != dim:
                 w *= d
         self.h, self.w = h, w
-        self.state("u", (h,), I.normal(0, 1), jnp.float32)
-        self.state("v", (w,), I.normal(0, 1), jnp.float32)
+        self.state("u", (h,), I.normal(0, 1), dtype)
+        self.state("v", (w,), I.normal(0, 1), dtype)
 
     def forward(self, weight):
         from paddle_tpu.ops.tail import spectral_norm as _sn_op
@@ -327,8 +327,11 @@ class SpectralNorm(Module):
                               dim=self.dim, power_iters=self.power_iters,
                               eps=self.eps)
         if self.training:
-            self.update_state("u", u)
-            self.update_state("v", v)
+            # cast back to the declared state dtype: the op promotes u/v to
+            # the weight dtype, and a drifting state pytree dtype breaks
+            # scan carries / donated buffers (same invariant as Adam slots)
+            self.update_state("u", u.astype(self.s("u").dtype))
+            self.update_state("v", v.astype(self.s("v").dtype))
         return normed
 
 

@@ -20,6 +20,10 @@ from paddle_tpu.ops import nn as F
 def _space_to_depth_nhwc(x, b=2):
     """[N,H,W,C] -> [N,H/b,W/b,b*b*C]; channel order (di, dj, c)."""
     n, h, w, c = x.shape
+    if h % b or w % b:
+        raise ValueError(
+            f"PT_FLAGS_resnet_s2d_stem requires H and W divisible by {b}; "
+            f"got {h}x{w}. Use the default 7x7 stem for odd input sizes.")
     x = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
     return x.reshape(n, h // b, w // b, b * b * c)
 

@@ -73,7 +73,7 @@ class Optimizer:
             state["nan_inf_steps"] = jnp.zeros((), jnp.int32)
         return state
 
-    def apply_gradients(self, params, grads, state):
+    def apply_gradients(self, params, grads, state, _decay_mask=None):
         """ref: optimizer.py apply_gradients :557 (clip → regularize →
         per-param update ops).
 
@@ -83,6 +83,12 @@ class Optimizer:
         since device code cannot raise on TPU (no host callbacks on the PJRT
         tunnel). The flag is bound in __init__ so the state structure can't
         change mid-run.
+
+        _decay_mask: optional bool pytree (True = apply this optimizer's
+        self.wd to the leaf) used by the decoupled-decay optimizers; kept
+        inside this method so the masked path shares the nan/inf guard and
+        state structure with the plain one. Mask leaves must be concrete
+        (Python/np bools) — the mask picks code, not values.
         """
         check = self._check_nan_inf
         grads_in = grads
@@ -107,15 +113,26 @@ class Optimizer:
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
+        if _decay_mask is None:
+            flat_m = [True] * len(flat_p)
+        else:
+            flat_m = [bool(m) for m in treedef.flatten_up_to(_decay_mask)]
         new_p, new_s = [], []
-        for g, p, s in zip(flat_g, flat_p, flat_s):
-            if g is None:
-                new_p.append(p)
-                new_s.append(s)
-                continue
-            np_, ns_ = self._update_leaf(g, p, s, lr, step)
-            new_p.append(np_)
-            new_s.append(ns_)
+        saved_wd = getattr(self, "wd", None)
+        try:
+            for g, p, s, use_decay in zip(flat_g, flat_p, flat_s, flat_m):
+                if g is None:
+                    new_p.append(p)
+                    new_s.append(s)
+                    continue
+                if _decay_mask is not None:
+                    self.wd = saved_wd if use_decay else 0.0
+                np_, ns_ = self._update_leaf(g, p, s, lr, step)
+                new_p.append(np_)
+                new_s.append(ns_)
+        finally:
+            if _decay_mask is not None:
+                self.wd = saved_wd
         params = jax.tree_util.tree_unflatten(treedef, new_p)
         slots = jax.tree_util.tree_unflatten(treedef, new_s)
         new_state = {"step": step + 1, "slots": slots}
@@ -135,35 +152,10 @@ class Optimizer:
         """Per-leaf weight-decay masking for decoupled-decay optimizers
         (AdamW decay_mask_fn, Lamb exclude_from_weight_decay_fn). mask:
         bool pytree, True = apply this optimizer's self.wd to the leaf.
-        Toggles self.wd around each leaf update — the decay lives inside
-        the subclass's _update_leaf."""
-        if self.grad_clip is not None:
-            grads = self.grad_clip(grads)
-        if self.regularization is not None:
-            grads = self.regularization(grads, params)
-        step = state["step"]
-        lr = self.lr(step)
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
-        flat_g = treedef.flatten_up_to(grads)
-        flat_s = treedef.flatten_up_to(state["slots"])
-        flat_m = treedef.flatten_up_to(mask)
-        new_p, new_s = [], []
-        saved_wd = self.wd
-        try:
-            for g, p, s, use_decay in zip(flat_g, flat_p, flat_s, flat_m):
-                if g is None:
-                    new_p.append(p)
-                    new_s.append(s)
-                    continue
-                self.wd = saved_wd if use_decay else 0.0
-                np_, ns_ = self._update_leaf(g, p, s, lr, step)
-                new_p.append(np_)
-                new_s.append(ns_)
-        finally:
-            self.wd = saved_wd
-        return (jax.tree_util.tree_unflatten(treedef, new_p),
-                {"step": step + 1,
-                 "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
+        Delegates to the base apply_gradients so the masked path keeps the
+        check_nan_inf skip/count guard and the exact state structure."""
+        return Optimizer.apply_gradients(self, params, grads, state,
+                                         _decay_mask=mask)
 
     def minimize(self, loss_fn, params, state, *args, **kwargs):
         """ref: optimizer.py minimize :641 — returns
@@ -465,7 +457,7 @@ class Lamb(Optimizer):
     def apply_gradients(self, params, grads, state):
         if self.exclude_fn is not None:
             excl = self.exclude_fn(params)
-            mask = jax.tree_util.tree_map(lambda e: not e, excl)
+            mask = jax.tree_util.tree_map(lambda e: not bool(e), excl)
             return self._apply_gradients_decay_masked(
                 params, grads, state, mask)
         return super().apply_gradients(params, grads, state)

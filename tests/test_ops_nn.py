@@ -66,6 +66,9 @@ class TestConv2D:
             flags.set_flags({"conv_custom_vjp": True})
             check_grad(
                 lambda a, b: F.conv2d(a, b, padding=((2, 1), (1, 0))),
+                [r((1, 2, 6, 6)), r((2, 2, 3, 3), 1)], arg_idx=0)
+            check_grad(
+                lambda a, b: F.conv2d(a, b, padding=((2, 1), (1, 0))),
                 [r((1, 2, 6, 6)), r((2, 2, 3, 3), 1)], arg_idx=1)
         finally:
             flags.set_flags({"conv_custom_vjp": old})

@@ -282,6 +282,39 @@ def test_check_nan_inf_bound_at_construction():
     assert "nan_inf_steps" not in st3
 
 
+def test_decay_masked_path_keeps_nan_inf_guard():
+    """ADVICE r4 (medium): AdamW(decay_mask_fn)/Lamb(exclude_fn) under
+    check_nan_inf must keep the nan_inf_steps key (stable jit/scan carry
+    structure) AND skip non-finite updates like the unmasked path."""
+    from paddle_tpu.core.flags import set_flags
+
+    set_flags({"check_nan_inf": True})
+    try:
+        o = opt.AdamW(0.1, weight_decay=0.1,
+                      decay_mask_fn=lambda p: {"w": True, "b": False})
+        lb = opt.Lamb(0.1, exclude_from_weight_decay_fn=lambda p: {
+            "w": False, "b": True})
+    finally:
+        set_flags({"check_nan_inf": False})
+    params = {"w": jnp.ones(3), "b": jnp.ones(3)}
+    for o_ in (o, lb):
+        st = o_.init(params)
+        assert "nan_inf_steps" in st
+        step = jax.jit(lambda p, g, s: o_.apply_gradients(p, g, s))
+        bad = {"w": jnp.array([1.0, jnp.nan, 1.0]), "b": jnp.ones(3)}
+        p2, st2 = step(params, bad, st)
+        # same pytree structure after the first update (jit carry safety)
+        assert (jax.tree_util.tree_structure(st2)
+                == jax.tree_util.tree_structure(st))
+        # bad step skipped + counted, not applied
+        np.testing.assert_allclose(np.asarray(p2["w"]), np.ones(3))
+        assert int(st2["nan_inf_steps"]) == 1
+        assert int(st2["step"]) == 0
+        p3, st3 = step(p2, {"w": jnp.ones(3), "b": jnp.ones(3)}, st2)
+        assert not np.allclose(np.asarray(p3["w"]), np.ones(3))
+        assert int(st3["step"]) == 1
+
+
 def test_momentum_state_dtype_bf16_tracks_f32():
     """bf16 velocity storage must track the f32-velocity trajectory
     closely over a short horizon (HBM-traffic lever for conv nets)."""

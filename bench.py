@@ -50,6 +50,22 @@ def _cost_flops(jitted, *args):
         return 0.0
 
 
+COMPILE_ONLY = False
+
+
+def _co(name, jitted, *args):
+    """--compile-only: compile the step (populating the persistent XLA
+    cache so later bench runs start executing immediately) and stop.
+    Both round-4 tunnel wedges followed a client kill mid-XLA-compile —
+    prewarming moves every compile into one pass so timed bench attempts
+    never straddle a compile."""
+    t0 = time.perf_counter()
+    jitted.lower(*args).compile()
+    return {"metric": f"{name}_compile_only", "value": 1.0,
+            "unit": "compiled", "vs_baseline": 0.0,
+            "compile_s": round(time.perf_counter() - t0, 1)}
+
+
 def _timed_steps(step_once, steps):
     """Per-step wall time with the remote-dispatch latency cancelled.
 
@@ -155,6 +171,9 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    if COMPILE_ONLY:
+        return _co(name, jitted, params, opt_state, ids, mlm_labels,
+                   nsp_labels, mask)
     flops_per_step = _cost_flops(jitted, params, opt_state, ids, mlm_labels,
                                  nsp_labels, mask)
     # warmup/compile
@@ -223,6 +242,9 @@ def bench_transformer(steps, batch, seq):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    if COMPILE_ONLY:
+        return _co("transformer_big", jitted, params, opt_state, src, tgt_in,
+                   tgt_out)
     flops_per_step = _cost_flops(jitted, params, opt_state, src, tgt_in,
                                  tgt_out)
     loss, params, opt_state = jitted(params, opt_state, src, tgt_in, tgt_out)
@@ -275,6 +297,8 @@ def bench_gpt_decode(steps, batch, seq):
                            method="generate")
 
     jitted = jax.jit(decode)
+    if COMPILE_ONLY:
+        return _co("gpt_decode", jitted, variables["params"], prompt)
     out = jitted(variables["params"], prompt)
     assert out.shape == (batch, prompt_len + max_new)
     _ = np.asarray(out[0, -1])  # true barrier (host fetch)
@@ -346,6 +370,8 @@ def bench_gpt(steps, batch, seq):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
+    if COMPILE_ONLY:
+        return _co("gpt", jitted, params, opt_state, ids)
     flops_per_step = _cost_flops(jitted, params, opt_state, ids)
     loss, params, opt_state = jitted(params, opt_state, ids)
     _ = float(loss)
@@ -416,6 +442,9 @@ def bench_resnet(steps, batch):
         return loss, params, opt_state, new_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    if COMPILE_ONLY:
+        return _co("resnet50", jitted, params, opt_state, state, images,
+                   labels)
     # analytic: ResNet-50 fwd = 4.089 GMACs/image @224 (the paper's
     # "~3.8-4.1 GFLOPs" figure counts a multiply-add as ONE op) = 8.178
     # GFLOPs at the FMA=2 convention the bf16 peak uses; train = 3x fwd.
@@ -484,6 +513,9 @@ def bench_ctr(steps, batch):
     raw_step = make_sparse_deepfm_train_step(model, opt, embed_tbl,
                                              linear_tbl)
     jitted = jax.jit(raw_step, donate_argnums=(0, 1, 2, 3))
+    if COMPILE_ONLY:
+        return _co("ctr", jitted, params, opt_state, emb_st, lin_st,
+                   dense, sparse_ids, labels)
     flops_per_step = _cost_flops(jitted, params, opt_state, emb_st, lin_st,
                                  dense, sparse_ids, labels)
     loss, params, opt_state, emb_st, lin_st = jitted(
@@ -533,6 +565,8 @@ def _enable_compile_cache():
 
 
 def _run_inner(args):
+    global COMPILE_ONLY
+    COMPILE_ONLY = bool(getattr(args, "compile_only", False))
     _enable_compile_cache()
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
@@ -562,6 +596,39 @@ def _run_inner(args):
     else:  # bandwidth-bound rows (decode) have no meaningful MFU framing
         res.setdefault("vs_baseline", 0.0)
     return res
+
+
+def _captured_fallback(model):
+    """Last captured silicon row for `model` (tools/captured/, written by
+    tools/tpu_recover2.sh), or None. Emitted — clearly marked `cached` with
+    its capture timestamp — when the tunnel is unreachable at bench time:
+    an honest last-known-good beats an empty bench_failed artifact, and the
+    driver's BENCH file then records where the number came from."""
+    import glob
+    cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "tools", "captured")
+    name = "bert" if model == "all" else model  # suite -> flagship row
+    exact = os.path.join(cap, f"{name}.json")
+    # only the exact row or its window-tagged seeds (<name>_w*.json) — a
+    # prefix glob would serve e.g. resnet50_s2d's flagged config (or
+    # gpt_decode's serving metric) as the plain row's number
+    cands = ([exact] if os.path.exists(exact) else
+             sorted(glob.glob(os.path.join(cap, f"{name}_w*.json")),
+                    key=os.path.getmtime, reverse=True))
+    for path in cands:
+        try:
+            with open(path) as f:
+                row = json.loads(f.read().strip())
+            mtime = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                  time.gmtime(os.path.getmtime(path)))
+            row["cached"] = True
+            row["note"] = (f"tunnel unreachable at bench time; value is "
+                           f"the captured silicon row from {mtime} "
+                           f"({path})")
+            return row
+        except Exception:
+            continue
+    return None
 
 
 def _probe(timeout_s):
@@ -616,6 +683,8 @@ def _run_suite(args, deadline):
         extra += ["--batch", str(args.batch)]
     if not args.flash:
         extra += ["--no-flash"]
+    if args.compile_only:
+        extra += ["--compile-only"]
     rows = {}
     for model in _suite_list():
         remaining = deadline - time.monotonic()
@@ -671,6 +740,10 @@ def main():
     ap.add_argument("--flash", action="store_true", default=True,
                     help="use the Pallas flash-attention path (default)")
     ap.add_argument("--no-flash", dest="flash", action="store_false")
+    ap.add_argument("--compile-only", action="store_true",
+                    help="compile every step into the persistent XLA cache "
+                         "and exit without timing (prewarm pass — timed "
+                         "runs then never straddle a compile)")
     ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
@@ -689,10 +762,15 @@ def main():
     probe_ok, probe_detail = _probe(
         float(os.environ.get("PT_BENCH_PROBE_TIMEOUT", "75")))
     if not probe_ok:
-        print(json.dumps({
-            "metric": "bench_failed", "value": 0.0, "unit": "error",
-            "vs_baseline": 0.0,
-            "error": f"TPU aliveness probe failed: {probe_detail}"}))
+        cached = _captured_fallback(args.model)
+        if cached is not None:
+            cached["probe_error"] = probe_detail
+            print(json.dumps(cached))
+        else:
+            print(json.dumps({
+                "metric": "bench_failed", "value": 0.0, "unit": "error",
+                "vs_baseline": 0.0,
+                "error": f"TPU aliveness probe failed: {probe_detail}"}))
         return
     if args.model == "all":
         _run_suite(args, deadline)
@@ -725,6 +803,20 @@ def main():
         last_tail = proc.stdout.strip()[-500:] or f"rc={proc.returncode}"
         if attempt + 1 < attempts:
             time.sleep(3.0)
+    # fall back to a captured row ONLY for tunnel-shaped failures (attempt
+    # timeouts = wedge mid-run). A crash with the tunnel alive is a real
+    # code regression and must surface as bench_failed, not be papered
+    # over with a stale number (and PT_BENCH_FORCE_FAIL self-tests rely
+    # on this path).
+    if "attempt timeout" in last_tail:
+        cached = _captured_fallback(args.model)
+        if cached is not None:
+            cached["probe"] = probe_detail
+            cached["attempt_error"] = last_tail[-300:]
+            cached["note"] = (cached.get("note", "") +
+                              " (bench attempts timed out mid-run)")
+            print(json.dumps(cached))
+            return
     print(json.dumps({
         "metric": "bench_failed", "value": 0.0, "unit": "error",
         "vs_baseline": 0.0, "probe": probe_detail,

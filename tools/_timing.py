@@ -37,9 +37,14 @@ def _make_loop(f, n):
             leaves = tuple(jax.tree_util.tree_leaves(y))
             if leaves:
                 # acc consumes every leaf: the final fetch of acc forces
-                # every iteration's f to really execute on the device
+                # every iteration's f to really execute on the device.
+                # sum(|l|), not sum(l): a LINEAR reduction of a dot can be
+                # algebraically folded (sum(A@B) == rowsum(A)·colsum(B),
+                # O(n^2) — no matmul left to time); the abs makes the
+                # reduction nonlinear so the full product must materialize,
+                # and it still fuses into the producer's epilogue.
                 acc = acc + sum(
-                    jnp.sum(l).astype(jnp.float32) for l in leaves)
+                    jnp.sum(jnp.abs(l)).astype(jnp.float32) for l in leaves)
                 out = jax.lax.optimization_barrier(tuple(xs) + leaves)
                 xs = out[:len(xs)]
             return (xs, acc), None

@@ -18,21 +18,32 @@ CAP=tools/captured
 mkdir -p "$CAP"
 say() { echo "== $*" | tee -a "$LOG"; }
 
-# row <name> <cmd...>: skip if captured; on a metric row, record it.
+# row <name> <cmd...>: skip if captured; on a metric row, record + commit it.
+# Each row first runs a --compile-only prewarm (populates the persistent
+# XLA cache) so the timed attempt never straddles a compile — both observed
+# tunnel wedges followed a client kill mid-XLA-compile.
 row() {
   name=$1; shift
   if [ -f "$CAP/$name.json" ]; then
     say "skip $name (captured)"
     return 0
   fi
+  say "prewarm $name"
+  PT_BENCH_ATTEMPTS=1 PT_BENCH_TIMEOUT=560 PT_BENCH_WALL=570 \
+    timeout 590 "$@" --compile-only >> "$LOG" 2>&1
   say "row $name: $*"
   out=$(PT_BENCH_ATTEMPTS=1 PT_BENCH_TIMEOUT=520 PT_BENCH_WALL=540 \
         timeout 560 "$@" 2>&1)
   echo "$out" >> "$LOG"
-  line=$(echo "$out" | grep '"metric"' | grep -v bench_failed | tail -1)
+  line=$(echo "$out" | grep '"metric"' | grep -v bench_failed \
+         | grep -v '"cached": true' | tail -1)
   if [ -n "$line" ]; then
     echo "$line" > "$CAP/$name.json"
     say "captured $name: $line"
+    git add "$CAP/$name.json" >> "$LOG" 2>&1 \
+      && git commit -q -m "bench: capture $name silicon row" \
+             -- "$CAP/$name.json" >> "$LOG" 2>&1 \
+      && say "committed $name"
   else
     say "MISS $name"
   fi
@@ -45,6 +56,7 @@ row ernie           python bench.py --model ernie --steps 10
 row ctr             python bench.py --model ctr --steps 10
 row transformer_big python bench.py --model transformer_big --steps 10
 row gpt             python bench.py --model gpt --steps 10
+row resnet50        python bench.py --model resnet50 --steps 10
 row resnet50_s2d    env PT_FLAGS_resnet_s2d_stem=1 python bench.py --model resnet50 --steps 10
 row resnet50_nhwc   env PT_BENCH_NHWC_FEED=1 python bench.py --model resnet50 --steps 10
 row resnet50_fast   env PT_FLAGS_resnet_s2d_stem=1 PT_BENCH_NHWC_FEED=1 PT_BENCH_BF16_VELOCITY=1 python bench.py --model resnet50 --steps 10
@@ -69,8 +81,13 @@ tool() {
   out=$(timeout "$tmo" "$@" 2>&1)
   echo "$out" >> "$LOG"
   if echo "$out" | grep -q "$pattern"; then
+    echo "$out" | tail -120 > "$CAP/$marker.txt"
     touch "$CAP/$marker.ok"
     say "captured $marker"
+    git add "$CAP/$marker.txt" "$CAP/$marker.ok" >> "$LOG" 2>&1 \
+      && git commit -q -m "bench: capture $marker silicon tool output" \
+             -- "$CAP/$marker.txt" "$CAP/$marker.ok" >> "$LOG" 2>&1 \
+      && say "committed $marker"
   else
     say "MISS $marker"
   fi

@@ -608,11 +608,13 @@ def _captured_fallback(model):
     cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "tools", "captured")
     name = "bert" if model == "all" else model  # suite -> flagship row
-    exact = os.path.join(cap, f"{name}.json")
-    # only the exact row or its window-tagged seeds (<name>_w*.json) — a
-    # prefix glob would serve e.g. resnet50_s2d's flagged config (or
-    # gpt_decode's serving metric) as the plain row's number
-    cands = ([exact] if os.path.exists(exact) else
+    # only the exact row, then its window-tagged seeds (<name>_w*.json) —
+    # a prefix glob would serve e.g. resnet50_s2d's flagged config (or
+    # gpt_decode's serving metric) as the plain row's number. Seeds stay
+    # in the list even when the exact file exists so a truncated capture
+    # does not block the fallback entirely.
+    cands = ([p for p in [os.path.join(cap, f"{name}.json")]
+              if os.path.exists(p)] +
              sorted(glob.glob(os.path.join(cap, f"{name}_w*.json")),
                     key=os.path.getmtime, reverse=True))
     for path in cands:

@@ -282,7 +282,17 @@ struct Predictor::Impl {
 };
 
 Predictor::Predictor() : impl_(new Impl) {}
+Predictor::Predictor(std::shared_ptr<Impl> shared)
+    : impl_(std::move(shared)) {}
 Predictor::~Predictor() = default;
+
+std::unique_ptr<Predictor> Predictor::Clone() const {
+  // Shares the Impl (plugin handle, PJRT client, compiled executable,
+  // device-resident weights) — the serving-fleet contract from
+  // paddle_api.h:271. Run() never mutates the Impl, so concurrent Run()
+  // on distinct clones is safe; TrainStep refuses while clones exist.
+  return std::unique_ptr<Predictor>(new Predictor(impl_));
+}
 
 std::unique_ptr<Predictor> Predictor::Create(const PredictorConfig& cfg,
                                              std::string* error) {
@@ -416,6 +426,15 @@ bool Predictor::TrainStep(float* loss, std::string* error) {
   Impl* im = impl_.get();
   if (!im->exe) {
     if (error) *error = "predictor created without a plugin (no device)";
+    return false;
+  }
+  if (impl_.use_count() > 1) {
+    // clones share the device-resident weights read-only; replacing them
+    // mid-serve would race every other clone's Run
+    if (error)
+      *error = "TrainStep requires exclusive ownership (" +
+               std::to_string(impl_.use_count() - 1) +
+               " clone(s) outstanding)";
     return false;
   }
   if (im->fixed_inputs.empty()) {

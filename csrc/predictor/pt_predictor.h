@@ -20,9 +20,15 @@
 //   std::vector<pt::Tensor> outs;
 //   pred->Run(inputs, &outs, &err);        // weights stay device-resident
 //
-// Thread-safety: a Predictor is NOT thread-safe; create one per thread or
-// serialize calls (same contract as the reference's predictor — it offers
-// Clone() for the per-thread case).
+// Thread-safety: a Predictor is NOT thread-safe; create one per thread via
+// Clone() (same contract as the reference's predictor, paddle_api.h:271).
+// Clones share the dlopened plugin, the PJRT client, the compiled
+// executable and the device-resident weights — a serving fleet pays one
+// compile and one weight staging for N request threads. Different clones
+// may Run() concurrently (PJRT Execute is thread-safe; the repo's
+// pycpu_pjrt test plugin serializes internally on the GIL). TrainStep
+// mutates the shared weights and therefore fails while clones are
+// outstanding.
 //
 // All entry points report failures via the std::string* error out-param and
 // a false/nullptr return — the library never exits or throws.
@@ -70,6 +76,13 @@ class Predictor {
                                            std::string* error);
   ~Predictor();
 
+  // Per-thread serving handle sharing this predictor's compiled executable
+  // and device-resident weights (ref paddle_api.h:271 PaddlePredictor::
+  // Clone). O(1): no recompile, no weight re-staging, no host copies.
+  // The clone keeps the shared runtime alive independently of the parent's
+  // lifetime. Run() on distinct clones is safe concurrently.
+  std::unique_ptr<Predictor> Clone() const;
+
   // Serving call: executes the program on [staged params..., inputs...],
   // fetches every program output to the host. Input count/shapes/dtypes
   // must match the exported signature.
@@ -93,9 +106,12 @@ class Predictor {
   bool has_device() const;
 
  private:
-  Predictor();
   struct Impl;
-  std::unique_ptr<Impl> impl_;
+  Predictor();
+  explicit Predictor(std::shared_ptr<Impl> shared);
+  // shared across clones (weights + executable + runtime); the last
+  // surviving handle tears it down
+  std::shared_ptr<Impl> impl_;
 };
 
 }  // namespace pt

@@ -115,6 +115,17 @@ int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
   return 0;
 }
 
+PT_Predictor* PT_PredictorClone(PT_Predictor* pred, char* err_buf,
+                                size_t err_len) {
+  if (!pred) {
+    SetErr(err_buf, err_len, "null predictor");
+    return nullptr;
+  }
+  auto* h = reinterpret_cast<PredictorHandle*>(pred);
+  return reinterpret_cast<PT_Predictor*>(
+      new PredictorHandle{h->impl->Clone()});
+}
+
 int PT_PredictorTrainStep(PT_Predictor* pred, float* loss, char* err_buf,
                           size_t err_len) {
   if (!pred) {

@@ -50,9 +50,18 @@ int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
                     size_t* n_outputs, char* err_buf, size_t err_len);
 
 /* One training step on a save_train_program artifact; *loss receives the
- * step loss. Returns 0 on success. */
+ * step loss. Returns 0 on success. Fails while clones are outstanding
+ * (they read the weights this call would replace). */
 int PT_PredictorTrainStep(PT_Predictor* pred, float* loss, char* err_buf,
                           size_t err_len);
+
+/* Per-thread serving handle sharing pred's compiled executable and
+ * device-resident weights (ref capi + paddle_api.h:271 Clone): one
+ * compile + one weight staging serve N threads. Distinct clones may
+ * PT_PredictorRun concurrently; free each with PT_PredictorFree (any
+ * order — the last handle tears the runtime down). */
+PT_Predictor* PT_PredictorClone(PT_Predictor* pred, char* err_buf,
+                                size_t err_len);
 
 size_t PT_PredictorNumParams(const PT_Predictor* pred);
 size_t PT_PredictorNumOutputs(const PT_Predictor* pred);

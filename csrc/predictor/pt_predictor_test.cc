@@ -9,9 +9,11 @@
 //
 // Usage: pt_predictor_test <model_dir> <plugin.so> [out.ptpb]
 
+#include <atomic>
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "pt_predictor.h"
@@ -68,7 +70,64 @@ int main(int argc, char** argv) {
   if (!out_path.empty() && !pt::SavePTPB(out_path, out1, &err))
     return Fail("SavePTPB: " + err);
 
-  printf("{\"ok\": true, \"outputs\": %zu, \"params\": %zu}\n",
-         out1.size(), pred->num_params());
+  // Clone() fleet (ref paddle_api.h:271): N per-thread handles over ONE
+  // compiled executable + ONE device-resident weight set; every thread's
+  // outputs must match the parent's run byte-for-byte.
+  constexpr int kThreads = 4;
+  constexpr int kRunsPerThread = 3;
+  std::vector<std::unique_ptr<pt::Predictor>> clones;
+  for (int i = 0; i < kThreads; ++i) {
+    auto c = pred->Clone();
+    if (!c) return Fail("Clone returned null");
+    if (!c->has_device()) return Fail("clone lost the device");
+    clones.push_back(std::move(c));
+  }
+  std::atomic<int> failures{0};
+  std::vector<std::thread> threads;
+  threads.reserve(kThreads);
+  for (int i = 0; i < kThreads; ++i) {
+    pt::Predictor* c = clones[i].get();
+    threads.emplace_back([&, c] {
+      for (int r = 0; r < kRunsPerThread; ++r) {
+        std::vector<pt::Tensor> out;
+        std::string terr;
+        if (!c->Run(inputs, &out, &terr) || out.size() != out1.size()) {
+          failures.fetch_add(1);
+          return;
+        }
+        for (size_t j = 0; j < out.size(); ++j) {
+          if (out[j].data != out1[j].data) {
+            failures.fetch_add(1);
+            return;
+          }
+        }
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  if (failures.load() != 0)
+    return Fail("concurrent clone serving: " +
+                std::to_string(failures.load()) + " thread(s) diverged");
+  // TrainStep must refuse while clones share the weights it would replace
+  float dummy_loss = 0.f;
+  if (pred->TrainStep(&dummy_loss, &err))
+    return Fail("TrainStep succeeded with clones outstanding");
+  if (err.find("clone") == std::string::npos)
+    return Fail("TrainStep-with-clones error should mention clones: " + err);
+  // parent destroyed first: clones must keep the shared runtime alive
+  pred.reset();
+  {
+    std::vector<pt::Tensor> out;
+    if (!clones[0]->Run(inputs, &out, &err))
+      return Fail("clone Run after parent destroyed: " + err);
+    if (out.size() != out1.size() || out[0].data != out1[0].data)
+      return Fail("clone output diverged after parent destroyed");
+  }
+  size_t n_params = clones[0]->num_params();
+  clones.clear();
+
+  printf("{\"ok\": true, \"outputs\": %zu, \"params\": %zu, "
+         "\"clone_threads\": %d}\n",
+         out1.size(), n_params, kThreads);
   return 0;
 }

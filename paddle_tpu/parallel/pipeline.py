@@ -98,8 +98,95 @@ def make_pipeline_fn(mesh, stage_fn, axis_name=PP):
     )
 
 
+def _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn, axis_name):
+    """1F1B forward+backward schedule as a single tick scan (per device,
+    inside shard_map).
+
+    Ref: /root/reference/paddle/fluid/framework/section_worker.cc:141 — the
+    reference's section threads run forward AND backward AND optimizer
+    concurrently per section, which bounds in-flight activations by the
+    section count instead of the microbatch count. This is the same
+    property expressed as data flow: every tick runs ONE forward microstep
+    (the GPipe wave) and ONE backward microstep (the reverse wave, lagging
+    2(S-1) ticks), so stage s's live activations are bounded by a circular
+    buffer of 2S-1 stage inputs — O(S), independent of M — while the
+    autodiff-transposed GPipe scan keeps all M microbatch residuals alive.
+    Backward recomputes the stage from its saved input (implicit remat, the
+    1F1B memory contract).
+
+    Timeline (S stages, M microbatches, ticks t = 0 .. M + 2S - 3):
+      forward  of microbatch t - s      at stage s   (valid while < M)
+      backward of microbatch t - 2(S-1) + s at stage s
+    The last stage's backward of microbatch b starts the same tick as its
+    forward (one-F-one-B steady state); cotangents hop stage s -> s-1 via
+    reverse ppermute.
+
+    loss_fn is applied per microbatch (outputs[None], y[None]) and the
+    per-microbatch losses/gradients averaged — identical to the GPipe path
+    whenever loss_fn averages over the leading microbatch axis.
+    """
+    def inner(params, x, y):
+        n = lax.axis_size(axis_name)
+        me = lax.axis_index(axis_name)
+        m = x.shape[0]
+        k = 2 * n - 1  # circular buffer: max residual age is 2(S-1) ticks
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        h_sds = jax.eval_shape(lambda p, a: stage_fn(p, a), my_params,
+                               jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+
+        def mb_loss(h_out, y_mb):
+            return loss_fn(h_out[None], y_mb[None])
+
+        def tick(carry, t):
+            h_fly, g_fly, acts, gacc, lacc = carry
+            # ---- forward microstep (the GPipe wave) ----
+            feed = lax.dynamic_index_in_dim(
+                x, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            h_in = jnp.where(me == 0, feed, h_fly)
+            acts = lax.dynamic_update_index_in_dim(
+                acts, h_in, jnp.mod(t, k), 0)
+            h_out = stage_fn(my_params, h_in)
+            # ---- loss head: valid only on the last stage, where the
+            # backward of microbatch bl = t-(S-1) starts this same tick ----
+            bl = t - (n - 1)
+            y_b = lax.dynamic_index_in_dim(
+                y, jnp.clip(bl, 0, m - 1), 0, keepdims=False)
+            loss_v, dh_out = jax.value_and_grad(mb_loss)(h_out, y_b)
+            # ---- backward microstep: stage s handles microbatch b ----
+            b = t - 2 * (n - 1) + me
+            g_in = jnp.where(me == n - 1, dh_out, g_fly)
+            h_saved = lax.dynamic_index_in_dim(
+                acts, jnp.mod(b + me, k), 0, keepdims=False)
+            _, vjp_fn = jax.vjp(stage_fn, my_params, h_saved)
+            dp, dh_prev = vjp_fn(g_in)
+            valid_b = (b >= 0) & (b < m)
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(valid_b, d, 0), gacc, dp)
+            lacc = lacc + jnp.where(
+                (me == n - 1) & (bl >= 0) & (bl < m),
+                loss_v.astype(jnp.float32), 0.0)
+            h_fly = lax.ppermute(h_out, axis_name, perm_f)
+            g_fly = lax.ppermute(dh_prev, axis_name, perm_b)
+            return (h_fly, g_fly, acts, gacc, lacc), None
+
+        zeros_h = jnp.zeros(h_sds.shape, h_sds.dtype)
+        carry0 = (zeros_h, zeros_h,
+                  jnp.zeros((k,) + h_sds.shape, h_sds.dtype),
+                  jax.tree_util.tree_map(jnp.zeros_like, my_params),
+                  jnp.float32(0.0))
+        carry, _ = lax.scan(tick, carry0, jnp.arange(m + 2 * (n - 1)))
+        gacc, lacc = carry[3], carry[4]
+        loss = lax.psum(lacc, axis_name) / m
+        grads = jax.tree_util.tree_map(lambda g: (g / m)[None], gacc)
+        return loss, grads
+
+    return inner
+
+
 def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
-                             remat=False):
+                             remat=False, schedule="gpipe"):
     """GPipe-style pipeline-parallel TRAINING step.
 
     Ref: /root/reference/python/paddle/fluid/optimizer.py:2985
@@ -131,13 +218,38 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
 
     Returns step(params, opt_state, x, y) -> (loss, params, opt_state)
     where x is [M, mb, ...] microbatches and y the matching labels.
+
+    schedule:
+      "gpipe" (default) — forward wave then autodiff-transposed backward
+        wave; all M microbatch residuals live across the turnaround
+        (remat=True shrinks each residual to the stage input).
+      "1f1b"  — one forward + one backward microstep per tick
+        (_pipeline_1f1b_loss_and_grads): live activations bounded by
+        2S-1 stage inputs regardless of M, backward recomputes from the
+        saved input (remat implied). Requires loss_fn to average over
+        the microbatch axis (the GPipe path then matches exactly).
     """
+    pspec = P(axis_name)
+    if schedule == "1f1b":
+        fwd_bwd = shard_map(
+            _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn, axis_name),
+            mesh=mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec), check_vma=False)
+
+        def step(params, opt_state, x, y):
+            loss, grads = fwd_bwd(params, x, y)
+            params, opt_state = opt.apply_gradients(params, grads, opt_state)
+            return loss, params, opt_state
+
+        return step
+    if schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(choices: 'gpipe', '1f1b')")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def inner(params, x):
         return pipeline_forward(fn, params, x, axis_name)
 
-    pspec = P(axis_name)
     fwd = shard_map(inner, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
                     check_vma=False)
 

@@ -527,7 +527,25 @@ got = np.frombuffer(ctypes.string_at(o.data, o.nbytes),
                     np.float32).reshape(expected.shape)
 np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
 lib.PT_OutputsFree(outs, n.value)
+
+# Clone: shared executable + weights; parent freed FIRST, clone must
+# still serve identical outputs (ref paddle_api.h:271)
+lib.PT_PredictorClone.restype = ctypes.c_void_p
+lib.PT_PredictorClone.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_size_t]
+c = lib.PT_PredictorClone(h, err, 1024)
+assert c, err.value
 lib.PT_PredictorFree(h)
+outs2 = ctypes.POINTER(PT_Tensor)()
+n2 = ctypes.c_size_t()
+rc = lib.PT_PredictorRun(c, ctypes.byref(inp), 1, ctypes.byref(outs2),
+                         ctypes.byref(n2), err, 1024)
+assert rc == 0, err.value
+got2 = np.frombuffer(ctypes.string_at(outs2[0].data, outs2[0].nbytes),
+                     np.float32).reshape(expected.shape)
+np.testing.assert_array_equal(got2, got)
+lib.PT_OutputsFree(outs2, n2.value)
+lib.PT_PredictorFree(c)
 print("CAPI_E2E_OK")
 """)
         env = dict(os.environ)

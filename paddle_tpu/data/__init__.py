@@ -9,6 +9,16 @@ from paddle_tpu.data.dataset import (
     synthetic_mnist,
     synthetic_tokens,
 )
+from paddle_tpu.data import formats
+from paddle_tpu.data.formats import (
+    build_dict,
+    cifar_reader,
+    corpus_reader,
+    mnist_reader,
+    ngram_reader,
+    read_idx,
+    tokenize_text,
+)
 
 
 def py_reader(feed_list=None, capacity=8, **kw):

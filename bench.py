@@ -633,6 +633,16 @@ def _captured_fallback(model):
     return None
 
 
+def _tag_cached(row, args):
+    """Annotate a cached fallback row with what was actually requested —
+    the captured row's config (batch/seq/flags) may differ from this
+    invocation's (e.g. a bert --batch 128 request served by the batch-64
+    capture), and the consumer must be able to see that."""
+    row["requested"] = {"model": args.model, "batch": args.batch,
+                        "seq": args.seq, "steps": args.steps}
+    return row
+
+
 def _probe(timeout_s):
     """Fast tunnel aliveness check in a child process: interpreter start
     (sitecustomize registers the PJRT plugin), device enumeration, and one
@@ -767,7 +777,7 @@ def main():
         cached = _captured_fallback(args.model)
         if cached is not None:
             cached["probe_error"] = probe_detail
-            print(json.dumps(cached))
+            print(json.dumps(_tag_cached(cached, args)))
         else:
             print(json.dumps({
                 "metric": "bench_failed", "value": 0.0, "unit": "error",
@@ -817,7 +827,7 @@ def main():
             cached["attempt_error"] = last_tail[-300:]
             cached["note"] = (cached.get("note", "") +
                               " (bench attempts timed out mid-run)")
-            print(json.dumps(cached))
+            print(json.dumps(_tag_cached(cached, args)))
             return
     print(json.dumps({
         "metric": "bench_failed", "value": 0.0, "unit": "error",

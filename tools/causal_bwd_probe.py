@@ -38,7 +38,16 @@ def main():
 
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})", flush=True)
-    cpu = jax.devices("cpu")[0] if jax.devices("cpu") else None
+    # under JAX_PLATFORMS=axon the cpu backend is not registered and
+    # jax.devices("cpu") RAISES (it does not return []) — a crash here
+    # would burn the probe's one silicon shot (recover2 passes
+    # JAX_PLATFORMS=axon,cpu, but do not depend on it)
+    try:
+        cpu = jax.devices("cpu")[0]
+    except (RuntimeError, IndexError) as e:
+        print(f"no cpu backend ({e!r}); using f32-precision chunked as the "
+              "reference proxy", flush=True)
+        cpu = None
 
     rng = np.random.RandomState(0)
     configs = [
@@ -65,12 +74,6 @@ def main():
                 a, b_, c, scale=scale, causal=causal), qx, kx, vx)
             return vjp(gx)
 
-        # ground truth: chunked on CPU in float64
-        with jax.default_device(cpu):
-            R = jax.jit(chunked_grads)(
-                *(jnp.asarray(x, jnp.float64) for x in (q, k, v, g)))
-            R = [np.asarray(x, np.float64) for x in R]
-
         qj, kj, vj, gj = (jnp.asarray(x) for x in (q, k, v, g))
         A = [np.asarray(x, np.float64)
              for x in jax.jit(flash_grads)(qj, kj, vj, gj)]
@@ -80,15 +83,27 @@ def main():
             C = [np.asarray(x, np.float64)
                  for x in jax.jit(chunked_grads)(qj, kj, vj, gj)]
 
+        if cpu is not None:
+            # ground truth: chunked on CPU in float64
+            with jax.default_device(cpu):
+                R = jax.jit(chunked_grads)(
+                    *(jnp.asarray(x, jnp.float64) for x in (q, k, v, g)))
+                R = [np.asarray(x, np.float64) for x in R]
+        else:
+            R = C  # f32-precision chunked: weaker, still separates A vs B
+
         names = ["dq", "dk", "dv"]
         for i, gn in enumerate(names):
             ar = float(np.max(np.abs(A[i] - R[i])))
             br = float(np.max(np.abs(B[i] - R[i])))
-            cr = float(np.max(np.abs(C[i] - R[i])))
             ab = float(np.max(np.abs(A[i] - B[i])))
+            if R is C:  # proxy mode: C-vs-C would print a misleading 0
+                cr_s = "n/a(ref=proxy)"
+            else:
+                cr_s = f"{float(np.max(np.abs(C[i] - R[i]))):.3e}"
             print(f"{name} {gn}: |pallas-ref|={ar:.3e} "
                   f"|chunked_default-ref|={br:.3e} "
-                  f"|chunked_f32-ref|={cr:.3e} |pallas-chunked|={ab:.3e}",
+                  f"|chunked_f32-ref|={cr_s} |pallas-chunked|={ab:.3e}",
                   flush=True)
 
 

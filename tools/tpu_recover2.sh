@@ -52,6 +52,7 @@ row() {
 say "$(date -u +%FT%TZ) recover2 start"
 
 row bert            python bench.py --model bert --steps 10
+row bert_b128       python bench.py --model bert --steps 10 --batch 128
 row ernie           python bench.py --model ernie --steps 10
 row ctr             python bench.py --model ctr --steps 10
 row transformer_big python bench.py --model transformer_big --steps 10
@@ -94,7 +95,9 @@ tool() {
 }
 
 # patterns are each tool's FINAL output line so a mid-run timeout is a MISS
-tool causal_probe "fa_plain dv"   420 python tools/causal_bwd_probe.py
+# axon,cpu: the probe's f64 ground truth needs a cpu backend registered
+# alongside the TPU (plain "axon" would make jax.devices("cpu") raise)
+tool causal_probe "fa_plain dv"   420 env JAX_PLATFORMS=axon,cpu python tools/causal_bwd_probe.py
 tool conv_traffic "nchw_to_nhwc"  420 python tools/conv_traffic_probe.py
 tool op_bench     "op_bench.*complete" 560 python tools/op_bench.py --n 20
 tool flash_tune   "flip the flash" 560 python tools/flash_tune.py --quick

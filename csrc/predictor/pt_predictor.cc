@@ -39,6 +39,35 @@ bool FileExists(const std::string& path) {
   return static_cast<bool>(f);
 }
 
+// bytes per element for a PJRT_Buffer_Type; 0 = unknown (size check
+// skipped — sub-byte and exotic types go through unvalidated)
+uint64_t DtypeSize(uint32_t dtype) {
+  switch (static_cast<PJRT_Buffer_Type>(dtype)) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 4;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_C128:
+      return 16;
+    default:
+      return 0;
+  }
+}
+
 }  // namespace
 
 bool LoadPTPB(const std::string& path, std::vector<Tensor>* out,
@@ -197,15 +226,20 @@ struct Predictor::Impl {
     return ok;
   }
 
-  PJRT_Buffer* ToDevice(const Tensor& t, std::string* error) {
+  // h2d straight from caller memory — the Tensor and zero-copy paths
+  // share it (kImmutableUntilTransferCompletes + the await below make the
+  // borrow window end before this returns)
+  PJRT_Buffer* ToDeviceRaw(uint32_t dtype, const int64_t* dims,
+                           size_t num_dims, const void* data,
+                           std::string* error) {
     PJRT_Client_BufferFromHostBuffer_Args args;
     memset(&args, 0, sizeof(args));
     args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
     args.client = client;
-    args.data = t.data.data();
-    args.type = static_cast<PJRT_Buffer_Type>(t.dtype);
-    args.dims = t.dims.data();
-    args.num_dims = t.dims.size();
+    args.data = data;
+    args.type = static_cast<PJRT_Buffer_Type>(dtype);
+    args.dims = dims;
+    args.num_dims = num_dims;
     args.host_buffer_semantics =
         PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
     args.device = device;
@@ -217,6 +251,11 @@ struct Predictor::Impl {
       return nullptr;
     }
     return args.buffer;
+  }
+
+  PJRT_Buffer* ToDevice(const Tensor& t, std::string* error) {
+    return ToDeviceRaw(t.dtype, t.dims.data(), t.dims.size(),
+                       t.data.data(), error);
   }
 
   bool Execute(const std::vector<PJRT_Buffer*>& args_in,
@@ -252,6 +291,43 @@ struct Predictor::Impl {
       return false;
     *ty = et.type;
     return true;
+  }
+
+  // d2h straight into a caller buffer (the ZeroCopyTensor copy_to_cpu
+  // analog). Fills v's dtype/dims/nbytes even on capacity failure so the
+  // caller can reallocate and retry.
+  bool BufferToHostInto(PJRT_Buffer* b, size_t idx, MutableTensorView* v,
+                        std::string* error) {
+    PJRT_Buffer_Type ty;
+    if (!BufferDtype(b, &ty, error)) return false;
+    v->dtype = static_cast<uint32_t>(ty);
+    PJRT_Buffer_Dimensions_Args da;
+    memset(&da, 0, sizeof(da));
+    da.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+    da.buffer = b;
+    if (!Check(api->PJRT_Buffer_Dimensions(&da), "Dimensions", error))
+      return false;
+    v->dims.assign(da.dims, da.dims + da.num_dims);
+    PJRT_Buffer_ToHostBuffer_Args th;
+    memset(&th, 0, sizeof(th));
+    th.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    th.src = b;
+    th.dst = nullptr;  // size query
+    if (!Check(api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer(size)",
+               error))
+      return false;
+    v->nbytes = th.dst_size;
+    if (!v->data || v->capacity < th.dst_size) {
+      if (error)
+        *error = "output " + std::to_string(idx) + " needs " +
+                 std::to_string(th.dst_size) + " bytes, caller capacity " +
+                 std::to_string(v->capacity);
+      return false;
+    }
+    th.dst = v->data;
+    if (!Check(api->PJRT_Buffer_ToHostBuffer(&th), "ToHostBuffer", error))
+      return false;
+    return AwaitAndFree(th.event, "Event_Await(d2h)", error);
   }
 
   bool BufferToHost(PJRT_Buffer* b, Tensor* t, std::string* error) {
@@ -416,6 +492,57 @@ bool Predictor::Run(const std::vector<Tensor>& inputs,
     outputs->assign(out_bufs.size(), Tensor{});
     for (size_t i = 0; ok && i < out_bufs.size(); ++i)
       ok = im->BufferToHost(out_bufs[i], &(*outputs)[i], error);
+  }
+  for (auto* b : out_bufs) im->DestroyBuffer(b);
+  for (auto* b : transient) im->DestroyBuffer(b);
+  return ok;
+}
+
+bool Predictor::RunZeroCopy(const TensorView* inputs, size_t num_inputs,
+                            std::vector<MutableTensorView>* outputs,
+                            std::string* error) {
+  Impl* im = impl_.get();
+  if (!im->exe) {
+    if (error) *error = "predictor created without a plugin (no device)";
+    return false;
+  }
+  if (!outputs || outputs->size() != im->n_outputs) {
+    if (error)
+      *error = "outputs must hold exactly " +
+               std::to_string(im->n_outputs) + " views (got " +
+               std::to_string(outputs ? outputs->size() : 0) + ")";
+    return false;
+  }
+  std::vector<PJRT_Buffer*> args(
+      im->state_bufs.begin(), im->state_bufs.begin() + im->params.size());
+  std::vector<PJRT_Buffer*> transient;
+  bool ok = true;
+  for (size_t i = 0; i < num_inputs; ++i) {
+    const TensorView& v = inputs[i];
+    // the h2d DMA reads product(dims)*itemsize bytes straight from caller
+    // memory — an undersized borrow would be an out-of-bounds read, so
+    // check the declared nbytes up front (the reason the field exists)
+    uint64_t need = DtypeSize(v.dtype);
+    for (int64_t d : v.dims) need *= static_cast<uint64_t>(d);
+    if (need > 0 && (v.nbytes < need || !v.data)) {
+      if (error)
+        *error = "input " + std::to_string(i) + " needs " +
+                 std::to_string(need) + " bytes, caller provided " +
+                 (v.data ? std::to_string(v.nbytes) : "null");
+      ok = false;
+      break;
+    }
+    PJRT_Buffer* b = im->ToDeviceRaw(v.dtype, v.dims.data(), v.dims.size(),
+                                     v.data, error);
+    if (!b) { ok = false; break; }
+    transient.push_back(b);
+    args.push_back(b);
+  }
+  std::vector<PJRT_Buffer*> out_bufs;
+  if (ok) ok = im->Execute(args, &out_bufs, error);
+  if (ok) {
+    for (size_t i = 0; ok && i < out_bufs.size(); ++i)
+      ok = im->BufferToHostInto(out_bufs[i], i, &(*outputs)[i], error);
   }
   for (auto* b : out_bufs) im->DestroyBuffer(b);
   for (auto* b : transient) im->DestroyBuffer(b);

@@ -43,13 +43,34 @@
 
 namespace pt {
 
-// Host tensor (the ZeroCopyTensor analog, paddle_api.h PaddleTensor):
-// dtype is a PJRT_Buffer_Type value (e.g. 11 = F32, 4 = S32 — see
-// pjrt_c_api.h); dims are row-major; data is the raw little-endian bytes.
+// Host tensor (paddle_api.h PaddleTensor analog): dtype is a
+// PJRT_Buffer_Type value (e.g. 11 = F32, 4 = S32 — see pjrt_c_api.h);
+// dims are row-major; data is the raw little-endian bytes.
 struct Tensor {
   uint32_t dtype = 0;
   std::vector<int64_t> dims;
   std::vector<uint8_t> data;
+};
+
+// Borrowed views for the zero-copy path (ref paddle_api.h:148
+// ZeroCopyTensor + :243,254 GetInputTensor/GetOutputTensor): the library
+// reads inputs straight from caller memory (h2d DMA from `data`, no
+// staging copy) and writes outputs straight into caller buffers (d2h DMA
+// into `data`). The caller owns both for the duration of the call.
+struct TensorView {
+  uint32_t dtype = 0;
+  std::vector<int64_t> dims;
+  const void* data = nullptr;
+  size_t nbytes = 0;
+};
+
+struct MutableTensorView {
+  void* data = nullptr;   // caller-allocated destination
+  size_t capacity = 0;    // bytes available at data
+  // filled by the call:
+  uint32_t dtype = 0;
+  std::vector<int64_t> dims;
+  size_t nbytes = 0;      // bytes actually written
 };
 
 struct PredictorConfig {
@@ -88,6 +109,17 @@ class Predictor {
   // must match the exported signature.
   bool Run(const std::vector<Tensor>& inputs, std::vector<Tensor>* outputs,
            std::string* error);
+
+  // Zero-copy serving call (ref paddle_api.h:148 ZeroCopyRun contract):
+  // inputs are borrowed views over caller memory (no staging copy before
+  // the h2d DMA); each output is written directly into the caller's
+  // buffer. outputs->size() must equal num_outputs() once compiled;
+  // a too-small capacity fails with the required byte count in *error
+  // (dims/nbytes/dtype are filled for every output that was measured).
+  // Same thread-safety contract as Run.
+  bool RunZeroCopy(const TensorView* inputs, size_t num_inputs,
+                   std::vector<MutableTensorView>* outputs,
+                   std::string* error);
 
   // One training step over a save_train_program artifact: executes on
   // [state..., fixed inputs (inputs.bin)...]; program outputs are

@@ -115,6 +115,67 @@ int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
   return 0;
 }
 
+int PT_PredictorRunZeroCopy(PT_Predictor* pred, const PT_Tensor* inputs,
+                            size_t n_inputs, PT_Tensor* outputs,
+                            size_t n_outputs, char* err_buf,
+                            size_t err_len) {
+  if (!pred) {
+    SetErr(err_buf, err_len, "null predictor");
+    return 1;
+  }
+  if ((!inputs && n_inputs > 0) || (!outputs && n_outputs > 0)) {
+    SetErr(err_buf, err_len, "null inputs/outputs pointer");
+    return 1;
+  }
+  auto* h = reinterpret_cast<PredictorHandle*>(pred);
+  std::vector<pt::TensorView> ins(n_inputs);
+  for (size_t i = 0; i < n_inputs; ++i) {
+    const PT_Tensor& t = inputs[i];
+    if (t.ndim < 0 || t.ndim > PT_MAX_DIMS) {
+      SetErr(err_buf, err_len, "input ndim out of range");
+      return 1;
+    }
+    ins[i].dtype = t.dtype;
+    ins[i].dims.assign(t.dims, t.dims + t.ndim);
+    ins[i].data = t.data;
+    ins[i].nbytes = t.nbytes;
+  }
+  std::vector<pt::MutableTensorView> outs(n_outputs);
+  for (size_t i = 0; i < n_outputs; ++i) {
+    outs[i].data = outputs[i].data;
+    outs[i].capacity = outputs[i].nbytes;
+  }
+  std::string err;
+  bool ok = h->impl->RunZeroCopy(ins.data(), ins.size(), &outs, &err);
+  /* propagate per-output metadata even on failure (the required-size
+   * retry contract) */
+  bool dims_overflow = false;
+  for (size_t i = 0; i < n_outputs; ++i) {
+    PT_Tensor& o = outputs[i];
+    o.dtype = outs[i].dtype;
+    size_t nd = outs[i].dims.size();
+    if (nd <= PT_MAX_DIMS) {
+      o.ndim = static_cast<int32_t>(nd);
+      for (size_t d = 0; d < nd; ++d) o.dims[d] = outs[i].dims[d];
+    } else {
+      dims_overflow = true;
+    }
+    /* on success every output was measured, so nbytes is authoritative
+     * (including a genuine 0); on failure keep the caller's capacity for
+     * outputs that were never measured */
+    if (ok || outs[i].nbytes) o.nbytes = outs[i].nbytes;
+  }
+  if (!ok) {
+    SetErr(err_buf, err_len, err);
+    return 1;
+  }
+  if (dims_overflow) {
+    SetErr(err_buf, err_len, "output ndim exceeds PT_MAX_DIMS");
+    return 1;
+  }
+  return 0;
+}
+
 PT_Predictor* PT_PredictorClone(PT_Predictor* pred, char* err_buf,
                                 size_t err_len) {
   if (!pred) {

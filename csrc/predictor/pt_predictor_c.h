@@ -49,6 +49,21 @@ int PT_PredictorRun(PT_Predictor* pred, const PT_Tensor* inputs,
                     size_t n_inputs, PT_Tensor** outputs,
                     size_t* n_outputs, char* err_buf, size_t err_len);
 
+/* Zero-copy serving call (ref paddle_api.h:148 ZeroCopyTensor /
+ * ZeroCopyRun): input data is read DIRECTLY from the caller's buffers
+ * (borrowed only for the duration of the call), and each output is
+ * written DIRECTLY into outputs[i].data, whose capacity the caller
+ * declares in outputs[i].nbytes. No library-side staging copies.
+ * n_outputs must equal PT_PredictorNumOutputs(). On success each
+ * outputs[i] has dtype/ndim/dims set and nbytes = bytes written. If a
+ * capacity is too small the call fails with the required byte count in
+ * both err_buf and outputs[i].nbytes (data is untouched) so the caller
+ * can reallocate and retry. Returns 0 on success. */
+int PT_PredictorRunZeroCopy(PT_Predictor* pred, const PT_Tensor* inputs,
+                            size_t n_inputs, PT_Tensor* outputs,
+                            size_t n_outputs, char* err_buf,
+                            size_t err_len);
+
 /* One training step on a save_train_program artifact; *loss receives the
  * step loss. Returns 0 on success. Fails while clones are outstanding
  * (they read the weights this call would replace). */

@@ -70,6 +70,43 @@ int main(int argc, char** argv) {
   if (!out_path.empty() && !pt::SavePTPB(out_path, out1, &err))
     return Fail("SavePTPB: " + err);
 
+  // Zero-copy path (ref paddle_api.h:148): inputs borrowed from caller
+  // memory, outputs written into caller buffers; must match Run() bytes.
+  {
+    std::vector<pt::TensorView> views(inputs.size());
+    for (size_t i = 0; i < inputs.size(); ++i) {
+      views[i].dtype = inputs[i].dtype;
+      views[i].dims = inputs[i].dims;
+      views[i].data = inputs[i].data.data();
+      views[i].nbytes = inputs[i].data.size();
+    }
+    std::vector<std::vector<uint8_t>> bufs(out1.size());
+    std::vector<pt::MutableTensorView> outs(out1.size());
+    for (size_t i = 0; i < out1.size(); ++i) {
+      bufs[i].resize(out1[i].data.size());
+      outs[i].data = bufs[i].data();
+      outs[i].capacity = bufs[i].size();
+    }
+    if (!pred->RunZeroCopy(views.data(), views.size(), &outs, &err))
+      return Fail("RunZeroCopy: " + err);
+    for (size_t i = 0; i < out1.size(); ++i) {
+      if (outs[i].nbytes != out1[i].data.size() ||
+          memcmp(bufs[i].data(), out1[i].data.data(), outs[i].nbytes) != 0)
+        return Fail("zero-copy output differs from Run()");
+      if (outs[i].dims != out1[i].dims)
+        return Fail("zero-copy dims differ from Run()");
+    }
+    // capacity-too-small: fails, reports the required size, leaves the
+    // caller able to retry
+    outs[0].capacity = 1;
+    if (pred->RunZeroCopy(views.data(), views.size(), &outs, &err))
+      return Fail("RunZeroCopy with capacity 1 must fail");
+    if (err.find(std::to_string(out1[0].data.size())) == std::string::npos)
+      return Fail("capacity error should name the required bytes: " + err);
+    if (outs[0].nbytes != out1[0].data.size())
+      return Fail("capacity failure must still report required nbytes");
+  }
+
   // Clone() fleet (ref paddle_api.h:271): N per-thread handles over ONE
   // compiled executable + ONE device-resident weight set; every thread's
   // outputs must match the parent's run byte-for-byte.

@@ -528,6 +528,32 @@ got = np.frombuffer(ctypes.string_at(o.data, o.nbytes),
 np.testing.assert_allclose(got, expected, rtol=2e-2, atol=2e-2)
 lib.PT_OutputsFree(outs, n.value)
 
+# Zero-copy run (ref paddle_api.h:148): input borrowed from the numpy
+# buffer, output written into a caller-allocated array; must match Run()
+lib.PT_PredictorRunZeroCopy.restype = ctypes.c_int
+lib.PT_PredictorRunZeroCopy.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(PT_Tensor), ctypes.c_size_t,
+    ctypes.POINTER(PT_Tensor), ctypes.c_size_t, ctypes.c_char_p,
+    ctypes.c_size_t]
+zc_out = np.zeros(expected.shape, np.float32)
+ot = PT_Tensor()
+ot.data = zc_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+ot.nbytes = zc_out.nbytes
+rc = lib.PT_PredictorRunZeroCopy(h, ctypes.byref(inp), 1,
+                                 ctypes.byref(ot), 1, err, 1024)
+assert rc == 0, err.value
+assert ot.nbytes == zc_out.nbytes and ot.dtype == 11
+np.testing.assert_array_equal(zc_out, got)
+# too-small capacity: fails naming the required bytes, reports nbytes
+ot2 = PT_Tensor()
+small = np.zeros(1, np.uint8)
+ot2.data = small.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+ot2.nbytes = 1
+rc = lib.PT_PredictorRunZeroCopy(h, ctypes.byref(inp), 1,
+                                 ctypes.byref(ot2), 1, err, 1024)
+assert rc != 0 and str(zc_out.nbytes).encode() in err.value, err.value
+assert ot2.nbytes == zc_out.nbytes
+
 # Clone: shared executable + weights; parent freed FIRST, clone must
 # still serve identical outputs (ref paddle_api.h:271)
 lib.PT_PredictorClone.restype = ctypes.c_void_p

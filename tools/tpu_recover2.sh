@@ -101,6 +101,10 @@ tool causal_probe "fa_plain dv"   420 env JAX_PLATFORMS=axon,cpu python tools/ca
 tool conv_traffic "nchw_to_nhwc"  420 python tools/conv_traffic_probe.py
 tool op_bench     "op_bench.*complete" 560 python tools/op_bench.py --n 20
 tool flash_tune   "flip the flash" 560 python tools/flash_tune.py --quick
+# full Pallas parity sweep with the f32-precision baseline — the 30/30
+# answer to the window-2 causal-bwd question ('"ok": true' only prints
+# when every check passed)
+tool tpu_smoke    '"ok": true' 560 python tools/tpu_smoke.py --quick
 
 # riskiest compile LAST (blew a 240 s window on day 1)
 row resnet50_b256   python bench.py --model resnet50 --steps 10 --batch 256

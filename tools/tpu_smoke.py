@@ -141,8 +141,18 @@ def main():
                   flush=True)
             failed.append(name)
             continue
-        ref = chunked_attention(q, k, v, scale=scale, causal=cfg["causal"],
-                                kv_mask=kv_mask, chunk_size=bk)
+        # The baseline's einsums must run at f32 matmul precision: at the
+        # TPU default they truncate operands to bf16, and on causal shapes
+        # (softmax mass concentrated on fewer keys -> larger p entries)
+        # that puts ~1e-2 of absolute noise in the BASELINE — the window-2
+        # "4/30 causal-bwd failures" signature. The Pallas kernels compute
+        # their dots in f32, so compare against an f32 reference
+        # (VERDICT r4 #4; tools/causal_bwd_probe.py decides this
+        # independently on silicon).
+        with jax.default_matmul_precision("float32"):
+            ref = chunked_attention(q, k, v, scale=scale,
+                                    causal=cfg["causal"],
+                                    kv_mask=kv_mask, chunk_size=bk)
         check(name + "_fwd", _maxdiff(out, ref), 2e-3)
 
         try:
@@ -156,10 +166,11 @@ def main():
                   flush=True)
             failed.append(name + "_bwd")
             continue
-        _, vjp = jax.vjp(lambda a, b_, c: chunked_attention(
-            a, b_, c, scale=scale, causal=cfg["causal"], kv_mask=kv_mask,
-            chunk_size=bk), q, k, v)
-        rdq, rdk, rdv = vjp(go)
+        with jax.default_matmul_precision("float32"):
+            _, vjp = jax.vjp(lambda a, b_, c: chunked_attention(
+                a, b_, c, scale=scale, causal=cfg["causal"],
+                kv_mask=kv_mask, chunk_size=bk), q, k, v)
+            rdq, rdk, rdv = vjp(go)
         check(name + "_dq", _maxdiff(dq, rdq), 5e-3)
         check(name + "_dk", _maxdiff(dk, rdk), 5e-3)
         check(name + "_dv", _maxdiff(dv, rdv), 5e-3)

@@ -185,8 +185,149 @@ def _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn, axis_name):
     return inner
 
 
+def interleave_stage_params(stacked, num_stages, num_chunks):
+    """[N = V*S, ...] global-stage-stacked params -> [S, V, ...] device-major
+    layout for schedule='interleaved': device s holds global stages
+    s, s+S, ..., s+(V-1)S (the Megatron-style round-robin placement that
+    lets the pipeline ramp advance one *chunk* per tick instead of one
+    full device-stage)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((num_chunks, num_stages) + a.shape[1:])
+                   .swapaxes(0, 1), stacked)
+
+
+def uninterleave_stage_params(inter, num_stages, num_chunks):
+    """Inverse of interleave_stage_params: [S, V, ...] -> [V*S, ...]."""
+    return jax.tree_util.tree_map(
+        lambda a: a.swapaxes(0, 1).reshape((num_chunks * num_stages,)
+                                           + a.shape[2:]), inter)
+
+
+def _pipeline_interleaved_loss_and_grads(stage_fn, loss_fn, num_chunks,
+                                         axis_name):
+    """Interleaved 1F1B (virtual pipeline chunks) as a single tick scan.
+
+    Ref: /root/reference/paddle/fluid/framework/pipeline_trainer.cc runs
+    2k-1 *sections* with per-section concurrency — far more sections than
+    devices. The TPU-native analog: each device holds V chunks (global
+    stage G = v*S + s on device s, slot v), every forward/backward hop is
+    still a ring ppermute (G -> G+1 always crosses to the next device,
+    wrapping s=S-1 -> s=0 raises v by one), and the wave advances one
+    CHUNK per tick, so the ramp costs ~(S-1) chunk-ticks instead of
+    (S-1) full-stage ticks. Tick schedule (m grouped in rounds of S,
+    r = m mod S, g = m div S):
+
+      forward  F(s,v,m) = s + v*S + r + g*S*V
+      backward B(s,v,m) = N + (S-1-s) + (V-1-v)*S + r + g*S*V   (N = S*V)
+
+    Both are injective per device (one chunk-forward + one chunk-backward
+    per tick) and satisfy F(G+1) = F(G)+1 / B(G) = B(G+1)+1, so each
+    tick's single ppermute pair delivers exactly on time. Total ticks
+    M*V + S*V + S - 1 vs the plain-1f1b equivalent V*(M + 2S - 2) chunk
+    pairs — (S-2)(V-1) ticks saved, the interleave ramp win. Activation
+    buffer: 2N chunk inputs (live window max 2N-1, +1 slack so a drain
+    tick's store can never clobber the slot it is about to read).
+    Backward recomputes each chunk from its saved input (remat implied).
+    Same per-microbatch loss_fn contract as the 1f1b schedule.
+    """
+    def inner(params, x, y):
+        n = lax.axis_size(axis_name)          # S devices
+        me = lax.axis_index(axis_name)
+        v_n = num_chunks                      # V chunks per device
+        big_n = n * v_n                       # N global stages
+        m = x.shape[0]
+        k = 2 * big_n
+        perm_f = [(i, (i + 1) % n) for i in range(n)]
+        perm_b = [(i, (i - 1) % n) for i in range(n)]
+        my_params = jax.tree_util.tree_map(lambda a: a[0], params)  # [V,...]
+        chunk0 = jax.tree_util.tree_map(lambda a: a[0], my_params)
+        h_sds = jax.eval_shape(lambda p, a: stage_fn(p, a), chunk0,
+                               jax.ShapeDtypeStruct(x.shape[1:], x.dtype))
+
+        def mb_loss(h_out, y_mb):
+            return loss_fn(h_out[None], y_mb[None])
+
+        def fwd_sched(t):
+            u = t - me
+            g, rem = u // big_n, u % big_n
+            v, r = rem // n, rem % n
+            mb = g * n + r
+            return v, mb, (u >= 0) & (mb >= 0) & (mb < m)
+
+        def bwd_sched(t):
+            u = t - big_n - (n - 1 - me)
+            g, rem = u // big_n, u % big_n
+            v, r = v_n - 1 - rem // n, rem % n
+            mb = g * n + r
+            return v, mb, (u >= 0) & (mb >= 0) & (mb < m)
+
+        def fwd_tick(v, mb):  # F(me, v, mb)
+            return me + v * n + jnp.mod(mb, n) + (mb // n) * big_n
+
+        def pick(tree, idx):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, idx, 0,
+                                                   keepdims=False), tree)
+
+        def tick(carry, t):
+            h_fly, g_fly, pending_lg, acts, gacc, lacc = carry
+            # ---- forward chunk-microstep ----
+            vf, mf, fvalid = fwd_sched(t)
+            feed = lax.dynamic_index_in_dim(
+                x, jnp.clip(mf, 0, m - 1), 0, keepdims=False)
+            h_in = jnp.where((me == 0) & (vf == 0), feed, h_fly)
+            acts = lax.dynamic_update_index_in_dim(
+                acts, h_in, jnp.mod(t, k), 0)
+            h_out = stage_fn(pick(my_params, vf), h_in)
+            # loss head: device S-1 chunk V-1 is the last global stage;
+            # its backward fires one tick after this forward, so the
+            # cotangent is carried in pending_lg for exactly one tick
+            y_b = lax.dynamic_index_in_dim(
+                y, jnp.clip(mf, 0, m - 1), 0, keepdims=False)
+            loss_v, dh_out = jax.value_and_grad(mb_loss)(h_out, y_b)
+            is_last_fwd = (me == n - 1) & (vf == v_n - 1) & fvalid
+            lacc = lacc + jnp.where(is_last_fwd,
+                                    loss_v.astype(jnp.float32), 0.0)
+            new_pending = jnp.where(is_last_fwd, dh_out,
+                                    jnp.zeros_like(dh_out))
+            # ---- backward chunk-microstep ----
+            vb, mbk, bvalid = bwd_sched(t)
+            g_in = jnp.where((me == n - 1) & (vb == v_n - 1), pending_lg,
+                             g_fly)
+            h_saved = lax.dynamic_index_in_dim(
+                acts, jnp.mod(fwd_tick(vb, mbk), k), 0, keepdims=False)
+            _, vjp_fn = jax.vjp(stage_fn, pick(my_params, vb), h_saved)
+            dp, dh_prev = vjp_fn(g_in)
+            gacc = jax.tree_util.tree_map(
+                lambda a, d: lax.dynamic_update_index_in_dim(
+                    a,
+                    lax.dynamic_index_in_dim(a, vb, 0, keepdims=False)
+                    + jnp.where(bvalid, d, 0), vb, 0),
+                gacc, dp)
+            h_fly = lax.ppermute(h_out, axis_name, perm_f)
+            g_fly = lax.ppermute(dh_prev, axis_name, perm_b)
+            return (h_fly, g_fly, new_pending, acts, gacc, lacc), None
+
+        zeros_h = jnp.zeros(h_sds.shape, h_sds.dtype)
+        carry0 = (zeros_h, zeros_h, zeros_h,
+                  jnp.zeros((k,) + h_sds.shape, h_sds.dtype),
+                  jax.tree_util.tree_map(jnp.zeros_like, my_params),
+                  jnp.float32(0.0))
+        # last tick = B(stage 0, microbatch M-1) = (2N-1) + f(M-1) where
+        # f(m) = (m mod S) + (m div S)*N is the round term (NOT (M-1)
+        # collapsed — a partial last round still pays a full-round stride)
+        total = 2 * big_n + (m - 1) % n + ((m - 1) // n) * big_n
+        carry, _ = lax.scan(tick, carry0, jnp.arange(total))
+        gacc, lacc = carry[4], carry[5]
+        loss = lax.psum(lacc, axis_name) / m
+        grads = jax.tree_util.tree_map(lambda g: (g / m)[None], gacc)
+        return loss, grads
+
+    return inner
+
+
 def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
-                             remat=False, schedule="gpipe"):
+                             remat=False, schedule="gpipe", num_chunks=1):
     """GPipe-style pipeline-parallel TRAINING step.
 
     Ref: /root/reference/python/paddle/fluid/optimizer.py:2985
@@ -228,15 +369,35 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
         2S-1 stage inputs regardless of M, backward recomputes from the
         saved input (remat implied). Requires loss_fn to average over
         the microbatch axis (the GPipe path then matches exactly).
+      "interleaved" — 1f1b over num_chunks virtual chunks per device
+        (_pipeline_interleaved_loss_and_grads): params in the
+        interleave_stage_params [S, V, ...] layout; the ramp advances one
+        chunk per tick (the reference's many-sections-per-device
+        concurrency, pipeline_trainer.cc). Same loss_fn contract.
     """
     pspec = P(axis_name)
-    if schedule == "1f1b":
-        fwd_bwd = shard_map(
-            _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn, axis_name),
-            mesh=mesh, in_specs=(pspec, P(), P()),
-            out_specs=(P(), pspec), check_vma=False)
+    if schedule in ("1f1b", "interleaved"):
+        if schedule == "interleaved":
+            inner = _pipeline_interleaved_loss_and_grads(
+                stage_fn, loss_fn, num_chunks, axis_name)
+        else:
+            inner = _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn,
+                                                  axis_name)
+        fwd_bwd = shard_map(inner, mesh=mesh, in_specs=(pspec, P(), P()),
+                            out_specs=(P(), pspec), check_vma=False)
 
         def step(params, opt_state, x, y):
+            if schedule == "interleaved":
+                # dynamic_index clamps, so a chunk-count/layout mismatch
+                # would train silently with wrong gradients — fail at
+                # trace time instead (shapes are static)
+                for leaf in jax.tree_util.tree_leaves(params):
+                    if leaf.ndim < 2 or leaf.shape[1] != num_chunks:
+                        raise ValueError(
+                            f"interleaved params must have shape "
+                            f"[n_stages, num_chunks={num_chunks}, ...] "
+                            f"(interleave_stage_params); got leaf shape "
+                            f"{leaf.shape}")
             loss, grads = fwd_bwd(params, x, y)
             params, opt_state = opt.apply_gradients(params, grads, opt_state)
             return loss, params, opt_state
@@ -244,7 +405,7 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
         return step
     if schedule != "gpipe":
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
-                         "(choices: 'gpipe', '1f1b')")
+                         "(choices: 'gpipe', '1f1b', 'interleaved')")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def inner(params, x):

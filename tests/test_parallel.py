@@ -253,6 +253,71 @@ class TestPipeline:
                 np.asarray(a), np.asarray(b), atol=1e-5),
             pp_params, ref_params)
 
+    def test_pipeline_interleaved_matches_sequential(self, mesh8):
+        """schedule='interleaved' (VERDICT r4 #7's virtual-chunk option,
+        ref pipeline_trainer.cc's many-sections-per-device concurrency):
+        16 global stages round-robined over 8 devices as 2 chunks each
+        must train identically to the sequential 16-stage model. M=10 is
+        deliberately NOT a multiple of S — the partial last round pays a
+        full-round tick stride (regression: a truncated drain silently
+        drops the last group's early-stage gradients)."""
+        from paddle_tpu.parallel.pipeline import (
+            interleave_stage_params, make_pipeline_train_step,
+            split_microbatches, stack_stage_params,
+            uninterleave_stage_params)
+        n_stages, n_chunks, n_micro, dim, mb = 8, 2, 10, 8, 2
+        n_global = n_stages * n_chunks
+        keys = jax.random.split(jax.random.key(3), n_global)
+        stacked = stack_stage_params(
+            [{"w": jax.random.normal(k, (dim, dim)) * 0.3,
+              "b": jnp.zeros((dim,))} for k in keys])
+        inter = interleave_stage_params(stacked, n_stages, n_chunks)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            uninterleave_stage_params(inter, n_stages, n_chunks), stacked)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        def loss_fn(outs, labels):
+            return jnp.mean((outs - labels) ** 2)
+
+        x = jnp.asarray(r((n_micro * mb, dim)))
+        y = jnp.asarray(r((n_micro * mb, dim), 1))
+        xm = split_microbatches(x, n_micro)
+        ym = split_microbatches(y, n_micro)
+        pp_mesh = pt.parallel.make_mesh({"pp": n_stages})
+        opt = pt.optimizer.Momentum(0.1, 0.9)
+        step = jax.jit(make_pipeline_train_step(
+            pp_mesh, stage_fn, loss_fn, opt, "pp", schedule="interleaved",
+            num_chunks=n_chunks))
+
+        def seq_loss(params, x, y):
+            h = x
+            for i in range(n_global):
+                h = stage_fn(
+                    jax.tree_util.tree_map(lambda a: a[i], params), h)
+            return jnp.mean((h - y) ** 2)
+
+        ref_opt = pt.optimizer.Momentum(0.1, 0.9)
+
+        @jax.jit
+        def seq_step(params, st, x, y):
+            l, g = jax.value_and_grad(seq_loss)(params, x, y)
+            params, st = ref_opt.apply_gradients(params, g, st)
+            return l, params, st
+
+        pi, sti = inter, opt.init(inter)
+        pr, srt = stacked, ref_opt.init(stacked)
+        for _ in range(3):
+            li, pi, sti = step(pi, sti, xm, ym)
+            lr, pr, srt = seq_step(pr, srt, x, y)
+            np.testing.assert_allclose(float(li), float(lr), atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5),
+            uninterleave_stage_params(pi, n_stages, n_chunks), pr)
+
     def test_pipeline_1f1b_activation_memory_bounded(self, mesh8):
         """Memory half of VERDICT r4 #7 (S=8): the 1f1b schedule's compiled
         temp footprint must stay ~flat as M grows (activations bounded by

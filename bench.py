@@ -285,6 +285,14 @@ def bench_gpt_decode(steps, batch, seq):
     cfg.max_position = max(cfg.max_position, seq)
     model = GPTDecoder(cfg)
     variables = model.init(jax.random.key(0))
+    # PT_BENCH_INT8_DECODE=1: weight-only int8 serving — every decode
+    # step reads the whole parameter set, so int8-resident weights halve
+    # the bf16 HBM bytes per token (quant.weight_only; v5e int8 ride)
+    int8 = os.environ.get("PT_BENCH_INT8_DECODE", "0") == "1"
+    if int8:
+        from paddle_tpu.quant import quantize_weights_int8
+        variables = {"params": quantize_weights_int8(
+            model, variables["params"]), "state": {}}
     max_new = 128
     prompt_len = max(8, seq // 4)
 
@@ -323,7 +331,8 @@ def bench_gpt_decode(steps, batch, seq):
         for l in jax.tree_util.tree_leaves(variables["params"]))
     hbm_util = (max_new + prompt_len) * param_bytes / dt / 819e9
     return {
-        "metric": "gpt_small_decode_tokens_per_sec_per_chip",
+        "metric": ("gpt_small_decode_int8_tokens_per_sec_per_chip"
+                   if int8 else "gpt_small_decode_tokens_per_sec_per_chip"),
         "value": round(toks_per_s, 1),
         "unit": "decoded tokens/s/chip",
         "step_ms": round(dt * 1e3, 2),

@@ -121,9 +121,10 @@ class BertForPretraining(nn.Module):
         hm = h if mask_positions is None else jnp.take_along_axis(
             h, mask_positions[..., None], axis=1)
         mlm_h = self.mlm_ln(self.mlm_transform(hm))
-        # weight tying with token embedding (standard BERT)
-        emb = self.encoder.tok_emb.p("weight")
-        mlm_logits = mlm_h @ emb.T + self.p("mlm_bias")
+        # weight tying with token embedding (standard BERT); int8-table
+        # aware (nn.tied_vocab_head) for weight-only serving
+        mlm_logits = (nn.tied_vocab_head(self.encoder.tok_emb, mlm_h)
+                      + self.p("mlm_bias"))
         pooled = self.pooler(h[:, 0])
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits

@@ -116,7 +116,7 @@ class GPT(nn.Module):
         for blk in self.blocks:
             x = blk(x)
         x = self.ln_f(x)
-        return x @ self.tok_emb.p("weight").T
+        return nn.tied_vocab_head(self.tok_emb, x)
 
 
 def lm_loss(logits, labels, pad_id=None):
@@ -144,7 +144,7 @@ def _gpt_decode_step(model, token, caches, pos):
         x, cache = blk.decode_step(x, cache, pos)
         new_caches.append(cache)
     x = model.ln_f(x)
-    return x @ model.tok_emb.p("weight").T, new_caches
+    return nn.tied_vocab_head(model.tok_emb, x), new_caches
 
 
 class GPTDecoder(GPT):

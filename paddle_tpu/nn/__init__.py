@@ -30,6 +30,7 @@ from paddle_tpu.nn.layers import (
     SpectralNorm,
     SyncBatchNorm,
     TreeConv,
+    tied_vocab_head,
 )
 
 from paddle_tpu.nn.heads import MultiBoxHead

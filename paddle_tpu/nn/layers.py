@@ -37,7 +37,18 @@ class Linear(Module):
             self.param("bias", (out_features,), bias_init or I.zeros(), dtype)
 
     def forward(self, x):
-        out = x @ self.p("weight")
+        if self.has_p("weight_q"):
+            # weight-only int8 serving (quant.weight_only): the kernel
+            # stays int8 in HBM and the mixed-dtype dot reads it directly
+            # (1/2 the bf16 bytes, 1/4 of f32) — per-output-channel scale
+            # applied on the dot OUTPUT, exact: x@(q*s) == (x@q)*s
+            wq = self.p("weight_q")
+            out = jax.lax.dot_general(
+                x, wq, (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=x.dtype)
+            out = out * self.p("weight_scale").astype(x.dtype)
+        else:
+            out = x @ self.p("weight")
         if self.has_bias:
             out = out + self.p("bias")
         return _act(self.act, out)
@@ -241,7 +252,32 @@ class Embedding(Module):
                    weight_init or I.normal(0.0, 0.02), dtype)
 
     def forward(self, ids):
+        if self.has_p("weight_q"):
+            # weight-only int8 table (per-ROW scale, axis 0): gather the
+            # int8 rows from HBM, dequantize the gathered slice only.
+            # The scale carries the original table dtype, so a bf16
+            # model's activation path stays bf16.
+            rows = F.lookup_table(ids, self.p("weight_q"), self.padding_idx)
+            s = self.p("weight_scale")
+            idx = (jnp.squeeze(ids, -1)
+                   if ids.ndim > 1 and ids.shape[-1] == 1 else ids)
+            return rows.astype(s.dtype) * jnp.take(s, idx, axis=0)[..., None]
         return F.lookup_table(ids, self.p("weight"), self.padding_idx)
+
+
+def tied_vocab_head(emb, x):
+    """Weight-tied vocab projection x @ W.T over an Embedding's table
+    (BERT/GPT heads). With a weight-only int8 table (quant.weight_only:
+    per-row scale) the dot reads the int8 table directly and the row
+    scale lands on the logit axis — exact:
+    x @ (q*s[:,None]).T == (x @ q.T) * s[None,:]."""
+    if emb.has_p("weight_q"):
+        wq = emb.p("weight_q")
+        logits = jax.lax.dot_general(
+            x, wq, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=x.dtype)
+        return logits * emb.p("weight_scale").astype(x.dtype)
+    return x @ emb.p("weight").T
 
 
 class Dropout(Module):

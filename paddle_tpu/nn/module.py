@@ -143,6 +143,13 @@ class Module:
         scope = _CURRENT.scopes[id(self)]
         return scope["params"][name]
 
+    def has_p(self, name):
+        """True when the bound params dict carries `name` — lets layers
+        accept transformed parameter layouts (e.g. weight-only int8
+        serving replaces 'weight' with 'weight_q' + 'weight_scale')."""
+        scope = _CURRENT.scopes[id(self)]
+        return name in scope["params"]
+
     def s(self, name):
         """Fetch own state value (latest update if already written)."""
         scope = _CURRENT.scopes[id(self)]

@@ -15,9 +15,10 @@ from paddle_tpu.quant.ptq import (calibrate, export_int8, freeze,
 from paddle_tpu.quant.qat import (QuantConfig, QuantizedConv2D,
                                   QuantizedLinear, quantize_model,
                                   upgrade_variables)
+from paddle_tpu.quant.weight_only import quantize_weights_int8
 
 __all__ = [
-    "ops", "ptq", "qat", "QuantConfig", "QuantizedConv2D", "QuantizedLinear",
+    "ops", "ptq", "qat", "quantize_weights_int8", "QuantConfig", "QuantizedConv2D", "QuantizedLinear",
     "quantize_model", "upgrade_variables", "calibrate", "export_int8",
     "freeze", "int8_linear", "save_int8_inference_model",
     "fake_quant_abs_max", "fake_quant_dequant",

@@ -279,3 +279,110 @@ class TestInt8Serving:
         ref = np.asarray(_TinyNet().apply(
             {"params": frozen["params"], "state": {}}, x))
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+class TestWeightOnlyInt8:
+    """quant.quantize_weights_int8 — int8-resident serving weights
+    consumed by mixed-dtype dots (nn/layers.py Linear/Embedding, the GPT
+    tied head). Ref: ConvertToInt8Pass writes real int8 weights into the
+    serving program (quantization_pass.py:764)."""
+
+    def test_linear_exact_dequant_identity(self):
+        """(x @ q) * s must equal x @ (q * s) — the per-out-column scale
+        commutes with the contraction, so the int8 path's only error is
+        weight rounding, identical to explicit dequantization."""
+        rs = np.random.RandomState(0)
+        lin = L.Linear(32, 16)
+        v = lin.init(jax.random.key(0))
+        x = jnp.asarray(rs.randn(4, 32), jnp.float32)
+        qp = quant.quantize_weights_int8(lin, v["params"], min_size=1)
+        assert qp["weight_q"].dtype == jnp.int8
+        got = lin.apply({"params": qp, "state": {}}, x)
+        wd = (qp["weight_q"].astype(np.float32)
+              * np.asarray(qp["weight_scale"])[None, :])
+        ref = x @ wd + v["params"]["bias"]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # rounding error vs the float weight is bounded by the int8 step
+        step = np.abs(np.asarray(v["params"]["weight"])).max(0) / 127.0
+        assert np.all(np.abs(wd - np.asarray(v["params"]["weight"]))
+                      <= step[None, :] * 0.5 + 1e-7)
+
+    def test_min_size_keeps_small_layers_float(self):
+        lin = L.Linear(4, 4)
+        v = lin.init(jax.random.key(0))
+        qp = quant.quantize_weights_int8(lin, v["params"], min_size=4096)
+        assert "weight" in qp and "weight_q" not in qp
+
+    def test_gpt_decode_int8_matches_float(self):
+        """End-to-end: GPT decode with int8-resident weights — logits
+        within ~2% and identical greedy continuations (the bench.py
+        PT_BENCH_INT8_DECODE path)."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        model = GPTDecoder(cfg)
+        v = model.init(jax.random.key(0))
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (2, 16),
+                                     dtype=np.int32))
+        logits_f = model.apply({"params": v["params"], "state": {}}, ids)
+        qp = quant.quantize_weights_int8(model, v["params"], min_size=16)
+        logits_q = model.apply({"params": qp, "state": {}}, ids)
+        rel = float(jnp.max(jnp.abs(logits_q - logits_f))
+                    / jnp.max(jnp.abs(logits_f)))
+        assert rel < 0.05, rel
+        gen = jax.jit(lambda p, x: model.apply(
+            {"params": p, "state": {}}, x, 8, method="generate"))
+        of = gen(v["params"], ids[:, :4])
+        oq = gen(qp, ids[:, :4])
+        np.testing.assert_array_equal(np.asarray(of), np.asarray(oq))
+
+    def test_bert_tied_head_and_bf16_dtype(self):
+        """BERT's weight-tied MLM head must serve int8 tables
+        (nn.tied_vocab_head), and a bf16 model must stay bf16 after
+        quantization (the scale carries the table dtype)."""
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+        cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position=64)
+        cfg.dropout = 0.0
+        model = BertForPretraining(cfg)
+        v = model.init(jax.random.key(0))
+        rs = np.random.RandomState(0)
+        ids = jnp.asarray(rs.randint(0, 128, (2, 16), dtype=np.int32))
+        mlm_f, nsp_f = model.apply({"params": v["params"], "state": {}}, ids)
+        qp = quant.quantize_weights_int8(model, v["params"], min_size=16)
+        mlm_q, nsp_q = model.apply({"params": qp, "state": {}}, ids)
+        rel = float(jnp.max(jnp.abs(mlm_q - mlm_f))
+                    / jnp.max(jnp.abs(mlm_f)))
+        assert rel < 0.1, rel
+        # bf16 embedding stays bf16 through the quantized lookup
+        emb = L.Embedding(64, 8)
+        vb = emb.init(jax.random.key(2), dtype=jnp.bfloat16)
+        qb = quant.quantize_weights_int8(emb, vb["params"], min_size=1)
+        out = emb.apply({"params": qb, "state": {}}, jnp.asarray([[1, 2]]))
+        assert out.dtype == jnp.bfloat16, out.dtype
+
+    def test_subclass_layers_left_alone(self):
+        """FC/QuantizedLinear override forward() with p('weight') reads —
+        the transform must not touch them (exact-type targeting)."""
+        fc = L.FC(16, 8)
+        v = fc.init(jax.random.key(0))
+        qp = quant.quantize_weights_int8(fc, v["params"], min_size=1)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(fc.apply({"params": qp, "state": {}}, x)),
+            np.asarray(fc.apply({"params": v["params"], "state": {}}, x)))
+
+    def test_embedding_padding_idx_stays_zero(self):
+        emb = L.Embedding(64, 8, padding_idx=0)
+        v = emb.init(jax.random.key(1))
+        qp = quant.quantize_weights_int8(emb, v["params"], min_size=1)
+        ids = jnp.asarray([[0, 3, 0, 5]])
+        out = emb.apply({"params": qp, "state": {}}, ids)
+        np.testing.assert_allclose(np.asarray(out)[0, 0], 0.0)
+        np.testing.assert_allclose(np.asarray(out)[0, 2], 0.0)
+        ref = emb.apply({"params": v["params"], "state": {}}, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0.05, atol=0.02)

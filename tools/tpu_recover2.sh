@@ -65,6 +65,7 @@ row resnet50_bf16v  env PT_BENCH_BF16_VELOCITY=1 python bench.py --model resnet5
 row resnet50_novjp  env PT_FLAGS_conv_custom_vjp=0 python bench.py --model resnet50 --steps 10
 row gpt2048         python bench.py --model gpt --steps 10 --seq 2048 --batch 4
 row gpt_decode      python bench.py --model gpt_decode --steps 3 --batch 16
+row gpt_decode_int8 env PT_BENCH_INT8_DECODE=1 python bench.py --model gpt_decode --steps 3 --batch 16
 # per-fusion profile of the flagship row: the 0.43->0.45+ BERT tail attack
 # needs to know where the non-flash milliseconds live
 row bert_profile    env PT_BENCH_PROFILE=/tmp/pt_bert_prof python bench.py --model bert --steps 10

@@ -20,6 +20,10 @@ def main():
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve with weight-only int8 weights "
+                         "(quant.quantize_weights_int8 — the weights stay "
+                         "int8 in HBM, halving per-token weight reads)")
     args = ap.parse_args()
 
     import jax
@@ -32,6 +36,11 @@ def main():
     cfg.use_flash = False
     model = GPTDecoder(cfg)
     v = model.init(jax.random.key(0))
+    if args.int8:
+        from paddle_tpu.quant import quantize_weights_int8
+        v = {"params": quantize_weights_int8(model, v["params"],
+                                             min_size=16),
+             "state": v.get("state", {})}
 
     prompt = jnp.asarray(np.random.RandomState(0).randint(
         0, cfg.vocab_size, (1, args.prompt_len), dtype=np.int32))

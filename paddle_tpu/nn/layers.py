@@ -256,11 +256,13 @@ class Embedding(Module):
             # weight-only int8 table (per-ROW scale, axis 0): gather the
             # int8 rows from HBM, dequantize the gathered slice only.
             # The scale carries the original table dtype, so a bf16
-            # model's activation path stays bf16.
-            rows = F.lookup_table(ids, self.p("weight_q"), self.padding_idx)
-            s = self.p("weight_scale")
+            # model's activation path stays bf16. ids are normalized ONCE
+            # (lookup_table's trailing-1 squeeze) so the row gather and
+            # the scale gather can never disagree on indexing.
             idx = (jnp.squeeze(ids, -1)
                    if ids.ndim > 1 and ids.shape[-1] == 1 else ids)
+            rows = F.lookup_table(idx, self.p("weight_q"), self.padding_idx)
+            s = self.p("weight_scale")
             return rows.astype(s.dtype) * jnp.take(s, idx, axis=0)[..., None]
         return F.lookup_table(ids, self.p("weight"), self.padding_idx)
 
@@ -478,12 +480,21 @@ class MultiHeadAttention(Module):
             if bias:
                 self.param(f"b{n}", (embed_dim,), I.zeros(), dtype)
 
+    def _w(self, n):
+        """Projection kernel, dequantized if weight-only int8 (the full
+        forward runs once per sequence, so a materialized dequant is
+        fine; decode_step keeps the int8-resident mixed-dot path)."""
+        if self.has_p(f"w{n}_q"):
+            q, s = self.p(f"w{n}_q"), self.p(f"w{n}_scale")
+            return q.astype(s.dtype) * s[None, :]
+        return self.p(f"w{n}")
+
     def forward(self, x, kv=None, mask=None, causal=False, seq_axis=None):
         from paddle_tpu.ops.attention import multihead_attention
         key = self.rng("dropout") if (self.training and self.dropout_rate > 0) \
             else None
         return multihead_attention(
-            x, self.p("wq"), self.p("wk"), self.p("wv"), self.p("wo"),
+            x, self._w("q"), self._w("k"), self._w("v"), self._w("o"),
             self.p("bq") if self.has_bias else None,
             self.p("bk") if self.has_bias else None,
             self.p("bv") if self.has_bias else None,
@@ -494,7 +505,8 @@ class MultiHeadAttention(Module):
 
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         """KV cache for incremental decoding: {k, v} [B, H, Tmax, hd]."""
-        e = self.p("wq").shape[0]
+        e = (self.p("wq_q") if self.has_p("wq_q")
+             else self.p("wq")).shape[0]
         hd = e // self.num_heads
         shape = (batch, self.num_heads, max_len, hd)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -513,8 +525,16 @@ class MultiHeadAttention(Module):
         hd = e // self.num_heads
 
         def proj(n):
-            w = self.p(f"w{n}")
-            out = x_t @ w
+            if self.has_p(f"w{n}_q"):
+                # int8-resident projection (quant.weight_only): the mixed
+                # dot reads the int8 kernel straight from HBM every step
+                wq = self.p(f"w{n}_q")
+                out = _lax.dot_general(
+                    x_t, wq, (((x_t.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=x_t.dtype)
+                out = out * self.p(f"w{n}_scale").astype(x_t.dtype)
+            else:
+                out = x_t @ self.p(f"w{n}")
             if self.has_bias:
                 out = out + self.p(f"b{n}")
             return out.reshape(b, 1, self.num_heads, hd).transpose(
@@ -533,7 +553,13 @@ class MultiHeadAttention(Module):
             scores, axis=-1, keepdims=True))
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, e)
-        out = ctx @ self.p("wo")
+        if self.has_p("wo_q"):
+            out = _lax.dot_general(
+                ctx, self.p("wo_q"), (((2,), (0,)), ((), ())),
+                preferred_element_type=ctx.dtype)
+            out = out * self.p("wo_scale").astype(ctx.dtype)
+        else:
+            out = ctx @ self.p("wo")
         if self.has_bias:
             out = out + self.p("bo")
         return out, {"k": k, "v": v}

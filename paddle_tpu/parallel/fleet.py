@@ -32,6 +32,8 @@ class DistributedStrategy:
     fsdp: int = 1                # param-sharded data parallel
     tp: int = 1                  # tensor parallel
     pp: int = 1                  # pipeline stages
+    pp_schedule: str = "gpipe"   # "gpipe" | "1f1b" | "interleaved"
+    pp_chunks: int = 1           # virtual chunks/device (interleaved)
     sp: int = 1                  # sequence/context parallel
     ep: int = 1                  # embedding/expert shards
     amp: bool = False            # bf16 mixed precision
@@ -52,6 +54,12 @@ class DistributedStrategy:
             if size == -1 or size > 1:
                 axes[name] = size
         return axes or {"dp": -1}
+
+    def pipeline_kwargs(self):
+        """kwargs for parallel.pipeline.make_pipeline_train_step matching
+        this strategy's pipeline schedule (ref: PipelineOptimizer config +
+        section_worker concurrency knobs)."""
+        return {"schedule": self.pp_schedule, "num_chunks": self.pp_chunks}
 
 
 class Fleet:

@@ -375,6 +375,12 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
         chunk per tick (the reference's many-sections-per-device
         concurrency, pipeline_trainer.cc). Same loss_fn contract.
     """
+    if num_chunks != 1 and schedule != "interleaved":
+        raise ValueError(
+            f"num_chunks={num_chunks} only applies to "
+            f"schedule='interleaved' (got {schedule!r}) — a silently "
+            "ignored chunk count would misrepresent the configured "
+            "parallelism")
     pspec = P(axis_name)
     if schedule in ("1f1b", "interleaved"):
         if schedule == "interleaved":

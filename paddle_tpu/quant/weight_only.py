@@ -18,6 +18,9 @@ once per token; see bench.py gpt_decode).
 Scale axes are chosen so the dequant is algebraically EXACT on the
 consuming contraction (no fake-quant round trip at serve time):
   * Linear [in, out]  -> per-out-column scale: x@(q*s) == (x@q)*s
+  * MultiHeadAttention wq/wk/wv/wo [E, E] -> per-out-column (same rule;
+    the decode_step projections consume them int8-resident, the full
+    forward dequantizes once per call)
   * Embedding [vocab, dim] -> per-row scale: works for both the lookup
     (rows[ids]*s[ids]) and the weight-tied head (x@(q*s[:,None]).T ==
     (x@q.T)*s[None,:]) — one table serves both consumers.
@@ -61,15 +64,19 @@ def quantize_weights_int8(model, params, include_embeddings=True,
     most accuracy). Biases, norms, and everything else pass through
     untouched. The returned tree serves directly through model.apply —
     no architecture changes, no recompile of the float path."""
+    # per-module map: param name -> quantization channel axis. Exact types
+    # only: subclasses (FC, QuantizedLinear) override forward() with
+    # p("weight") reads that do not understand the int8 layout.
     targets = {}
     for path, mod in _module_paths(model):
-        # exact types only: subclasses (FC, QuantizedLinear) override
-        # forward() with p("weight") reads that do not understand the
-        # int8 layout — quantizing them would fail at serve time
         if type(mod) is L.Linear:
-            targets[path] = 1          # [in, out] -> per-out-column
+            targets[path] = {"weight": 1}   # [in, out] -> per-out-column
+        elif type(mod) is L.MultiHeadAttention:
+            # the four projection kernels [E, E] — a third of a
+            # transformer block's weight bytes, read every decode step
+            targets[path] = {f"w{n}": 1 for n in ("q", "k", "v", "o")}
         elif include_embeddings and type(mod) is L.Embedding:
-            targets[path] = 0          # [vocab, dim] -> per-row
+            targets[path] = {"weight": 0}   # [vocab, dim] -> per-row
 
     def walk(node, path=()):
         if not isinstance(node, dict):
@@ -77,12 +84,12 @@ def quantize_weights_int8(model, params, include_embeddings=True,
         out = {}
         for k, v in node.items():
             p = path + (k,)
-            if (k == "weight" and path in targets
-                    and hasattr(v, "size") and v.size >= min_size
-                    and getattr(v, "ndim", 0) == 2):
-                q, s = _q8(v, targets[path])
-                out["weight_q"] = q
-                out["weight_scale"] = s
+            axis = targets.get(path, {}).get(k)
+            if (axis is not None and hasattr(v, "size")
+                    and v.size >= min_size and getattr(v, "ndim", 0) == 2):
+                q, s = _q8(v, axis)
+                out[f"{k}_q"] = q
+                out[f"{k}_scale"] = s
             else:
                 out[k] = walk(v, p)
         return out

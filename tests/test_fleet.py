@@ -40,6 +40,29 @@ class TestDistributedStrategy:
         mesh = fleet.build_mesh(DistributedStrategy())
         assert mesh.shape["dp"] == 8
 
+    def test_pipeline_kwargs_feed_train_step(self):
+        """pp_schedule/pp_chunks plumb straight into
+        make_pipeline_train_step (ref PipelineOptimizer config)."""
+        from paddle_tpu.parallel.pipeline import (
+            interleave_stage_params, make_pipeline_train_step,
+            stack_stage_params)
+        s = DistributedStrategy(pp=8, pp_schedule="interleaved",
+                                pp_chunks=2)
+        assert s.pipeline_kwargs() == {"schedule": "interleaved",
+                                       "num_chunks": 2}
+        mesh = fleet.build_mesh(s)
+        stacked = stack_stage_params(
+            [{"w": jnp.eye(4) * 0.5} for _ in range(16)])
+        opt = pt.optimizer.SGD(0.1)
+        step = make_pipeline_train_step(
+            mesh, lambda p, h: jnp.tanh(h @ p["w"]),
+            lambda o, y: jnp.mean((o - y) ** 2), opt, "pp",
+            **s.pipeline_kwargs())
+        params = interleave_stage_params(stacked, 8, 2)
+        x = jnp.ones((4, 2, 4)) * 0.1
+        loss, params, _ = jax.jit(step)(params, opt.init(params), x, x)
+        assert np.isfinite(float(loss))
+
     def test_exclusive_schedules_rejected(self):
         s = DistributedStrategy(local_sgd_steps=2, geo_sgd_steps=2)
         with pytest.raises(Exception):

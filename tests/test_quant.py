@@ -332,6 +332,12 @@ class TestWeightOnlyInt8:
         rel = float(jnp.max(jnp.abs(logits_q - logits_f))
                     / jnp.max(jnp.abs(logits_f)))
         assert rel < 0.05, rel
+        # coverage: FFN Linears AND the 4 attention projections per block
+        # AND both embeddings must be int8 (a silent skip of the attention
+        # kernels would fake the decode row's bandwidth story)
+        n_int8 = sum(1 for l in jax.tree_util.tree_leaves(qp)
+                     if l.dtype == jnp.int8)
+        assert n_int8 == 2 * cfg.num_layers + 4 * cfg.num_layers + 2, n_int8
         gen = jax.jit(lambda p, x: model.apply(
             {"params": p, "state": {}}, x, 8, method="generate"))
         of = gen(v["params"], ids[:, :4])

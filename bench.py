@@ -300,9 +300,18 @@ def bench_gpt_decode(steps, batch, seq):
     prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len),
                                      dtype=np.int32))
 
+    # TPU-first serving defaults: batched prefill + bf16 KV cache (the
+    # padded cache reads dominate per-token HBM traffic at serving batch
+    # sizes). PT_BENCH_CACHE_F32=1 restores the f32 cache for A/B.
+    cache_dtype = (jnp.float32
+                   if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
+                   else jnp.bfloat16)
+
     def decode(p, prompt):
-        return model.apply({"params": p, "state": {}}, prompt, max_new,
-                           method="generate")
+        return model.apply(
+            {"params": p, "state": {}}, prompt,
+            method=lambda pr: model.generate(pr, max_new,
+                                             cache_dtype=cache_dtype))
 
     jitted = jax.jit(decode)
     if COMPILE_ONLY:

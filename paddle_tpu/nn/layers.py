@@ -489,6 +489,64 @@ class MultiHeadAttention(Module):
             return q.astype(s.dtype) * s[None, :]
         return self.p(f"w{n}")
 
+    def _project(self, x, n):
+        """x @ w{n} (+ bias) over the last axis; consumes int8-resident
+        kernels via a mixed-dtype dot when weight-only quantized."""
+        from jax import lax as _lax
+        if self.has_p(f"w{n}_q"):
+            out = _lax.dot_general(
+                x, self.p(f"w{n}_q"), (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=x.dtype)
+            out = out * self.p(f"w{n}_scale").astype(x.dtype)
+        else:
+            out = x @ self.p(f"w{n}")
+        if self.has_bias:
+            out = out + self.p(f"b{n}")
+        return out
+
+    def prefill(self, x, cache, start=0):
+        """Batched cache fill: project the WHOLE prompt in one pass,
+        write its K/V into the cache at [0, T), and return the causal
+        self-attention output — one forward instead of T sequential
+        decode_steps (the serving prefill/decode split; no reference
+        counterpart: Fluid's decoders re-ran the network per step).
+        x: [B, T, E] -> (out [B, T, E], new_cache). Long prompts ride
+        the Pallas flash kernel when use_flash is set (O(T) memory,
+        like forward)."""
+        from jax import lax as _lax
+        if start != 0:
+            # chunked prefill would need attention over the cached prefix
+            # plus a shifted causal mask — not implemented; failing loudly
+            # beats silently ignoring the prefix
+            raise NotImplementedError(
+                "MultiHeadAttention.prefill only supports start=0 "
+                "(whole-prompt prefill); decode_step handles the rest")
+        b, t, e = x.shape
+        hd = e // self.num_heads
+
+        def heads(y):
+            return y.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
+
+        q = heads(self._project(x, "q"))
+        k = heads(self._project(x, "k"))
+        v = heads(self._project(x, "v"))
+        cache = {
+            "k": _lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, 0, 0, 0)),
+            "v": _lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, 0, 0, 0)),
+        }
+        if self.use_flash:
+            from paddle_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            ctx = flash_attention(q, k, v, causal=True)
+        else:
+            from paddle_tpu.ops.attention import \
+                scaled_dot_product_attention
+            ctx = scaled_dot_product_attention(q, k, v, causal=True)
+        out = ctx.transpose(0, 2, 1, 3).reshape(b, t, e)
+        return self._project(out, "o"), cache
+
     def forward(self, x, kv=None, mask=None, causal=False, seq_axis=None):
         from paddle_tpu.ops.attention import multihead_attention
         key = self.rng("dropout") if (self.training and self.dropout_rate > 0) \
@@ -525,19 +583,8 @@ class MultiHeadAttention(Module):
         hd = e // self.num_heads
 
         def proj(n):
-            if self.has_p(f"w{n}_q"):
-                # int8-resident projection (quant.weight_only): the mixed
-                # dot reads the int8 kernel straight from HBM every step
-                wq = self.p(f"w{n}_q")
-                out = _lax.dot_general(
-                    x_t, wq, (((x_t.ndim - 1,), (0,)), ((), ())),
-                    preferred_element_type=x_t.dtype)
-                out = out * self.p(f"w{n}_scale").astype(x_t.dtype)
-            else:
-                out = x_t @ self.p(f"w{n}")
-            if self.has_bias:
-                out = out + self.p(f"b{n}")
-            return out.reshape(b, 1, self.num_heads, hd).transpose(
+            return self._project(x_t, n).reshape(
+                b, 1, self.num_heads, hd).transpose(
                 0, 2, 1, 3)                            # [B, H, 1, hd]
 
         q = proj("q")
@@ -553,16 +600,7 @@ class MultiHeadAttention(Module):
             scores, axis=-1, keepdims=True))
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, e)
-        if self.has_p("wo_q"):
-            out = _lax.dot_general(
-                ctx, self.p("wo_q"), (((2,), (0,)), ((), ())),
-                preferred_element_type=ctx.dtype)
-            out = out * self.p("wo_scale").astype(ctx.dtype)
-        else:
-            out = ctx @ self.p("wo")
-        if self.has_bias:
-            out = out + self.p("bo")
-        return out, {"k": k, "v": v}
+        return self._project(ctx, "o"), {"k": k, "v": v}
 
 
 class FC(Linear):

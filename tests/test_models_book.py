@@ -459,6 +459,51 @@ class TestGPT:
         np.testing.assert_allclose(np.asarray(inc), np.asarray(full),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_batched_prefill_matches_stepwise(self):
+        """generate()'s one-pass prompt prefill must leave the caches and
+        last logits exactly as Tp sequential decode_steps would (the
+        serving prefill/decode split)."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        cfg.use_flash = False
+        model = GPTDecoder(cfg)
+        v = model.init(jax.random.key(0))
+        prompt = jnp.asarray(np.random.RandomState(5).randint(
+            0, cfg.vocab_size, (2, 10), dtype=np.int32))
+
+        def batched(pr):
+            caches = model.init_caches(2, 10)
+            x = (model.tok_emb(pr)
+                 + model.pos_emb(jnp.arange(10)[None, :]))
+            new = []
+            for blk, c in zip(model.blocks, caches):
+                x, c = blk.prefill(x, c)
+                new.append(c)
+            return x, new
+
+        def stepwise(pr):
+            caches = model.init_caches(2, 10)
+            for t in range(10):
+                _, caches = model.decode_step(pr[:, t:t + 1], caches, t)
+            return caches
+
+        _, cb = model.apply(v, prompt, method=batched)
+        cs = model.apply(v, prompt, method=stepwise)
+        for a, b in zip(cb, cs):
+            np.testing.assert_allclose(np.asarray(a["k"]),
+                                       np.asarray(b["k"]),
+                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(np.asarray(a["v"]),
+                                       np.asarray(b["v"]),
+                                       rtol=2e-4, atol=2e-4)
+        # bf16 cache generation agrees with f32 on the greedy tokens
+        o32 = model.apply(v, prompt, method=lambda p_: model.generate(
+            p_, max_new=6))
+        o16 = model.apply(v, prompt, method=lambda p_: model.generate(
+            p_, max_new=6, cache_dtype=jnp.bfloat16))
+        assert float(np.mean(np.asarray(o16) == np.asarray(o32))) > 0.9
+
     def test_greedy_generate_matches_argmax_forwards(self):
         from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
         cfg = GPTConfig.tiny()

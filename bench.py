@@ -343,11 +343,11 @@ def bench_gpt_decode(steps, batch, seq):
     param_bytes = sum(
         l.size * l.dtype.itemsize
         for l in jax.tree_util.tree_leaves(variables["params"]))
-    cache_bytes = sum(
-        l.size * l.dtype.itemsize
-        for l in jax.tree_util.tree_leaves(jax.eval_shape(
-            lambda: model.apply(variables, batch, prompt_len + max_new,
-                                cache_dtype, method="init_caches"))))
+    # analytic (eval_shape over a nullary closure would still allocate:
+    # only *arguments* are abstracted): K + V per layer, padded length
+    cache_bytes = (model.cfg.num_layers * 2 * batch
+                   * (prompt_len + max_new) * model.cfg.hidden_size
+                   * jnp.dtype(cache_dtype).itemsize)
     hbm_util = (max_new * (param_bytes + cache_bytes)) / dt / 819e9
     return {
         "metric": ("gpt_small_decode_int8_tokens_per_sec_per_chip"

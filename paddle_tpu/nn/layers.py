@@ -23,6 +23,17 @@ def _act(name, x):
     return getattr(A, name)(x)
 
 
+def _int8_dot(x, q, scale, rhs_axis=0):
+    """x contracted with an int8-resident kernel over x's last axis and
+    q's rhs_axis, per-channel scale applied on the output — the one
+    mixed-dtype dot all weight-only consumers share (quant.weight_only:
+    exact because the scale axis is the non-contracted one)."""
+    out = jax.lax.dot_general(
+        x, q, (((x.ndim - 1,), (rhs_axis,)), ((), ())),
+        preferred_element_type=x.dtype)
+    return out * scale.astype(x.dtype)
+
+
 class Linear(Module):
     """ref: dygraph/nn.py FC / Linear."""
 
@@ -40,13 +51,8 @@ class Linear(Module):
         if self.has_p("weight_q"):
             # weight-only int8 serving (quant.weight_only): the kernel
             # stays int8 in HBM and the mixed-dtype dot reads it directly
-            # (1/2 the bf16 bytes, 1/4 of f32) — per-output-channel scale
-            # applied on the dot OUTPUT, exact: x@(q*s) == (x@q)*s
-            wq = self.p("weight_q")
-            out = jax.lax.dot_general(
-                x, wq, (((x.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=x.dtype)
-            out = out * self.p("weight_scale").astype(x.dtype)
+            # (1/2 the bf16 bytes, 1/4 of f32)
+            out = _int8_dot(x, self.p("weight_q"), self.p("weight_scale"))
         else:
             out = x @ self.p("weight")
         if self.has_bias:
@@ -274,11 +280,8 @@ def tied_vocab_head(emb, x):
     scale lands on the logit axis — exact:
     x @ (q*s[:,None]).T == (x @ q.T) * s[None,:]."""
     if emb.has_p("weight_q"):
-        wq = emb.p("weight_q")
-        logits = jax.lax.dot_general(
-            x, wq, (((x.ndim - 1,), (1,)), ((), ())),
-            preferred_element_type=x.dtype)
-        return logits * emb.p("weight_scale").astype(x.dtype)
+        return _int8_dot(x, emb.p("weight_q"), emb.p("weight_scale"),
+                         rhs_axis=1)
     return x @ emb.p("weight").T
 
 
@@ -491,13 +494,10 @@ class MultiHeadAttention(Module):
 
     def _project(self, x, n):
         """x @ w{n} (+ bias) over the last axis; consumes int8-resident
-        kernels via a mixed-dtype dot when weight-only quantized."""
-        from jax import lax as _lax
+        kernels via the shared mixed-dtype dot when weight-only
+        quantized."""
         if self.has_p(f"w{n}_q"):
-            out = _lax.dot_general(
-                x, self.p(f"w{n}_q"), (((x.ndim - 1,), (0,)), ((), ())),
-                preferred_element_type=x.dtype)
-            out = out * self.p(f"w{n}_scale").astype(x.dtype)
+            out = _int8_dot(x, self.p(f"w{n}_q"), self.p(f"w{n}_scale"))
         else:
             out = x @ self.p(f"w{n}")
         if self.has_bias:

@@ -634,8 +634,10 @@ def _captured_fallback(model):
     an honest last-known-good beats an empty bench_failed artifact, and the
     driver's BENCH file then records where the number came from."""
     import glob
-    cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "tools", "captured")
+    cap = os.environ.get(
+        "PT_BENCH_CAPTURED_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tools", "captured"))
     name = "bert" if model == "all" else model  # suite -> flagship row
     # only the exact row, then its window-tagged seeds (<name>_w*.json) —
     # a prefix glob would serve e.g. resnet50_s2d's flagged config (or

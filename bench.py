@@ -729,11 +729,13 @@ def _run_suite(args, deadline):
     if args.compile_only:
         extra += ["--compile-only"]
     rows = {}
+    timed_out = False  # wedge-shaped failure (hang), vs crash-shaped
     for model in _suite_list():
         remaining = deadline - time.monotonic()
         if remaining < 60:
             print(f"suite: wall budget exhausted before {model}",
                   file=sys.stderr)
+            timed_out = True
             break
         try:
             proc = subprocess.run(
@@ -743,6 +745,7 @@ def _run_suite(args, deadline):
                 timeout=min(per_model_cap, remaining - 10))
         except subprocess.TimeoutExpired:
             print(f"suite: {model} timed out", file=sys.stderr)
+            timed_out = True
             continue
         res = None
         for line in reversed(proc.stdout.strip().splitlines()):
@@ -761,9 +764,20 @@ def _run_suite(args, deadline):
         rows[model] = res
         print(json.dumps(res), flush=True)
     if not rows:
-        print(json.dumps({
-            "metric": "bench_failed", "value": 0.0, "unit": "error",
-            "vs_baseline": 0.0, "error": "no suite row completed"}))
+        # same last-known-good contract as the single-model path: ONLY a
+        # wedge-shaped failure (children hang / wall exhausted after the
+        # probe passed) serves the captured flagship row — a crash with
+        # a live tunnel is a code regression and must stay bench_failed
+        cached = _captured_fallback("all") if timed_out else None
+        if cached is not None:
+            cached["suite_error"] = "no suite row completed"
+            cached["note"] = (cached.get("note", "") +
+                              " (probe passed; suite children timed out)")
+            print(json.dumps(_tag_cached(cached, args)))
+        else:
+            print(json.dumps({
+                "metric": "bench_failed", "value": 0.0, "unit": "error",
+                "vs_baseline": 0.0, "error": "no suite row completed"}))
         return
     flag = rows.get("bert") or next(iter(rows.values()))
     summary = dict(flag)

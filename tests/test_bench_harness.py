@@ -112,3 +112,33 @@ class TestDriverPaths:
                          timeout=420)
         assert row["metric"] == "ctr_compile_only"
         assert row["unit"] == "compiled" and row["compile_s"] >= 0
+
+    def test_suite_wedge_after_probe_uses_cached_flagship(self, tmp_path):
+        """Suite mode, probe alive, children HANG past their cap (the
+        genuine wedge shape): emit the captured flagship row, marked
+        with the suite failure. A tiny PT_BENCH_TIMEOUT makes the ctr
+        child's jax import + compile overrun its cap for real."""
+        seed = {"metric": "bert_base_tokens_per_sec_per_chip",
+                "value": 9.0, "unit": "x", "vs_baseline": 0.5}
+        (tmp_path / "bert.json").write_text(json.dumps(seed))
+        row = _run_bench(
+            ["--model", "all"],
+            {"PT_BENCH_WALL": "120", "PT_BENCH_TIMEOUT": "3",
+             "PT_BENCH_SUITE": "ctr",
+             "PT_BENCH_CAPTURED_DIR": str(tmp_path)}, timeout=300)
+        assert row["cached"] is True and row["value"] == 9.0
+        assert row["suite_error"] == "no suite row completed"
+        assert "suite children timed out" in row["note"]
+
+    def test_suite_crash_with_live_backend_stays_bench_failed(self, tmp_path):
+        """Suite children CRASHING (rc!=0, no hang) with a live backend
+        is a code regression: bench_failed, never a cached number."""
+        seed = {"metric": "bert_base_tokens_per_sec_per_chip",
+                "value": 9.0, "unit": "x", "vs_baseline": 0.5}
+        (tmp_path / "bert.json").write_text(json.dumps(seed))
+        row = _run_bench(
+            ["--model", "all"],
+            {"PT_BENCH_FORCE_FAIL": "1", "PT_BENCH_WALL": "120",
+             "PT_BENCH_TIMEOUT": "60", "PT_BENCH_SUITE": "ctr",
+             "PT_BENCH_CAPTURED_DIR": str(tmp_path)}, timeout=300)
+        assert row["metric"] == "bench_failed"

@@ -1,0 +1,48 @@
+"""Render tools/captured/*.json into a markdown table for BASELINE.md.
+
+Usage: python tools/captured_report.py
+Prints one table row per captured bench row (plus tool markers), newest
+last — paste into BASELINE.md after a silicon window, or just read it.
+"""
+
+import glob
+import json
+import os
+import time
+
+CAP = os.path.join(os.path.dirname(os.path.abspath(__file__)), "captured")
+
+
+def main():
+    rows = []
+    for path in sorted(glob.glob(os.path.join(CAP, "*.json")),
+                       key=os.path.getmtime):
+        name = os.path.basename(path)[:-5]
+        try:
+            with open(path) as f:
+                r = json.loads(f.read().strip())
+        except ValueError:
+            rows.append((name, "(corrupt capture)", "", "", "", ""))
+            continue
+        when = time.strftime("%m-%d %H:%MZ",
+                             time.gmtime(os.path.getmtime(path)))
+        perf = r.get("mfu", r.get("hbm_util", ""))
+        rows.append((name, r.get("metric", "?"), r.get("value", ""),
+                     r.get("unit", ""), perf, when))
+    tools = [os.path.basename(p)[:-3]
+             for p in sorted(glob.glob(os.path.join(CAP, "*.ok")))]
+
+    print("| row | metric | value | unit | mfu/hbm | captured |")
+    print("|---|---|---|---|---|---|")
+    for name, metric, value, unit, perf, when in rows:
+        print(f"| {name} | {metric} | {value} | {unit} | {perf} | {when} |")
+    if tools:
+        print(f"\ntool captures: {', '.join(tools)} "
+              f"(outputs in tools/captured/<name>.txt)")
+    if not rows and not tools:
+        print("\n(no captures yet — tools/tpu_recover2.sh fills this on "
+              "the next tunnel window)")
+
+
+if __name__ == "__main__":
+    main()

@@ -327,7 +327,8 @@ def _pipeline_interleaved_loss_and_grads(stage_fn, loss_fn, num_chunks,
 
 
 def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
-                             remat=False, schedule="gpipe", num_chunks=1):
+                             remat=False, schedule="gpipe", num_chunks=1,
+                             dp_axis=None):
     """GPipe-style pipeline-parallel TRAINING step.
 
     Ref: /root/reference/python/paddle/fluid/optimizer.py:2985
@@ -374,6 +375,16 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
         interleave_stage_params [S, V, ...] layout; the ramp advances one
         chunk per tick (the reference's many-sections-per-device
         concurrency, pipeline_trainer.cc). Same loss_fn contract.
+
+    dp_axis (1f1b/interleaved only): name of a data-parallel mesh axis to
+    compose with the pipeline — each dp replica runs the full pipeline on
+    its shard of every microbatch (x/y split on the per-microbatch batch
+    dim), gradients/loss psum-averaged across replicas (the reference's
+    NCCL-DP x pipeline hybrid, multi_devices_graph_pass + pipeline
+    sections). Params replicated over dp, sharded over the pipe axis.
+    Requires loss_fn to be a uniform MEAN over the batch rows as well as
+    the microbatch axis (mean-of-shard-means == global mean only then;
+    a sum over batch rows would come back scaled 1/dp_n).
     """
     if num_chunks != 1 and schedule != "interleaved":
         raise ValueError(
@@ -381,6 +392,11 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
             f"schedule='interleaved' (got {schedule!r}) — a silently "
             "ignored chunk count would misrepresent the configured "
             "parallelism")
+    if dp_axis is not None and schedule == "gpipe":
+        raise ValueError(
+            "dp_axis only applies to the '1f1b'/'interleaved' schedules "
+            "— gpipe with dp_axis would silently run every replica on "
+            "the full batch")
     pspec = P(axis_name)
     if schedule in ("1f1b", "interleaved"):
         if schedule == "interleaved":
@@ -389,7 +405,24 @@ def make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, axis_name=PP,
         else:
             inner = _pipeline_1f1b_loss_and_grads(stage_fn, loss_fn,
                                                   axis_name)
-        fwd_bwd = shard_map(inner, mesh=mesh, in_specs=(pspec, P(), P()),
+        if dp_axis is None:
+            data_spec = P()
+            pipe_inner = inner
+        else:
+            # dp replicas each pipeline their shard of every microbatch
+            # ([M, mb, ...] split on the mb dim), then average
+            data_spec = P(None, dp_axis)
+
+            def pipe_inner(params, x, y, _inner=inner):
+                loss, grads = _inner(params, x, y)
+                dp_n = lax.axis_size(dp_axis)
+                loss = lax.psum(loss, dp_axis) / dp_n
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, dp_axis) / dp_n, grads)
+                return loss, grads
+
+        fwd_bwd = shard_map(pipe_inner, mesh=mesh,
+                            in_specs=(pspec, data_spec, data_spec),
                             out_specs=(P(), pspec), check_vma=False)
 
         def step(params, opt_state, x, y):

@@ -318,6 +318,65 @@ class TestPipeline:
                 np.asarray(a), np.asarray(b), atol=1e-5),
             uninterleave_stage_params(pi, n_stages, n_chunks), pr)
 
+    @pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+    def test_pipeline_1f1b_dp_composed_matches_sequential(self, mesh8,
+                                                          schedule):
+        """dp(2) x pp(4) hybrid via dp_axis: each replica pipelines its
+        shard of every microbatch, grads psum-averaged — must train
+        identically to the single-device model on the full batch (the
+        reference's NCCL-DP x pipeline-sections hybrid). Covers both
+        tick schedules (interleaved runs V=2 chunks = 8 global
+        stages)."""
+        from paddle_tpu.parallel.pipeline import (
+            interleave_stage_params, make_pipeline_train_step,
+            split_microbatches, stack_stage_params)
+        n_stages, n_dp, dim, n_micro, mb = 4, 2, 8, 4, 4
+        n_chunks = 2 if schedule == "interleaved" else 1
+        n_global = n_stages * n_chunks
+        keys = jax.random.split(jax.random.key(3), n_global)
+        stacked = stack_stage_params(
+            [{"w": jax.random.normal(k, (dim, dim)) * 0.3} for k in keys])
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        def loss_fn(outs, labels):
+            return jnp.mean((outs - labels) ** 2)
+
+        x = jnp.asarray(r((n_micro * mb, dim)))
+        y = jnp.asarray(r((n_micro * mb, dim), 1))
+        xm = split_microbatches(x, n_micro)
+        ym = split_microbatches(y, n_micro)
+        mesh = pt.parallel.make_mesh({"dp": n_dp, "pp": n_stages})
+        opt = pt.optimizer.Momentum(0.1, 0.9)
+        step = jax.jit(make_pipeline_train_step(
+            mesh, stage_fn, loss_fn, opt, "pp", schedule=schedule,
+            num_chunks=n_chunks, dp_axis="dp"))
+        p0 = (interleave_stage_params(stacked, n_stages, n_chunks)
+              if schedule == "interleaved" else stacked)
+
+        def seq_loss(params, x, y):
+            h = x
+            for i in range(n_global):
+                h = stage_fn(
+                    jax.tree_util.tree_map(lambda a: a[i], params), h)
+            return jnp.mean((h - y) ** 2)
+
+        ref_opt = pt.optimizer.Momentum(0.1, 0.9)
+
+        @jax.jit
+        def seq_step(params, st, x, y):
+            l, g = jax.value_and_grad(seq_loss)(params, x, y)
+            params, st = ref_opt.apply_gradients(params, g, st)
+            return l, params, st
+
+        pi, sti = p0, opt.init(p0)
+        pr, srt = stacked, ref_opt.init(stacked)
+        for _ in range(3):
+            li, pi, sti = step(pi, sti, xm, ym)
+            lr, pr, srt = seq_step(pr, srt, x, y)
+            np.testing.assert_allclose(float(li), float(lr), atol=1e-5)
+
     def test_pipeline_1f1b_activation_memory_bounded(self, mesh8):
         """Memory half of VERDICT r4 #7 (S=8): the 1f1b schedule's compiled
         temp footprint must stay ~flat as M grows (activations bounded by

@@ -58,8 +58,16 @@ class DistributedStrategy:
     def pipeline_kwargs(self):
         """kwargs for parallel.pipeline.make_pipeline_train_step matching
         this strategy's pipeline schedule (ref: PipelineOptimizer config +
-        section_worker concurrency knobs)."""
-        return {"schedule": self.pp_schedule, "num_chunks": self.pp_chunks}
+        section_worker concurrency knobs). An EXPLICIT dp > 1 with a tick
+        schedule composes the dp x pp hybrid (dp_axis='dp', which shards
+        the per-microbatch batch dim — a contract change the inferred
+        dp = -1 default must not silently opt into). gpipe ignores dp
+        here: its pipeline step has no dp composition path, so pick a
+        tick schedule for the hybrid."""
+        kw = {"schedule": self.pp_schedule, "num_chunks": self.pp_chunks}
+        if self.pp_schedule in ("1f1b", "interleaved") and self.dp > 1:
+            kw["dp_axis"] = "dp"
+        return kw
 
 
 class Fleet:

@@ -46,10 +46,16 @@ class TestDistributedStrategy:
         from paddle_tpu.parallel.pipeline import (
             interleave_stage_params, make_pipeline_train_step,
             stack_stage_params)
-        s = DistributedStrategy(pp=8, pp_schedule="interleaved",
+        s = DistributedStrategy(dp=1, pp=8, pp_schedule="interleaved",
                                 pp_chunks=2)
         assert s.pipeline_kwargs() == {"schedule": "interleaved",
                                        "num_chunks": 2}
+        # inferred dp (-1 default) must NOT silently shard the batch dim
+        s_inf = DistributedStrategy(pp=4, pp_schedule="1f1b")
+        assert "dp_axis" not in s_inf.pipeline_kwargs()
+        # gpipe has no dp composition path: never emits dp_axis
+        s3 = DistributedStrategy(dp=2, pp=4)
+        assert "dp_axis" not in s3.pipeline_kwargs()
         mesh = fleet.build_mesh(s)
         stacked = stack_stage_params(
             [{"w": jnp.eye(4) * 0.5} for _ in range(16)])
@@ -62,6 +68,21 @@ class TestDistributedStrategy:
         x = jnp.ones((4, 2, 4)) * 0.1
         loss, params, _ = jax.jit(step)(params, opt.init(params), x, x)
         assert np.isfinite(float(loss))
+        # EXPLICIT dp>1 + tick schedule -> the emitted kwargs must run
+        # the hybrid end-to-end on the strategy's own mesh
+        s2 = DistributedStrategy(dp=2, pp=4, pp_schedule="1f1b")
+        assert s2.pipeline_kwargs()["dp_axis"] == "dp"
+        mesh2 = fleet.build_mesh(s2)
+        st2 = stack_stage_params(
+            [{"w": jnp.eye(4) * 0.5} for _ in range(4)])
+        step2 = make_pipeline_train_step(
+            mesh2, lambda p, h: jnp.tanh(h @ p["w"]),
+            lambda o, y: jnp.mean((o - y) ** 2), opt, "pp",
+            **s2.pipeline_kwargs())
+        loss2, _, _ = jax.jit(step2)(st2, opt.init(st2),
+                                     jnp.ones((4, 2, 4)) * 0.1,
+                                     jnp.ones((4, 2, 4)) * 0.1)
+        assert np.isfinite(float(loss2))
 
     def test_exclusive_schedules_rejected(self):
         s = DistributedStrategy(local_sgd_steps=2, geo_sgd_steps=2)

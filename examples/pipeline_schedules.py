@@ -1,0 +1,88 @@
+"""Pipeline-parallel training schedules on an 8-device mesh.
+
+Ref: the reference's PipelineTrainer/SectionWorker
+(paddle/fluid/framework/pipeline_trainer.cc, section_worker.cc:141) —
+here as three scan-native schedules over a `pp` mesh axis plus the
+dp x pp hybrid, all loss-equivalent:
+
+  gpipe        forward wave + autodiff-transposed backward wave
+  1f1b         one fwd + one bwd microstep per tick; O(stages)
+               activation residency instead of O(microbatches)
+  interleaved  1f1b over V virtual chunks per device (chunk-granular
+               pipeline ramp)
+
+On CPU:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         JAX_PLATFORMS=cpu python examples/pipeline_schedules.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.parallel.pipeline import (
+        interleave_stage_params, make_pipeline_train_step,
+        split_microbatches, stack_stage_params)
+
+    n = len(jax.devices())
+    S, V, dim, M, mb = n, 2, 32, 2 * n, 4
+    keys = jax.random.split(jax.random.key(0), S * V)
+    stages16 = stack_stage_params(
+        [{"w": jax.random.normal(k, (dim, dim)) * 0.25} for k in keys])
+    stages8 = jax.tree_util.tree_map(lambda a: a[:S], stages16)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(outs, labels):
+        return jnp.mean((outs - labels) ** 2)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(M * mb, dim).astype(np.float32) - 0.5)
+    y = jnp.asarray(rng.rand(M * mb, dim).astype(np.float32) - 0.5)
+    xm, ym = split_microbatches(x, M), split_microbatches(y, M)
+
+    mesh = pt.parallel.make_mesh({"pp": S})
+    opt = pt.optimizer.Adam(1e-2)
+
+    def run(label, step, params):
+        step = jax.jit(step)
+        p, st = params, opt.init(params)
+        losses = []
+        for _ in range(10):
+            l, p, st = step(p, st, xm, ym)
+            losses.append(float(l))
+        print(f"{label}:  loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+
+    for schedule in ("gpipe", "1f1b"):
+        run(f"{schedule:12s} S={S} M={M}",
+            make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, "pp",
+                                     remat=True, schedule=schedule),
+            stages8)
+
+    run(f"interleaved  S={S} V={V} ({S * V} stages)",
+        make_pipeline_train_step(mesh, stage_fn, loss_fn, opt, "pp",
+                                 schedule="interleaved", num_chunks=V),
+        interleave_stage_params(stages16, S, V))
+
+    # the dp x pp hybrid from a strategy object (explicit dp)
+    if n % 2 == 0:
+        s = pt.parallel.DistributedStrategy(dp=2, pp=n // 2,
+                                            pp_schedule="1f1b")
+        hmesh = pt.parallel.fleet.build_mesh(s)
+        run(f"dp(2) x pp({n // 2}) 1f1b",
+            make_pipeline_train_step(hmesh, stage_fn, loss_fn, opt, "pp",
+                                     **s.pipeline_kwargs()),
+            jax.tree_util.tree_map(lambda a: a[:n // 2], stages8))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,7 +13,10 @@ runtime provides. What remains framework-level lives here:
   program.py   captured Program IR via jax tracing (ref: framework.proto ProgramDesc)
   random.py    global seed management
   ragged.py    ragged/variable-length batching (ref: lod_tensor.h LoD)
+  retry.py     retry/backoff policy for remote I/O (no reference
+               counterpart — the reference propagated one-shot failures)
 """
 
-from paddle_tpu.core import dtype, enforce, flags, random
+from paddle_tpu.core import dtype, enforce, flags, random, retry
 from paddle_tpu.core.registry import OpRegistry, register_op
+from paddle_tpu.core.retry import RetryPolicy, retrying

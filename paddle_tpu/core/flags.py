@@ -96,3 +96,27 @@ define_flag("reader_queue_size", 2, "Device prefetch depth for DataLoader.")
 # distributed
 define_flag("dist_heartbeat_interval_s", 10.0, "Heartbeat interval (DCN).")
 define_flag("dist_heartbeat_timeout_s", 300.0, "Peer failure timeout.")
+# fault tolerance — remote I/O retry (core/retry.py RetryPolicy; the ONE
+# retry implementation: io/fs.py remote primitives, checkpoint mirroring,
+# and ElasticRunner restart pacing all resolve these defaults)
+define_flag("retry_max_attempts", 4,
+            "Max attempts (1 = no retry) for remote I/O operations.")
+define_flag("retry_backoff_base_s", 0.05,
+            "Initial retry backoff in seconds (grows per attempt).")
+define_flag("retry_backoff_max_s", 2.0,
+            "Cap on a single retry backoff sleep, in seconds.")
+define_flag("retry_backoff_multiplier", 2.0,
+            "Backoff growth factor between attempts.")
+define_flag("retry_jitter", 0.25,
+            "Backoff jitter fraction in [0, 1]: each sleep is scaled by a "
+            "uniform factor in [1-j, 1+j] to decorrelate retry storms.")
+define_flag("retry_deadline_s", 60.0,
+            "Overall deadline for one retried operation (<= 0 = none): "
+            "give up rather than start a sleep that would cross it.")
+# fault tolerance — checkpoint mirroring (io/checkpoint.py): False = a
+# mirror push that still fails after retries is logged and queued for the
+# next save (training continues on the durable local copy); True = raise
+# into the train loop (pre-fault-tolerance behavior)
+define_flag("strict_mirror", False,
+            "Fail training when a checkpoint remote-mirror push fails "
+            "after retries, instead of degrading to queue-and-continue.")

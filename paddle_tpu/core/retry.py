@@ -1,0 +1,129 @@
+"""Retry/backoff policy — THE single retry mechanism of the framework.
+
+Ref: the reference had none — fs.cc shells out to `hadoop fs` once and
+propagates whatever the shell returns; checkpoint_notify_op.cc fires one
+RPC per pserver and PSLib workers just sleep through restarts
+(fleet_wrapper.h:60). Production object stores and preemptible pods make
+every remote I/O edge a transient-failure surface, so retry semantics are
+centralized here: exponential backoff + full jitter + an overall deadline
++ a retryable-exception predicate, all flag-configurable (core/flags.py
+``retry_*``). Consumers (io/fs.py remote primitives, checkpoint
+mirroring, ElasticRunner restart pacing) never hand-roll sleep loops —
+they construct a `RetryPolicy` (or take `default_policy()`) so chaos
+tests can tune one knob set and reason about one behavior.
+
+    from paddle_tpu.core.retry import RetryPolicy, retrying
+
+    policy = RetryPolicy(max_attempts=5, deadline_s=30.0)
+    data = policy.call(read_remote_blob, url)
+
+    @retrying()                      # defaults from flags, read per call
+    def push(blob): ...
+"""
+
+import random as _random
+import time
+
+from paddle_tpu.core import flags as F
+
+
+def default_retryable(exc):
+    """Transient-looking I/O failures retry; semantic misses never do.
+
+    FileNotFoundError & friends are answers, not hiccups — retrying them
+    only turns a clear error into a slow one (and breaks callers that
+    branch on existence)."""
+    if isinstance(exc, (FileNotFoundError, NotADirectoryError,
+                        IsADirectoryError, PermissionError)):
+        return False
+    return isinstance(exc, (OSError, ConnectionError, TimeoutError))
+
+
+class RetryPolicy:
+    """Exponential backoff + jitter + deadline around a callable.
+
+    Unset parameters resolve from the ``retry_*`` flags at construction,
+    so per-run tuning (PT_FLAGS_retry_max_attempts=1 to fail fast in a
+    debug session) needs no code changes. `sleep`/`rng`/`clock` are
+    injectable for deterministic tests.
+    """
+
+    def __init__(self, max_attempts=None, backoff_base_s=None,
+                 backoff_max_s=None, backoff_multiplier=None, jitter=None,
+                 deadline_s=None, retryable=None, sleep=None, rng=None,
+                 clock=None, on_retry=None):
+        def _f(v, name):
+            return F.get_flag(name) if v is None else v
+        self.max_attempts = max(1, int(_f(max_attempts,
+                                          "retry_max_attempts")))
+        self.backoff_base_s = float(_f(backoff_base_s,
+                                       "retry_backoff_base_s"))
+        self.backoff_max_s = float(_f(backoff_max_s, "retry_backoff_max_s"))
+        self.backoff_multiplier = float(_f(backoff_multiplier,
+                                           "retry_backoff_multiplier"))
+        self.jitter = float(_f(jitter, "retry_jitter"))
+        self.deadline_s = float(_f(deadline_s, "retry_deadline_s"))
+        self.retryable = retryable or default_retryable
+        self.on_retry = on_retry          # (attempt, exc, sleep_s) -> None
+        self._sleep = sleep or time.sleep
+        self._rng = rng or _random
+        self._clock = clock or time.monotonic
+
+    def backoff_s(self, attempt):
+        """Sleep before retry number `attempt` (1-based failure count)."""
+        b = min(self.backoff_max_s,
+                self.backoff_base_s
+                * self.backoff_multiplier ** max(0, attempt - 1))
+        if self.jitter:
+            b *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, b)
+
+    def call(self, fn, *args, **kwargs):
+        """Run fn(*args, **kwargs), retrying retryable failures. The last
+        exception is re-raised as itself (not wrapped) so upstream
+        except-clauses keep working."""
+        start = self._clock()
+        failures = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                failures += 1
+                if not self.retryable(e) or failures >= self.max_attempts:
+                    raise
+                delay = self.backoff_s(failures)
+                if (self.deadline_s > 0
+                        and self._clock() - start + delay > self.deadline_s):
+                    raise
+                if self.on_retry is not None:
+                    self.on_retry(failures, e, delay)
+                self._sleep(delay)
+
+    def wrap(self, fn):
+        """Decorator form of `call` (bound to this policy instance)."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        return wrapped
+
+
+def default_policy(**overrides):
+    """A policy from the current ``retry_*`` flags (fresh each call so
+    `set_flags` between operations takes effect)."""
+    return RetryPolicy(**overrides)
+
+
+def retrying(policy=None, **policy_kwargs):
+    """Decorator: `@retrying()` retries with flag defaults resolved at
+    each call; `@retrying(policy)` pins an explicit policy."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            p = policy or RetryPolicy(**policy_kwargs)
+            return p.call(fn, *args, **kwargs)
+        return wrapped
+    return deco

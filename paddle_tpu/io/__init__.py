@@ -7,7 +7,10 @@ from paddle_tpu.io.fs import (
     ensure_local,
     fs_exists,
     fs_open,
+    get_tree,
+    put_tree,
     register_filesystem,
+    remove_tree,
 )
 from paddle_tpu.io.checkpoint import (
     CheckpointManager,

@@ -10,12 +10,26 @@ TPU-first: orbax async checkpointing — atomic-rename discipline, per-shard
 parallel writes on multi-host (each host saves its addressable shards;
 restore re-shards to the current mesh), which the reference lacked
 (SURVEY.md §5 "No async/atomic-rename discipline").
+
+Fault tolerance (no reference counterpart — checkpoint_notify_op.cc fires
+one RPC and hopes): remote mirrors are torn-write protected — a COMMIT
+marker is the LAST object pushed per step, and discovery/restore ignore
+steps without it, so a crash mid-mirror can never be resumed from. A
+mirror push that still fails after retries (io/fs.py RetryPolicy)
+degrades: the step is queued and re-pushed on the next save while
+training continues on the durable local copy (``strict_mirror`` flag or
+ctor arg restores fail-fast).
 """
 
 import os
 
 import jax
 import numpy as np
+
+from paddle_tpu.testing.chaos import fault_point
+
+# pushed last into each mirrored step dir; its presence IS the commit
+COMMIT_MARKER = "COMMIT"
 
 try:
     import orbax.checkpoint as ocp
@@ -70,9 +84,10 @@ def load_persistables(path, template, step=None):
 def latest_step(path):
     """Find newest step dir for resume (ref: the reference had no resume
     discovery; fleet_util picked paths manually)."""
-    if not os.path.isdir(path):
+    try:
+        steps = [int(d) for d in os.listdir(path) if d.isdigit()]
+    except (FileNotFoundError, NotADirectoryError):
         return None
-    steps = [int(d) for d in os.listdir(path) if d.isdigit()]
     return max(steps) if steps else None
 
 
@@ -80,8 +95,13 @@ class CheckpointManager:
     """Keep-last-N rotation + resume (orbax CheckpointManager when
     available)."""
 
-    def __init__(self, path, max_to_keep=3, save_interval_steps=1):
+    def __init__(self, path, max_to_keep=3, save_interval_steps=1,
+                 strict_mirror=None):
+        from paddle_tpu.core import flags as F
         from paddle_tpu.io import fs as _fs
+        self.strict_mirror = (F.get_flag("strict_mirror")
+                              if strict_mirror is None else strict_mirror)
+        self._mirror_pending = []      # steps whose remote push failed
         scheme, _rest = _fs.split_scheme(path)
         if scheme is not None:
             # remote checkpointing (ref fs.cc hdfs_*, hdfs.py): orbax runs
@@ -102,61 +122,126 @@ class CheckpointManager:
             self.path = os.path.abspath(path)
         self.max_to_keep = max_to_keep
         self.save_interval = save_interval_steps
-        if _HAS_ORBAX:
-            self._mgr = ocp.CheckpointManager(
-                self.path,
-                options=ocp.CheckpointManagerOptions(
-                    max_to_keep=max_to_keep,
-                    save_interval_steps=save_interval_steps))
-        else:
-            self._mgr = None
+        self._mgr = self._make_mgr() if _HAS_ORBAX else None
+
+    def _make_mgr(self):
+        return ocp.CheckpointManager(
+            self.path,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep,
+                save_interval_steps=self.save_interval))
+
+    def _mirror_one(self, step):
+        """Atomically publish ONE staged step to the remote tree: clear
+        any torn remnant of a previous attempt, push the files, then the
+        COMMIT marker as the final object — a reader that doesn't see
+        COMMIT sees nothing."""
+        dst = f"{self._remote}/{step}"
+        if self._fs.fs_exists(dst):
+            self._fs.remove_tree(dst)
+        self._fs.put_tree(os.path.join(self.path, str(step)), dst)
+        with self._fs.fs_open(f"{dst}/{COMMIT_MARKER}", "wb") as f:
+            f.write(b"committed")
 
     def _mirror_save(self, step):
-        """Push the completed step dir to the remote tree and prune remote
-        steps past the keep window — by STEP-NUMBER retention, never by
-        mirroring the local dir listing: a fresh host stages only the
-        steps it touched, and pruning 'whatever is not local' would wipe
-        valid remote history (or, before any restore, ALL of it)."""
+        """Push the completed step (plus any previously-failed queued
+        steps) to the remote tree and prune past the keep window — by
+        COMMITTED-STEP retention, never by mirroring the local dir
+        listing: a fresh host stages only the steps it touched, and
+        pruning 'whatever is not local' would wipe valid remote history
+        (or, before any restore, ALL of it).
+
+        Per-object transfers retry (io/fs.py RetryPolicy); a step that
+        still fails is queued for the next save instead of raising into
+        the train loop, unless strict_mirror."""
         if self._remote is None:
             return
+        fault_point("checkpoint.mirror")
         self.wait()  # the async save must be durable before mirroring
-        self._fs.put_tree(os.path.join(self.path, str(step)),
-                          f"{self._remote}/{step}")
-        remote_steps = sorted(self._remote_steps())
-        for old in remote_steps[:-self.max_to_keep]:
-            self._fs.remove_tree(f"{self._remote}/{old}")
+        todo = [s for s in self._mirror_pending
+                if os.path.isdir(os.path.join(self.path, str(s)))]
+        if step not in todo:
+            todo.append(step)
+        failed = []
+        for s in sorted(todo):
+            try:
+                self._mirror_one(s)
+            except Exception as e:
+                if self.strict_mirror:
+                    # everything from the failed step on is still owed
+                    self._mirror_pending = [x for x in sorted(todo)
+                                            if x >= s]
+                    raise
+                failed.append(s)
+                print(f"[checkpoint] WARNING: mirror of step {s} to "
+                      f"{self._remote} failed after retries ({e!r}); "
+                      f"queued for next save")
+        self._mirror_pending = failed
+        committed = sorted(self._remote_steps())
+        if committed and not failed:
+            # prune anything older than the keep window's floor — torn
+            # junk included; torn dirs >= the floor are republished by
+            # _mirror_one's clear-then-push
+            cutoff = committed[-self.max_to_keep:][0]
+            for name in self._fs.listdir(self._remote):
+                if name.isdigit() and int(name) < cutoff:
+                    self._fs.remove_tree(f"{self._remote}/{name}")
 
-    def _remote_steps(self):
+    def _remote_steps(self, committed_only=True):
+        """Step numbers present in the remote tree; by default only steps
+        whose COMMIT marker landed — an uncommitted (torn) step must be
+        invisible to discovery/restore."""
         if self._remote is None or not self._fs.fs_exists(self._remote):
             return []
-        return [int(n) for n in self._fs.listdir(self._remote)
-                if n.isdigit()]
+        steps = []
+        for n in self._fs.listdir(self._remote):
+            if not n.isdigit():
+                continue
+            if committed_only and not self._fs.fs_exists(
+                    f"{self._remote}/{n}/{COMMIT_MARKER}"):
+                continue
+            steps.append(int(n))
+        return steps
 
     def _fetch_remote(self, step):
         """Pull a step dir from the remote tree into staging if absent
-        locally (fresh host resuming someone else's checkpoint)."""
+        locally (fresh host resuming someone else's checkpoint). Refuses
+        torn (uncommitted) remote steps."""
         if self._remote is None:
             return
         local = os.path.join(self.path, str(step))
         if not os.path.isdir(local):
+            fault_point("checkpoint.fetch")
+            from paddle_tpu.core.enforce import enforce
+            enforce(self._fs.fs_exists(
+                f"{self._remote}/{step}/{COMMIT_MARKER}"),
+                f"remote checkpoint step {step} at {self._remote} has no "
+                f"{COMMIT_MARKER} marker (torn mirror from a crashed "
+                "writer?) — refusing to restore from it")
             self._fs.get_tree(f"{self._remote}/{step}", local)
+            marker = os.path.join(local, COMMIT_MARKER)
+            if os.path.exists(marker):
+                os.remove(marker)      # staging holds orbax files only
             if self._mgr is not None:
                 # orbax scanned the staging dir at construction; rebuild so
                 # it sees the newly fetched step
                 self._mgr.close()
-                self._mgr = ocp.CheckpointManager(
-                    self.path,
-                    options=ocp.CheckpointManagerOptions(
-                        max_to_keep=self.max_to_keep,
-                        save_interval_steps=self.save_interval))
+                self._mgr = self._make_mgr()
 
-    def save(self, step, state):
+    def save(self, step, state, force=False):
+        """Save when the step hits the save interval; `force=True`
+        bypasses the interval gate (preemption: flush the current step at
+        the boundary before exiting)."""
         if self._mgr is not None:
-            saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+            if force and self._mgr.latest_step() == step:
+                saved = True           # boundary save already landed
+            else:
+                saved = self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force)
             if saved:
                 self._mirror_save(step)
             return saved
-        if step % self.save_interval == 0:
+        if force or step % self.save_interval == 0:
             save_persistables(state, self.path, step)
             steps = sorted(int(d) for d in os.listdir(self.path)
                            if d.isdigit())
@@ -167,6 +252,22 @@ class CheckpointManager:
             return True
         return False
 
+    def _reconcile_staging(self, committed):
+        """Drop staged steps the authoritative remote doesn't know about —
+        leftovers of an older experiment on this host (the staging dir is
+        deterministic per remote path), or of a crashed run whose mirror
+        push never landed. Left in place they'd collide with this run's
+        saves at the same step numbers (orbax StepAlreadyExistsError mid
+        train loop)."""
+        import shutil
+        stale = [d for d in os.listdir(self.path)
+                 if d.isdigit() and int(d) not in committed]
+        for d in stale:
+            shutil.rmtree(os.path.join(self.path, d), ignore_errors=True)
+        if stale and self._mgr is not None:
+            self._mgr.close()
+            self._mgr = self._make_mgr()
+
     def restore(self, template, step=None):
         if step is None and self._remote is not None:
             # the REMOTE tree is authoritative: the deterministic staging
@@ -174,6 +275,7 @@ class CheckpointManager:
             # step outranking a reset remote would silently resume the
             # wrong run's weights
             cand = self._remote_steps()
+            self._reconcile_staging(set(cand))
             step = max(cand) if cand else None
             if step is None:
                 return None, None
@@ -201,10 +303,19 @@ class CheckpointManager:
     def close(self):
         """Release orbax's async machinery (background checkpoint threads
         can otherwise outlive the manager and stall interpreter shutdown).
-        The manager is unusable afterwards."""
+        The manager is unusable afterwards. Queued mirror pushes get one
+        last best-effort flush (a clean shutdown shouldn't strand a
+        recovered remote one save behind)."""
         if self._mgr is not None:
             self._mgr.wait_until_finished()
             self._mgr.close()
+        if self._remote is not None and self._mirror_pending:
+            try:
+                self._mirror_save(self._mirror_pending[-1])
+            except Exception as e:      # already logged per-step
+                print(f"[checkpoint] WARNING: final mirror flush failed "
+                      f"({e!r}); steps {self._mirror_pending} remain "
+                      "local-only")
         return self
 
     def __enter__(self):

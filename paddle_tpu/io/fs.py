@@ -26,8 +26,24 @@ import shutil
 import tempfile
 import threading
 
+from paddle_tpu.core import retry as _retry
+
 _REGISTRY = {}
 _LOCK = threading.Lock()
+
+
+def _policy_for(path):
+    """RetryPolicy for remote (scheme-prefixed) paths; None for local ones.
+    Local POSIX ops don't retry — a local failure is a bug or a full disk,
+    and masking it with backoff would only slow the report down."""
+    scheme, _ = split_scheme(path)
+    return _retry.default_policy() if scheme is not None else None
+
+
+def _call(policy, fn, *args, **kwargs):
+    if policy is None:
+        return fn(*args, **kwargs)
+    return policy.call(fn, *args, **kwargs)
 
 
 def split_scheme(path):
@@ -80,6 +96,12 @@ class LocalFS:
         return os.path.isdir(path)
 
     def listdir(self, path):
+        if not os.path.isdir(path):
+            # normalize to FileNotFoundError (MemFS.open semantics) so
+            # callers can branch on "not there yet" without catching the
+            # whole OSError family (which the retry layer treats as
+            # transient)
+            raise FileNotFoundError(path)
         return sorted(os.listdir(path))
 
     def makedirs(self, path):
@@ -171,9 +193,10 @@ class MemFS:
 
 
 def fs_open(path, mode="rb"):
-    """Open a local or scheme-prefixed path through the registry."""
+    """Open a local or scheme-prefixed path through the registry. Remote
+    opens retry transient failures per the ``retry_*`` flags."""
     fs, p = get_filesystem(path)
-    return fs.open(p, mode)
+    return _call(_policy_for(path), fs.open, p, mode)
 
 
 def fs_exists(path):
@@ -214,16 +237,23 @@ def ensure_local(path, cache_dir=None):
     if not os.path.exists(base):
         fs, _ = get_filesystem(path)
         os.makedirs(os.path.dirname(base), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(base),
-                                   prefix=name + ".")
-        try:
-            with fs.open(path, "rb") as src, os.fdopen(fd, "wb") as dst:
-                shutil.copyfileobj(src, dst)
-            os.replace(tmp, base)  # atomic publish; unique tmp per caller
-        except BaseException:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-            raise
+
+        def attempt():
+            # fresh tmp + reopened source per attempt: a failed transfer
+            # leaves nothing behind to poison the retry or the cache
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(base),
+                                       prefix=name + ".")
+            try:
+                with fs.open(path, "rb") as src, \
+                        os.fdopen(fd, "wb") as dst:
+                    shutil.copyfileobj(src, dst)
+                os.replace(tmp, base)  # atomic publish; unique tmp each
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
+
+        _call(_policy_for(path), attempt)
     return base
 
 
@@ -236,45 +266,75 @@ def clear_cache():
 
 
 def put_tree(local_dir, remote_dir):
-    """Mirror a local directory tree to a (remote) destination."""
+    """Mirror a local directory tree to a (remote) destination. Each file
+    transfer retries independently (one flaky object doesn't restart the
+    whole tree)."""
     fs, _ = get_filesystem(remote_dir)
+    policy = _policy_for(remote_dir)
+
+    def copy_one(srcp, dst):
+        # whole-file unit of retry: reopen both ends on each attempt so
+        # a mid-stream failure never leaves a half-written object ACTIVE
+        # as the final content
+        with open(srcp, "rb") as src, fs.open(dst, "wb") as out:
+            shutil.copyfileobj(src, out)
+
     for root, _dirs, files in os.walk(local_dir):
         rel = os.path.relpath(root, local_dir)
         for name in files:
             dst = remote_dir.rstrip("/") + (
                 "/" if rel == "." else f"/{rel}/") + name
-            with open(os.path.join(root, name), "rb") as src, \
-                    fs.open(dst, "wb") as out:
-                shutil.copyfileobj(src, out)
+            _call(policy, copy_one, os.path.join(root, name), dst)
 
 
 def get_tree(remote_dir, local_dir):
-    """Mirror a (remote) directory tree into a local directory. Raises
-    FileNotFoundError when the source does not exist — a silent empty
-    mirror would poison downstream latest-step discovery."""
+    """Mirror a (remote) directory tree into a local directory,
+    atomically: the download lands in a temp dir that is os.replace'd
+    into place only when complete — a failure mid-walk leaves no partial
+    local tree to poison latest-step discovery (same atomic-publish
+    discipline as ensure_local). An existing local_dir is replaced
+    wholesale. Raises FileNotFoundError when the source does not exist —
+    a silent empty mirror would be just as poisonous."""
     fs, p = get_filesystem(remote_dir)
     if not fs.exists(p):
         raise FileNotFoundError(remote_dir)
+    policy = _policy_for(remote_dir)
+
+    def fetch_one(rpath, lpath):
+        with fs.open(rpath, "rb") as src, open(lpath, "wb") as dst:
+            shutil.copyfileobj(src, dst)
 
     def walk(rdir, ldir):
         os.makedirs(ldir, exist_ok=True)
-        for name in fs.listdir(rdir):
+        for name in _call(policy, fs.listdir, rdir):
             rpath = rdir.rstrip("/") + "/" + name
             lpath = os.path.join(ldir, name)
-            if fs.isdir(rpath):
+            if _call(policy, fs.isdir, rpath):
                 walk(rpath, lpath)
             else:
-                with fs.open(rpath, "rb") as src, open(lpath, "wb") as dst:
-                    shutil.copyfileobj(src, dst)
+                _call(policy, fetch_one, rpath, lpath)
 
-    walk(remote_dir, local_dir)
+    local_dir = os.path.abspath(local_dir)
+    parent = os.path.dirname(local_dir)
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".pt_get_tree_", dir=parent)
+    try:
+        walk(remote_dir, tmp)
+        try:
+            os.replace(tmp, local_dir)      # atomic when dst absent/empty
+        except OSError:
+            shutil.rmtree(local_dir, ignore_errors=True)
+            os.replace(tmp, local_dir)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
 
 def remove_tree(path):
     fs, p = get_filesystem(path)
-    fs.remove(p)
+    _call(_policy_for(path), fs.remove, p)
 
 
 def listdir(path):
     fs, p = get_filesystem(path)
-    return fs.listdir(p)
+    return _call(_policy_for(path), fs.listdir, p)

@@ -7,6 +7,19 @@ Here the detector drives supervision: a process supervisor relaunches
 crashed workers, and workers recover through Trainer's checkpoint/resume
 (state + step restored, seekable datasets continue mid-stream).
 
+Restart pacing is fault-tolerance-aware (no reference counterpart):
+
+  * crashes respawn on exponentially backed-off "not before" deadlines
+    (core/retry.py RetryPolicy backoff math — the single backoff
+    implementation), tracked per worker so one crash-looping rank never
+    stalls exit/crash detection for the others;
+  * the restart budget is a crash-loop WINDOW: crashes older than
+    `crash_window_s` are forgiven, so a job that hits one rough patch a
+    day isn't killed by lifetime-total accounting;
+  * a worker exiting with `graceful_exit_rc` (static/trainer.py
+    PREEMPTED_EXIT_CODE, 75) was preempted AFTER checkpointing — it
+    respawns immediately and never burns crash budget.
+
 Single-host scope (process supervision); multi-host pods restart via
 their cluster scheduler — the same worker-side resume path applies.
 """
@@ -16,60 +29,107 @@ import subprocess
 import sys
 import time
 
+from paddle_tpu.core.retry import RetryPolicy
+
 
 class ElasticRunner:
     """Supervise N worker processes; restart any that die with a nonzero
-    exit, up to max_restarts each. Workers are expected to be idempotent
-    via checkpoint/resume (TrainerConfig.checkpoint_dir + resume)."""
+    exit, with exponential backoff, up to max_restarts each within the
+    crash window. Workers are expected to be idempotent via
+    checkpoint/resume (TrainerConfig.checkpoint_dir + resume)."""
 
     def __init__(self, nproc, script, script_args=(), max_restarts=3,
-                 restart_delay_s=1.0, env_extra=None):
+                 restart_delay_s=1.0, backoff_multiplier=2.0,
+                 max_restart_delay_s=30.0, crash_window_s=None,
+                 graceful_exit_rc=75, env_extra=None):
         self.nproc = nproc
         self.script = script
         self.script_args = list(script_args)
         self.max_restarts = max_restarts
         self.restart_delay_s = restart_delay_s
+        self.crash_window_s = crash_window_s   # None = lifetime budget
+        self.graceful_exit_rc = graceful_exit_rc
         self.env_extra = dict(env_extra or {})
-        self.restarts = [0] * nproc
+        self.restarts = [0] * nproc            # crash respawns (lifetime)
+        self.preemptions = [0] * nproc         # graceful-rc respawns
+        self._crash_times = [[] for _ in range(nproc)]
+        # restart pacing = the framework's one backoff implementation
+        # (jitter off: supervised respawns don't thundering-herd a store)
+        self._backoff = RetryPolicy(backoff_base_s=restart_delay_s,
+                                    backoff_multiplier=backoff_multiplier,
+                                    backoff_max_s=max_restart_delay_s,
+                                    jitter=0.0)
 
     def _spawn(self, rank):
         env = dict(os.environ)
         env.update(self.env_extra)
         env["PT_ELASTIC_RANK"] = str(rank)
         env["PT_ELASTIC_RESTART"] = str(self.restarts[rank])
+        env["PT_ELASTIC_GENERATION"] = str(self.restarts[rank]
+                                           + self.preemptions[rank])
         return subprocess.Popen(
             [sys.executable, self.script, *self.script_args], env=env)
 
+    def _recent_crashes(self, rank, now):
+        """Crashes charged against the budget: all of them, or only those
+        inside the sliding crash window when one is configured."""
+        if self.crash_window_s is not None:
+            self._crash_times[rank] = [
+                t for t in self._crash_times[rank]
+                if now - t <= self.crash_window_s]
+            return len(self._crash_times[rank])
+        return self.restarts[rank]
+
     def run(self, timeout=600, poll_s=0.2):
         """Run until every worker exits 0. Raises RuntimeError when a
-        worker exhausts its restart budget or the deadline passes."""
+        worker exhausts its restart budget or the deadline passes.
+
+        The poll loop never blocks on a single worker's backoff: crashed
+        workers get a per-rank "respawn not before" deadline and the loop
+        keeps polling everyone else meanwhile (a blocking sleep here
+        would stall exit/crash detection for all other ranks)."""
         procs = {r: self._spawn(r) for r in range(self.nproc)}
+        respawn_at = {}                # rank -> monotonic deadline
         done = set()
         deadline = time.monotonic() + timeout
         try:
             while len(done) < self.nproc:
-                if time.monotonic() > deadline:
+                now = time.monotonic()
+                if now > deadline:
                     raise RuntimeError(
                         f"elastic run timed out; completed={sorted(done)}")
+                for r in [r for r, t in respawn_at.items() if now >= t]:
+                    del respawn_at[r]
+                    procs[r] = self._spawn(r)
                 for r, p in list(procs.items()):
-                    if r in done:
+                    if r in done or r in respawn_at:
                         continue
                     rc = p.poll()
                     if rc is None:
                         continue
                     if rc == 0:
                         done.add(r)
-                    else:
-                        self.restarts[r] += 1
-                        if self.restarts[r] > self.max_restarts:
-                            raise RuntimeError(
-                                f"worker {r} failed rc={rc} after "
-                                f"{self.max_restarts} restarts")
-                        time.sleep(self.restart_delay_s)
-                        procs[r] = self._spawn(r)
+                        continue
+                    if rc == self.graceful_exit_rc:
+                        # preemption after checkpoint: resume right away,
+                        # no crash budget charged
+                        self.preemptions[r] += 1
+                        respawn_at[r] = now
+                        continue
+                    self.restarts[r] += 1
+                    self._crash_times[r].append(now)
+                    recent = self._recent_crashes(r, now)
+                    if recent > self.max_restarts:
+                        window = ("" if self.crash_window_s is None else
+                                  f" within {self.crash_window_s}s")
+                        raise RuntimeError(
+                            f"worker {r} failed rc={rc} after "
+                            f"{self.max_restarts} restarts{window}")
+                    respawn_at[r] = now + self._backoff.backoff_s(recent)
                 time.sleep(poll_s)
         finally:
             for r, p in procs.items():
                 if p.poll() is None:
                     p.kill()
-        return dict(restarts=list(self.restarts))
+        return dict(restarts=list(self.restarts),
+                    preemptions=list(self.preemptions))

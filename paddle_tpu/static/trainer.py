@@ -19,12 +19,36 @@ parity comes from optional sparse-table pull/push hooks around each step
 
 import dataclasses
 import queue
+import signal
 import threading
 import time
 
 import jax
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.testing.chaos import fault_point
+
+# conventional "rescheduleable interruption" exit status (BSD EX_TEMPFAIL);
+# ElasticRunner respawns this rc immediately without burning crash budget
+PREEMPTED_EXIT_CODE = 75
+
+
+class Preempted(SystemExit):
+    """Raised out of Trainer.train after a preemption signal triggered a
+    final checkpoint save at the step boundary. Subclasses SystemExit
+    with code PREEMPTED_EXIT_CODE, so a worker script that lets it
+    propagate exits cleanly (no traceback) with the status the
+    supervisor (parallel/elastic.ElasticRunner, or a cluster scheduler
+    shim) recognizes as 'resume me'."""
+
+    def __init__(self, step, signum=None):
+        super().__init__(PREEMPTED_EXIT_CODE)
+        self.step = step
+        self.signum = signum
+
+    def __str__(self):
+        return (f"preempted by signal {self.signum} at step {self.step} "
+                "(checkpoint saved)")
 
 
 @dataclasses.dataclass
@@ -52,6 +76,13 @@ class TrainerConfig:
     checkpoint_dir: str = None     # None = checkpointing off
     checkpoint_every: int = 0      # steps between saves (0 = off)
     resume: bool = True            # restore latest checkpoint before start
+    # preemption awareness (TPU pods get SIGTERM with a grace window when
+    # the scheduler reclaims capacity): opt-in handler that requests a
+    # final checkpoint at the next step boundary, then raises Preempted
+    # (exit code 75) so the supervisor resumes at full fidelity instead
+    # of losing up to checkpoint_every steps
+    handle_preemption: bool = False
+    preemption_signals: tuple = None  # default (SIGTERM, SIGINT)
 
 
 class _EndOfData:
@@ -99,6 +130,7 @@ class Trainer:
         def work(reader):
             try:
                 for item in reader():
+                    fault_point("trainer.ingest")
                     if not put(item):
                         return  # trainer stopped early (max_steps)
             except BaseException as e:  # surfaced by train() at drain
@@ -125,6 +157,37 @@ class Trainer:
         if hasattr(dataset, "reader"):
             return [dataset.reader()]
         return [dataset]  # assume callable yielding items
+
+    # -- preemption (SIGTERM grace window -> checkpoint -> clean exit) -----
+    def _install_preemption_handler(self):
+        """Opt-in signal handlers that REQUEST a stop; the train loop acts
+        at the next step boundary (mid-step state is not checkpointable).
+        Returns (requested: dict, restore: callable)."""
+        requested = {"signum": None}
+        if not self.cfg.handle_preemption:
+            return requested, lambda: None
+        sigs = self.cfg.preemption_signals or (signal.SIGTERM,
+                                               signal.SIGINT)
+        prev = {}
+
+        def on_signal(signum, frame):
+            requested["signum"] = signum
+
+        try:
+            for s in sigs:
+                prev[s] = signal.signal(s, on_signal)
+        except ValueError:
+            # not the main thread: signals can't be trapped here — run
+            # without graceful preemption rather than refuse to train
+            print("[trainer] WARNING: handle_preemption requested off the "
+                  "main thread; preemption signals will not be trapped")
+            return requested, lambda: None
+
+        def restore():
+            for s, h in prev.items():
+                signal.signal(s, h)
+
+        return requested, restore
 
     # -- failure detection (ref heart_beat_monitor.h LostWorkerMonitor) ----
     def _start_heartbeat(self, num_workers=None, worker_id=None):
@@ -247,6 +310,7 @@ class Trainer:
                         dataset.seek(step)
                     print(f"[trainer] resumed from step {step}")
         start_step = step
+        preempt, restore_signals = self._install_preemption_handler()
         chan, stop, errors = self._start_ingest(
             self._split_readers(dataset))
         hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
@@ -271,6 +335,7 @@ class Trainer:
             return _collate(buf)
 
         clean = False
+        preempted_sig = None
         try:
             nxt = next_batch()
             while nxt is not None:
@@ -286,6 +351,16 @@ class Trainer:
                     loss, state = self.step_fn(state, *staged)
                 step += 1
                 hb_ping()
+                if preempt["signum"] is not None:
+                    # step boundary after a preemption notice: flush a
+                    # final checkpoint (interval gate bypassed) and stop —
+                    # the supervisor resumes at exactly this step
+                    if ckpt_mgr is not None:
+                        ckpt_mgr.save(step, state, force=True)
+                    preempted_sig = preempt["signum"]
+                    print(f"[trainer] preemption signal {preempted_sig}: "
+                          f"checkpointed step {step}, exiting for resume")
+                    break
                 if ckpt_mgr is not None:
                     ckpt_mgr.save(step, state)  # manager gates the interval
                 if cfg.log_every and step % cfg.log_every == 0:
@@ -294,12 +369,17 @@ class Trainer:
                     print(f"[trainer] step {step} loss {lv:.6f}")
                 if not cfg.prefetch:
                     nxt = next_batch()
-            clean = True
+            clean = preempted_sig is None
         finally:
             stop.set()  # release producers even when step_fn raises
+            restore_signals()
+            # a preempted worker is NOT complete: no done marker — peers
+            # see it pause (and revive), never COMPLETED
             hb_finish(clean)
             if ckpt_mgr is not None:
                 ckpt_mgr.close()
+        if preempted_sig is not None:
+            raise Preempted(step, preempted_sig)
         run_steps = step - start_step
         if errors:
             raise RuntimeError(

@@ -1,0 +1,11 @@
+"""Testing utilities — deterministic fault injection for robustness tests.
+
+  chaos.py   FaultPlan / ChaosFS / fault_point: seedable fault injection
+             over the io/fs registry and framework fault points, so
+             recovery behavior (retry, degrade, torn-write protection,
+             preemption resume) is exercised by tier-1 tests rather than
+             trusted.
+"""
+
+from paddle_tpu.testing import chaos
+from paddle_tpu.testing.chaos import ChaosFS, DirFS, FaultPlan, fault_point

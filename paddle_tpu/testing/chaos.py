@@ -1,0 +1,264 @@
+"""Chaos harness — deterministic fault injection for the I/O + recovery
+paths.
+
+Ref: the reference framework had no fault-injection story at all — its
+failure handling (HeartBeatMonitor warnings, PSLib sleep-through-restart)
+shipped untested. Here every recovery behavior is exercised by tests:
+
+  FaultPlan   seedable schedule of faults, matched by operation name,
+              occurrence count, and path regex. Deterministic by
+              construction (per-op counters); optional probabilistic
+              rules draw from the plan's own seeded RNG.
+  ChaosFS     wraps any filesystem implementing the 6-primitive surface
+              (io/fs.py MemFS template) and consults the plan before
+              each primitive: raise an injected error, add latency, or
+              silently truncate a write (torn-write simulation).
+  DirFS       LocalFS under a URL scheme, rooted at a directory — a
+              fault-injectable "remote" store that SURVIVES process
+              restarts (MemFS is per-process), for multi-process drills
+              like tools/chaos_drill.py.
+  fault_point 1-line hooks compiled into framework paths (checkpoint
+              mirror, trainer ingest); no-ops unless a plan is active.
+
+    plan = FaultPlan(seed=7).fail("write", path=r"/3/", times=2)
+    fs.register_filesystem("mem", ChaosFS(fs.MemFS(), plan))
+
+    with chaos.active(plan): ...        # enables fault_point() hooks
+
+This module deliberately imports nothing from paddle_tpu at module level
+so the framework hot paths (io/fs.py, static/trainer.py) can import
+`fault_point` without cycles.
+"""
+
+import contextlib
+import os
+import random
+import re
+import shutil
+import threading
+import time
+
+
+class InjectedFault(OSError):
+    """Default injected error. Subclasses OSError so the framework's
+    default retryable predicate (core/retry.py) treats it as transient —
+    exactly what a flaky object store throws."""
+
+
+class FaultPlan:
+    """A deterministic schedule of faults.
+
+    Rules are matched in insertion order against (op, path) events; each
+    op keeps its own 1-based occurrence counter. A rule fires when its
+    `op` matches, the op's occurrence index is >= `nth`, its `path`
+    regex (if any) searches the path, its `times` budget is not spent,
+    and its probability (if any) passes the seeded RNG. Actions: raise
+    `exc` (default InjectedFault), sleep `latency_s`, or mark the write
+    for truncation after `truncate_at` bytes (torn write — the caller
+    sees success).
+    """
+
+    def __init__(self, seed=0):
+        self.rng = random.Random(seed)
+        self._rules = []
+        self._counts = {}
+        self._lock = threading.Lock()
+        self.log = []                  # (op, path, action) tuples fired
+
+    def fail(self, op, path=None, nth=1, times=1, exc=None, p=None,
+             latency_s=None, truncate_at=None):
+        """Add a rule; returns self for chaining."""
+        self._rules.append(dict(
+            op=op, path=re.compile(path) if path else None, nth=nth,
+            remaining=times, exc=exc, p=p, latency_s=latency_s,
+            truncate_at=truncate_at))
+        return self
+
+    def reset_counts(self):
+        with self._lock:
+            self._counts.clear()
+
+    def fired(self, op=None):
+        """How many faults fired (optionally for one op) — assertions."""
+        return len([e for e in self.log if op is None or e[0] == op])
+
+    def check(self, op, path=""):
+        """Record one (op, path) event; raise/sleep per the first matching
+        rule. Returns a truncation byte limit for write ops, else None."""
+        with self._lock:
+            n = self._counts[op] = self._counts.get(op, 0) + 1
+            rule = None
+            for r in self._rules:
+                if r["op"] != op or r["remaining"] <= 0 or n < r["nth"]:
+                    continue
+                if r["path"] is not None and not r["path"].search(str(path)):
+                    continue
+                if r["p"] is not None and self.rng.random() >= r["p"]:
+                    continue
+                r["remaining"] -= 1
+                rule = r
+                break
+        if rule is None:
+            return None
+        if rule["latency_s"]:
+            self.log.append((op, path, f"latency:{rule['latency_s']}"))
+            time.sleep(rule["latency_s"])
+        if rule["truncate_at"] is not None:
+            self.log.append((op, path, f"truncate:{rule['truncate_at']}"))
+            return rule["truncate_at"]
+        if rule["exc"] is not None or rule["latency_s"] is None:
+            exc = rule["exc"] or InjectedFault(
+                f"injected fault: {op} #{n} on {path!r}")
+            self.log.append((op, path, f"raise:{type(exc).__name__}"))
+            raise exc
+        return None
+
+
+class _TruncatingWriter:
+    """Persists only the first `limit` bytes but reports full success to
+    the writer — what a crash mid-upload leaves behind (torn write)."""
+
+    def __init__(self, inner, limit):
+        self._inner = inner
+        self._left = limit
+
+    def write(self, data):
+        if self._left > 0:
+            take = data[:self._left]
+            self._inner.write(take)
+            self._left -= len(take)
+        return len(data)
+
+    def close(self):
+        self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ChaosFS:
+    """Fault-injecting wrapper over any registered filesystem.
+
+    Consulted ops (FaultPlan `op` names): "open" (read), "write"
+    (write/append open), "exists", "isdir", "listdir", "makedirs",
+    "remove". Register it in place of the real backend:
+
+        fs.register_filesystem("gs", ChaosFS(real_gs, plan))
+    """
+
+    def __init__(self, inner, plan):
+        self.inner = inner
+        self.plan = plan
+
+    def open(self, path, mode="rb"):
+        writeish = "w" in mode or "a" in mode
+        limit = self.plan.check("write" if writeish else "open", path)
+        f = self.inner.open(path, mode)
+        if writeish and limit is not None:
+            return _TruncatingWriter(f, limit)
+        return f
+
+    def exists(self, path):
+        self.plan.check("exists", path)
+        return self.inner.exists(path)
+
+    def isdir(self, path):
+        self.plan.check("isdir", path)
+        return self.inner.isdir(path)
+
+    def listdir(self, path):
+        self.plan.check("listdir", path)
+        return self.inner.listdir(path)
+
+    def makedirs(self, path):
+        self.plan.check("makedirs", path)
+        return self.inner.makedirs(path)
+
+    def remove(self, path):
+        self.plan.check("remove", path)
+        return self.inner.remove(path)
+
+
+class DirFS:
+    """A 'remote' store backed by a local directory, addressed through a
+    URL scheme ('drill://ck/3/x' -> <root>/ck/3/x). Unlike MemFS the
+    contents survive process restarts, so multi-process drills
+    (ElasticRunner workers dying and resuming) can share one
+    fault-injectable store: register ChaosFS(DirFS(root), plan)."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _p(self, path):
+        rest = str(path).partition("://")[2] if "://" in str(path) \
+            else str(path)
+        return os.path.join(self.root, rest.lstrip("/"))
+
+    def open(self, path, mode="rb"):
+        p = self._p(path)
+        if "w" in mode or "a" in mode:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+        return open(p, mode)
+
+    def exists(self, path):
+        return os.path.exists(self._p(path))
+
+    def isdir(self, path):
+        return os.path.isdir(self._p(path))
+
+    def listdir(self, path):
+        p = self._p(path)
+        if not os.path.isdir(p):
+            raise FileNotFoundError(path)
+        return sorted(os.listdir(p))
+
+    def makedirs(self, path):
+        os.makedirs(self._p(path), exist_ok=True)
+
+    def remove(self, path):
+        p = self._p(path)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+        elif os.path.exists(p):
+            os.remove(p)
+
+
+# -- fault points: named hooks on framework paths ------------------------
+_ACTIVE = None
+
+
+def install(plan):
+    """Activate `plan` for fault_point() hooks process-wide."""
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def uninstall():
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def active(plan):
+    """Scoped install: `with chaos.active(plan): ...`."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+def fault_point(name):
+    """Named hook compiled into framework paths (checkpoint mirror,
+    trainer ingest). Free when no plan is active; under an active plan it
+    is a FaultPlan event with op="fault_point" and path=name."""
+    if _ACTIVE is not None:
+        _ACTIVE.check("fault_point", name)

@@ -78,6 +78,72 @@ class TestMemFS:
         assert (dst / "a.txt").read_text() == "A"
         assert (dst / "sub" / "b.txt").read_text() == "B"
 
+    def test_localfs_listdir_missing_raises_filenotfound(self, tmp_path):
+        # FileNotFoundError (MemFS.open semantics), not a raw OSError the
+        # retry layer would treat as transient
+        with pytest.raises(FileNotFoundError):
+            fs.LocalFS().listdir(str(tmp_path / "nope"))
+        from paddle_tpu.io.checkpoint import latest_step
+        assert latest_step(str(tmp_path / "nope")) is None
+        f = tmp_path / "plainfile"
+        f.write_text("x")
+        assert latest_step(str(f)) is None     # not a dir: no steps
+
+    def test_get_tree_failure_leaves_no_partial_tree(self, memfs,
+                                                     tmp_path):
+        """A failure mid-walk must not leave a partial local tree (it
+        would poison latest-step discovery): downloads land in a temp dir
+        and are os.replace'd into place only when complete."""
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.testing import chaos
+        with fs.fs_open("mem://store/ck/5/a.bin", "wb") as f:
+            f.write(b"A")
+        with fs.fs_open("mem://store/ck/5/b.bin", "wb") as f:
+            f.write(b"B")
+        plan = chaos.FaultPlan().fail("open", path=r"b\.bin$", times=4)
+        fs.register_filesystem("chaosmem", chaos.ChaosFS(memfs, plan))
+        old = {k: F.get_flag(k) for k in ("retry_max_attempts",
+                                          "retry_backoff_base_s")}
+        F.set_flags({"retry_max_attempts": 2,
+                     "retry_backoff_base_s": 0.001})
+        dst = tmp_path / "ck" / "5"
+        try:
+            with pytest.raises(chaos.InjectedFault):
+                fs.get_tree("chaosmem://store/ck/5", str(dst))
+            assert not dst.exists()            # nothing partial published
+            assert list((tmp_path / "ck").glob(".pt_get_tree_*")) == []
+            # with the fault budget down to one hit, the retry layer
+            # absorbs it and the complete tree lands atomically
+            plan2 = chaos.FaultPlan().fail("open", path=r"b\.bin$")
+            fs.register_filesystem("chaosmem",
+                                   chaos.ChaosFS(memfs, plan2))
+            fs.get_tree("chaosmem://store/ck/5", str(dst))
+            assert (dst / "a.bin").read_bytes() == b"A"
+            assert (dst / "b.bin").read_bytes() == b"B"
+            assert plan2.fired("open") == 1    # the retry really happened
+        finally:
+            F.set_flags(old)
+            fs._REGISTRY.pop("chaosmem", None)
+
+    def test_remote_open_retries_transients(self, memfs):
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.testing import chaos
+        with fs.fs_open("mem://b/x", "wb") as f:
+            f.write(b"1")
+        plan = chaos.FaultPlan().fail("open", times=2)
+        fs.register_filesystem("flaky", chaos.ChaosFS(memfs, plan))
+        old = {k: F.get_flag(k) for k in ("retry_max_attempts",
+                                          "retry_backoff_base_s")}
+        F.set_flags({"retry_max_attempts": 3,
+                     "retry_backoff_base_s": 0.001})
+        try:
+            with fs.fs_open("flaky://b/x", "rb") as f:
+                assert f.read() == b"1"        # 2 injected failures eaten
+            assert plan.fired("open") == 2
+        finally:
+            F.set_flags(old)
+            fs._REGISTRY.pop("flaky", None)
+
 
 class TestFileDatasetRemote:
     def test_reads_remote_files(self, memfs, tmp_path):

@@ -1,0 +1,144 @@
+"""End-to-end fault drill: train -> flaky mirror (degrade) -> SIGTERM
+preemption (checkpoint + exit 75) -> hard crash -> resume -> verify.
+
+One ElasticRunner-supervised worker trains against a remote checkpoint
+store served by ChaosFS(DirFS) — a directory-backed "object store" that
+survives process restarts but injects deterministic faults:
+
+  generation 0: the first mirror push hits 2 injected write failures
+                (exhausting the tightened retry budget) -> the step is
+                queued, training continues; then a SIGTERM lands mid-run
+                -> forced checkpoint at the step boundary, exit 75;
+  generation 1: resumes at the preemption step, then hard-crashes
+                (os._exit) mid-step -> ElasticRunner restarts it;
+  generation 2: resumes from the last committed step and finishes.
+
+The drill verifies: exactly 1 preemption + 1 crash restart, every
+remotely-visible step carries a COMMIT marker, retention pruned to the
+keep window, and the final committed step equals the step count.
+
+Usage:
+    python tools/chaos_drill.py [--steps 8] [--workdir DIR]
+
+Also exercised as a slow-marked test (tests/test_chaos.py).
+"""
+
+import argparse
+import hashlib
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """\
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.core import flags as F
+from paddle_tpu.io import fs
+from paddle_tpu.testing import chaos
+from paddle_tpu.static.trainer import Trainer, TrainerConfig
+
+gen = int(os.environ['PT_ELASTIC_GENERATION'])
+max_steps = {steps}
+F.set_flags({{'retry_max_attempts': 2, 'retry_backoff_base_s': 0.001,
+             'retry_jitter': 0.0}})
+# deterministic chaos: the first mirrored step's push fails both retry
+# attempts of its first object, then the store heals
+plan = chaos.FaultPlan(seed=7).fail('write', path='/2/', times=2)
+fs.register_filesystem('drill', chaos.ChaosFS(chaos.DirFS({root!r}), plan))
+
+def reader():
+    for i in range(1000):
+        yield (np.ones((1,), np.float32),)
+
+def step(state, x):
+    w = float(state['w'])
+    if gen == 0 and w == 3.0:
+        os.kill(os.getpid(), signal.SIGTERM)   # preemption notice
+    if gen == 1 and w == 5.0:
+        os._exit(17)                           # simulated hard crash
+    return jnp.sum(x), {{'w': state['w'] + 1.0}}
+
+cfg = TrainerConfig(num_ingest_threads=1, max_steps=max_steps,
+                    checkpoint_dir='drill://ck', checkpoint_every=2,
+                    prefetch=False, handle_preemption=True)
+state, stats = Trainer(step, cfg).train({{'w': jnp.zeros(())}},
+                                        lambda: reader())
+assert stats['steps'] == max_steps, stats
+assert float(state['w']) == float(max_steps), state
+with open({out!r}, 'a') as f:
+    f.write('gen %d: steps=%d run_steps=%d\\n'
+            % (gen, stats['steps'], stats['run_steps']))
+print('[drill worker] generation', gen, 'finished', stats)
+"""
+
+
+def _staging_of(url):
+    tag = hashlib.sha1(url.rstrip("/").encode()).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(), "pt_ckpt_staging", tag)
+
+
+def run_drill(workdir, steps=8, timeout=600):
+    """Run the drill under `workdir`; returns a summary dict (raises on
+    any verification failure)."""
+    sys.path.insert(0, REPO)
+    from paddle_tpu.parallel.elastic import ElasticRunner
+
+    workdir = os.path.abspath(workdir)
+    root = os.path.join(workdir, "remote_store")
+    out = os.path.join(workdir, "drill_log.txt")
+    os.makedirs(workdir, exist_ok=True)
+    # the staging dir is deterministic per URL and 'drill://ck' is shared
+    # across drill invocations — start from a clean slate
+    shutil.rmtree(_staging_of("drill://ck"), ignore_errors=True)
+    script = os.path.join(workdir, "drill_worker.py")
+    with open(script, "w") as f:
+        f.write(_WORKER.format(repo=REPO, steps=steps, root=root, out=out))
+
+    runner = ElasticRunner(1, script, max_restarts=2, restart_delay_s=0.1,
+                           crash_window_s=300.0)
+    res = runner.run(timeout=timeout)
+
+    assert res["preemptions"] == [1], res
+    assert res["restarts"] == [1], res
+    ck = os.path.join(root, "ck")
+    committed = sorted(int(n) for n in os.listdir(ck)
+                       if n.isdigit()
+                       and os.path.exists(os.path.join(ck, n, "COMMIT")))
+    torn = sorted(int(n) for n in os.listdir(ck)
+                  if n.isdigit()
+                  and not os.path.exists(os.path.join(ck, n, "COMMIT")))
+    assert torn == [], f"uncommitted steps visible remotely: {torn}"
+    assert committed[-1] == steps, committed
+    assert len(committed) <= 3, f"retention failed: {committed}"
+    log = open(out).read()
+    summary = dict(restarts=res["restarts"], preemptions=res["preemptions"],
+                   committed_steps=committed, worker_log=log.strip())
+    shutil.rmtree(_staging_of("drill://ck"), ignore_errors=True)
+    return summary
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir (default: fresh temp dir, removed "
+                         "on success)")
+    args = ap.parse_args()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="pt_chaos_drill_")
+    summary = run_drill(workdir, steps=args.steps)
+    print("\n=== chaos drill PASSED ===")
+    for k, v in summary.items():
+        print(f"  {k}: {v}")
+    if args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

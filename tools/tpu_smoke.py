@@ -222,7 +222,7 @@ def main():
     # a 1-device mesh runs the REAL ring code path (fori_loop + ppermute +
     # the Pallas per-block kernels and the custom ring VJP) on the chip
     # without needing multiple devices; parity vs the dense ring.
-    from jax import shard_map
+    from paddle_tpu.parallel.pipeline import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from paddle_tpu.parallel.ring_attention import (ring_attention,

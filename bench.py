@@ -51,6 +51,18 @@ def _cost_flops(jitted, *args):
 
 
 COMPILE_ONLY = False
+TINY = False
+
+
+def _scan_env(cfg):
+    """Step-fusion defaults for the transformer-family benches:
+    scan-over-layers on (PT_BENCH_SCAN=0 restores unrolled), remat policy
+    from PT_BENCH_REMAT (else the remat_policy flag)."""
+    cfg.scan_layers = os.environ.get("PT_BENCH_SCAN", "1") == "1"
+    remat = os.environ.get("PT_BENCH_REMAT", "").strip()
+    if remat:
+        cfg.remat = remat
+    return cfg
 
 
 def _co(name, jitted, *args):
@@ -107,7 +119,7 @@ def _timed_steps(step_once, steps):
 
 def bench_bert(steps, batch, seq, use_flash=False):
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
-    cfg = BertConfig.base()
+    cfg = BertConfig.tiny() if TINY else BertConfig.base()
     return _bench_mlm(BertForPretraining, cfg, "bert_base", steps, batch,
                       seq, use_flash)
 
@@ -117,7 +129,7 @@ def bench_ernie(steps, batch, seq, use_flash=False):
     BERT-base with knowledge masking; the training step is the same
     MXU-dominated MLM+NSP compute, so it shares the harness."""
     from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
-    cfg = ErnieConfig.base()
+    cfg = ErnieConfig.tiny() if TINY else ErnieConfig.base()
     return _bench_mlm(ErnieForPretraining, cfg, "ernie_1.0", steps, batch,
                       seq, use_flash)
 
@@ -126,11 +138,11 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
-    from paddle_tpu.models.bert import pretrain_loss
 
     cfg.dropout = 0.0  # bench the compute path
     cfg.use_flash = use_flash
     cfg.max_position = max(cfg.max_position, seq)
+    _scan_env(cfg)
     model = model_cls(cfg)
     variables = model.init(jax.random.key(0))
     params = variables["params"]
@@ -161,9 +173,10 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
         mask = jnp.ones((batch, n_mask), jnp.float32)
 
     def loss_fn(p, ids, mlm_l, nsp_l, m):
-        mlm_logits, nsp_logits = model.apply({"params": p, "state": {}}, ids,
-                                             mask_positions=mask_pos)
-        return pretrain_loss(mlm_logits, nsp_logits, mlm_l, nsp_l, m), 0.0
+        # .loss entry point: chunked fused vocab cross-entropy (no
+        # [B, M, V] logits; PT_FUSED_XENT=0 restores logits+pretrain_loss)
+        return model.apply({"params": p, "state": {}}, ids, mlm_l, nsp_l, m,
+                           mask_positions=mask_pos, method="loss"), 0.0
 
     def train_step(params, opt_state, ids, mlm_l, nsp_l, m):
         loss, params, opt_state, _ = opt.minimize(
@@ -210,10 +223,9 @@ def bench_transformer(steps, batch, seq):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
-    from paddle_tpu.models.transformer import (Transformer,
-                                               TransformerConfig, nmt_loss)
+    from paddle_tpu.models.transformer import Transformer, TransformerConfig
 
-    cfg = TransformerConfig.big()
+    cfg = TransformerConfig.tiny() if TINY else TransformerConfig.big()
     cfg.dropout = 0.0
     cfg.max_len = max(cfg.max_len, seq)
     model = Transformer(cfg)
@@ -233,8 +245,10 @@ def bench_transformer(steps, batch, seq):
                                       dtype=np.int32))
 
     def loss_fn(p, src, tgt_in, tgt_out):
-        logits = model.apply({"params": p, "state": {}}, src, tgt_in)
-        return nmt_loss(logits, tgt_out), 0.0
+        # .loss entry point: fused label-smoothed vocab cross-entropy (no
+        # [B, T, V] logits or one-hot; PT_FUSED_XENT=0 restores nmt_loss)
+        return model.apply({"params": p, "state": {}}, src, tgt_in, tgt_out,
+                           method="loss"), 0.0
 
     def train_step(params, opt_state, src, tgt_in, tgt_out):
         loss, params, opt_state, _ = opt.minimize(
@@ -372,11 +386,12 @@ def bench_gpt(steps, batch, seq):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
-    from paddle_tpu.models.gpt import GPT, GPTConfig, lm_loss
+    from paddle_tpu.models.gpt import GPT, GPTConfig
 
-    cfg = GPTConfig.small()
+    cfg = GPTConfig.tiny() if TINY else GPTConfig.small()
     cfg.dropout = 0.0
     cfg.max_position = max(cfg.max_position, seq)
+    _scan_env(cfg)
     model = GPT(cfg)
     variables = model.init(jax.random.key(0))
     params = variables["params"]
@@ -390,8 +405,10 @@ def bench_gpt(steps, batch, seq):
                                   dtype=np.int32))
 
     def loss_fn(p, ids):
-        logits = model.apply({"params": p, "state": {}}, ids)
-        return lm_loss(logits, ids), 0.0
+        # .loss entry point: fused shifted CE against the tied embedding
+        # (no [B, T, V] logits; PT_FUSED_XENT=0 restores logits+lm_loss)
+        return model.apply({"params": p, "state": {}}, ids,
+                           method="loss"), 0.0
 
     def train_step(params, opt_state, ids):
         loss, params, opt_state, _ = opt.minimize(
@@ -594,8 +611,9 @@ def _enable_compile_cache():
 
 
 def _run_inner(args):
-    global COMPILE_ONLY
+    global COMPILE_ONLY, TINY
     COMPILE_ONLY = bool(getattr(args, "compile_only", False))
+    TINY = bool(getattr(args, "tiny", False))
     _enable_compile_cache()
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
@@ -728,6 +746,8 @@ def _run_suite(args, deadline):
         extra += ["--no-flash"]
     if args.compile_only:
         extra += ["--compile-only"]
+    if args.tiny:
+        extra += ["--tiny"]
     rows = {}
     timed_out = False  # wedge-shaped failure (hang), vs crash-shaped
     for model in _suite_list():
@@ -801,6 +821,10 @@ def main():
                     help="compile every step into the persistent XLA cache "
                          "and exit without timing (prewarm pass — timed "
                          "runs then never straddle a compile)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny model configs (CI smoke: proves the fused "
+                         "step compiles without paying the full-size "
+                         "trace; transformer-family models only)")
     ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 

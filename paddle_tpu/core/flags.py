@@ -84,6 +84,26 @@ define_flag("flash_block_k", 512,
 define_flag("use_pallas_layer_norm", True,
             "Route layer_norm through the Pallas TPU kernel; False forces "
             "the XLA twin.")
+# step-fusion: chunked softmax-cross-entropy over the vocab axis (never
+# materializes [batch, seq, vocab] logits or one-hot targets). The env
+# spelling PT_FUSED_XENT is also honored (see ops/fused.py).
+define_flag("fused_xent", True,
+            "Route model .loss() train paths through the chunked/fused "
+            "softmax-cross-entropy; False restores the reference "
+            "logits-then-loss composition.")
+define_flag("xent_chunk", 8192,
+            "Vocab-axis tile size for the fused cross-entropy (rows x chunk "
+            "logits are the largest temporary on the loss path).")
+define_flag("use_pallas_xent", True,
+            "Use the Pallas forward-stats kernel for the fused cross-"
+            "entropy on TPU; False forces the chunked XLA formulation.")
+# scan-over-layers remat policy for transformer encoders (models pass
+# cfg.remat to override per-model): nothing | dots_saveable | full
+define_flag("remat_policy", "nothing",
+            "Gradient checkpointing policy for scan-over-layers encoder "
+            "blocks: 'nothing' (save all), 'dots_saveable' (save matmul "
+            "outputs, recompute elementwise), 'full' (recompute the whole "
+            "block).")
 # flash-attention backward: Pallas dq/dkv kernels (flash-attn-2 style) vs
 # the recompute-based chunked-XLA fallback
 define_flag("flash_pallas_bwd", True,

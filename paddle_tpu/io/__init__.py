@@ -17,6 +17,8 @@ from paddle_tpu.io.checkpoint import (
     latest_step,
     load_persistables,
     save_persistables,
+    stack_layer_tree,
+    unstack_layer_tree,
 )
 from paddle_tpu.io.inference import (
     Predictor,

@@ -81,6 +81,46 @@ def load_persistables(path, template, step=None):
     return jax.tree_util.tree_unflatten(treedef, restored)
 
 
+def stack_layer_tree(tree):
+    """Up-convert a per-layer param tree to the scan-over-layers layout.
+
+    Wherever a dict's keys are exactly "0".."n-1" (the ModuleList layout of
+    the unrolled encoders) and the per-index subtrees share a structure,
+    the subtrees are stacked leaf-wise along a new leading layer axis and
+    the dict collapses to {"layer": stacked} — the nn.ScanLayers layout.
+    Checkpoints saved before scan-over-layers load with their old template
+    and convert through this (see README "Performance": checkpoint
+    migration)."""
+    if not isinstance(tree, dict) or not tree:
+        return tree
+    idx = [str(i) for i in range(len(tree))]
+    if sorted(tree.keys()) == sorted(idx) and all(
+            isinstance(tree[i], dict) for i in idx):
+        subs = [stack_layer_tree(tree[i]) for i in idx]
+        import jax.numpy as jnp
+        return {"layer": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *subs)}
+    return {k: stack_layer_tree(v) for k, v in tree.items()}
+
+
+def unstack_layer_tree(tree):
+    """Inverse of stack_layer_tree: every {"layer": stacked} subtree (the
+    nn.ScanLayers layout — a single-key dict whose leaves carry the layer
+    axis) splits back into {"0": ..., "n-1": ...} per-layer subtrees, for
+    serving paths that step layers individually (GPTDecoder KV caches)."""
+    import jax
+    if not isinstance(tree, dict):
+        return tree
+    if set(tree.keys()) == {"layer"} and isinstance(tree["layer"], dict):
+        stacked = tree["layer"]
+        leaves = jax.tree_util.tree_leaves(stacked)
+        if leaves:
+            n = leaves[0].shape[0]
+            return {str(i): unstack_layer_tree(jax.tree_util.tree_map(
+                lambda x: x[i], stacked)) for i in range(n)}
+    return {k: unstack_layer_tree(v) for k, v in tree.items()}
+
+
 def latest_step(path):
     """Find newest step dir for resume (ref: the reference had no resume
     discovery; fleet_util picked paths manually)."""

@@ -32,6 +32,8 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout: float = 0.1
     use_flash: bool = True
+    scan_layers: bool = False  # stack layer params + lax.scan over layers
+    remat: str = None          # nothing|dots_saveable|full (None -> flag)
 
     @staticmethod
     def base():
@@ -78,7 +80,13 @@ class BertEncoder(nn.Module):
         self.seg_emb = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
         self.emb_ln = nn.LayerNorm(cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.layers = [TransformerLayer(cfg) for _ in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            self.layers = nn.ScanLayers(TransformerLayer(cfg),
+                                        cfg.num_layers, remat=cfg.remat,
+                                        needs_rng=cfg.dropout > 0)
+        else:
+            self.layers = [TransformerLayer(cfg)
+                           for _ in range(cfg.num_layers)]
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         b, t = input_ids.shape
@@ -90,8 +98,11 @@ class BertEncoder(nn.Module):
         mask = None
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :]  # [B,1,1,T]
-        for layer in self.layers:
-            x = layer(x, mask=mask)
+        if self.cfg.scan_layers:
+            x = self.layers(x, mask=mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, mask=mask)
         return x
 
 
@@ -129,10 +140,37 @@ class BertForPretraining(nn.Module):
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
 
+    def loss(self, input_ids, mlm_labels, nsp_labels, mlm_mask,
+             token_type_ids=None, attention_mask=None, mask_positions=None):
+        """MLM + NSP pretraining loss as an apply() entry point. Default
+        path fuses the MLM vocab projection into the chunked cross-entropy
+        (no [B, M, V] logits, no tied-head matmul output in HBM);
+        PT_FUSED_XENT=0 restores forward() + pretrain_loss."""
+        from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        if (not fused_xent_enabled()
+                or self.encoder.tok_emb.has_p("weight_q")):
+            mlm_logits, nsp_logits = self.forward(
+                input_ids, token_type_ids, attention_mask, mask_positions)
+            return pretrain_loss(mlm_logits, nsp_logits, mlm_labels,
+                                 nsp_labels, mlm_mask)
+        h = self.encoder(input_ids, token_type_ids, attention_mask)
+        hm = h if mask_positions is None else jnp.take_along_axis(
+            h, mask_positions[..., None], axis=1)
+        mlm_h = self.mlm_ln(self.mlm_transform(hm))
+        ce = fused_xent(mlm_h, self.encoder.tok_emb.p("weight"),
+                        mlm_labels, bias=self.p("mlm_bias"))
+        mlm = (jnp.sum(ce * mlm_mask)
+               / jnp.maximum(jnp.sum(mlm_mask), 1))
+        nsp_logits = self.nsp(self.pooler(h[:, 0]))
+        nsp = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,
+                                                    nsp_labels[..., None]))
+        return mlm + nsp
+
 
 def pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
                   mlm_mask):
-    """Masked-LM + NSP loss. mlm_mask: 1.0 at masked positions."""
+    """Masked-LM + NSP loss. mlm_mask: 1.0 at masked positions. Parity
+    reference for BertForPretraining.loss's fused path."""
     mlm = L.softmax_with_cross_entropy(mlm_logits, mlm_labels[..., None])
     mlm = jnp.sum(mlm[..., 0] * mlm_mask) / jnp.maximum(jnp.sum(mlm_mask), 1)
     nsp = jnp.mean(L.softmax_with_cross_entropy(nsp_logits,

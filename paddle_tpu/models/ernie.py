@@ -41,7 +41,10 @@ class ErnieConfig(BertConfig):
 
 class ErnieForPretraining(BertForPretraining):
     """Same heads as BERT (MLM over spans + sentence-pair); the knowledge
-    masking happens in the data pipeline (knowledge_mask)."""
+    masking happens in the data pipeline (knowledge_mask). The step-fusion
+    perf surface rides along through the shared backbone: cfg.scan_layers /
+    cfg.remat (scan-over-layers encoder) and the fused .loss() entry point
+    (chunked vocab cross-entropy, PT_FUSED_XENT)."""
 
     def __init__(self, cfg: ErnieConfig):
         super().__init__(cfg)

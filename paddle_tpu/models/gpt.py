@@ -36,6 +36,8 @@ class GPTConfig:
     moe_experts: int = 0       # >0: MoE FFN with this many experts
     moe_k: int = 2
     moe_ep_axis: str = None    # mesh axis for expert parallelism
+    scan_layers: bool = False  # stack block params + lax.scan over layers
+    remat: str = None          # nothing|dots_saveable|full (None -> flag)
 
     @staticmethod
     def small():
@@ -108,10 +110,17 @@ class GPT(nn.Module):
         self.tok_emb = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
         self.pos_emb = nn.Embedding(cfg.max_position, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            self.blocks = nn.ScanLayers(GPTBlock(cfg), cfg.num_layers,
+                                        remat=cfg.remat,
+                                        needs_rng=cfg.dropout > 0)
+        else:
+            self.blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids, pos_offset=0):
+    def hidden(self, input_ids, pos_offset=0):
+        """Final post-LN hidden states [B, T, H] (the vocab head is applied
+        by forward, or fused into the loss by .loss)."""
         b, t = input_ids.shape
         if self.cfg.seq_axis is not None:
             # under shard_map the leading tokens of this shard sit at
@@ -121,14 +130,40 @@ class GPT(nn.Module):
                 self.cfg.seq_axis) * t
         pos = pos_offset + jnp.arange(t)[None, :]
         x = self.drop(self.tok_emb(input_ids) + self.pos_emb(pos))
-        for blk in self.blocks:
-            x = blk(x)
-        x = self.ln_f(x)
-        return nn.tied_vocab_head(self.tok_emb, x)
+        if self.cfg.scan_layers:
+            x = self.blocks(x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return self.ln_f(x)
+
+    def forward(self, input_ids, pos_offset=0):
+        return nn.tied_vocab_head(self.tok_emb,
+                                  self.hidden(input_ids, pos_offset))
+
+    def loss(self, input_ids, labels=None, pad_id=None):
+        """Shifted next-token CE as an apply() entry point
+        (``model.apply(vars, ids, method="loss")``). Default path: the
+        chunked fused cross-entropy against the tied embedding table —
+        no [B, T, V] logits. PT_FUSED_XENT=0 restores the
+        logits-then-lm_loss reference composition."""
+        from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        if labels is None:
+            labels = input_ids
+        h = self.hidden(input_ids)
+        if not fused_xent_enabled() or self.tok_emb.has_p("weight_q"):
+            return lm_loss(nn.tied_vocab_head(self.tok_emb, h), labels,
+                           pad_id)
+        ce = fused_xent(h[:, :-1], self.tok_emb.p("weight"), labels[:, 1:])
+        if pad_id is not None:
+            valid = (labels[:, 1:] != pad_id).astype(ce.dtype)
+            return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+        return jnp.mean(ce)
 
 
 def lm_loss(logits, labels, pad_id=None):
-    """Shifted next-token cross entropy; optionally ignores pad positions."""
+    """Shifted next-token cross entropy; optionally ignores pad positions.
+    Parity reference for GPT.loss's fused path (PT_FUSED_XENT gates)."""
     lp = logits[:, :-1]
     tgt = labels[:, 1:]
     ce = L.softmax_with_cross_entropy(lp, tgt[..., None])[..., 0]
@@ -160,6 +195,14 @@ class GPTDecoder(GPT):
     O(1)-projection step (no full-sequence recompute). No reference
     counterpart — Fluid's decoders re-ran the network per step via the
     beam_search op loop."""
+
+    def __init__(self, cfg: GPTConfig):
+        from paddle_tpu.core.enforce import enforce
+        enforce(not cfg.scan_layers,
+                "GPTDecoder steps per-layer KV caches and needs unrolled "
+                "blocks (scan_layers=False); train params saved from a "
+                "scan model convert via io.checkpoint.unstack_layer_tree")
+        super().__init__(cfg)
 
     def init_caches(self, batch, max_len, dtype=jnp.float32):
         from paddle_tpu.core.enforce import enforce

@@ -63,8 +63,9 @@ class EncoderLayer(nn.Module):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x, mask=None):
-        x = self.ln1(x + self.drop(self.attn(x, mask=mask)))
-        x = self.ln2(x + self.drop(self.fc2(A.relu(self.fc1(x)))))
+        # residual=: fused add+LN (one HBM pass, Pallas kernel on TPU)
+        x = self.ln1(self.drop(self.attn(x, mask=mask)), residual=x)
+        x = self.ln2(self.drop(self.fc2(A.relu(self.fc1(x)))), residual=x)
         return x
 
 
@@ -83,11 +84,12 @@ class DecoderLayer(nn.Module):
         self.drop = nn.Dropout(cfg.dropout)
 
     def forward(self, x, memory, self_mask=None, cross_mask=None):
-        x = self.ln1(x + self.drop(self.self_attn(x, causal=True,
-                                                  mask=self_mask)))
-        x = self.ln2(x + self.drop(self.cross_attn(x, kv=memory,
-                                                   mask=cross_mask)))
-        x = self.ln3(x + self.drop(self.fc2(A.relu(self.fc1(x)))))
+        x = self.ln1(self.drop(self.self_attn(x, causal=True,
+                                              mask=self_mask)), residual=x)
+        x = self.ln2(self.drop(self.cross_attn(x, kv=memory,
+                                               mask=cross_mask)),
+                     residual=x)
+        x = self.ln3(self.drop(self.fc2(A.relu(self.fc1(x)))), residual=x)
         return x
 
 
@@ -111,23 +113,47 @@ class Transformer(nn.Module):
             x = layer(x, mask=mask)
         return x
 
-    def decode(self, tgt, memory, src_mask=None):
+    def decode_hidden(self, tgt, memory, src_mask=None):
+        """Decoder stack output [B, T, D] before the vocab projection (the
+        fused loss consumes this directly)."""
         pe = positional_encoding(tgt.shape[1], self.cfg.d_model)
         x = self.tgt_emb(tgt) * (self.cfg.d_model ** 0.5) + pe[None]
         x = self.drop(x)
         cross = src_mask[:, None, None, :] if src_mask is not None else None
         for layer in self.dec_layers:
             x = layer(x, memory, cross_mask=cross)
-        return self.out_proj(x)
+        return x
+
+    def decode(self, tgt, memory, src_mask=None):
+        return self.out_proj(self.decode_hidden(tgt, memory, src_mask))
 
     def forward(self, src, tgt, src_mask=None):
         memory = self.encode(src, src_mask)
         return self.decode(tgt, memory, src_mask)
 
+    def loss(self, src, tgt_in, tgt_out, src_mask=None, pad_id=0,
+             label_smoothing=0.1):
+        """Label-smoothed NMT loss as an apply() entry point. Default path
+        fuses the vocab projection into the chunked cross-entropy — no
+        [B, T, V] logits and no same-shape one_hot soft labels (the two
+        HBM sinks of the reference recipe). PT_FUSED_XENT=0 restores
+        forward() + nmt_loss."""
+        from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        memory = self.encode(src, src_mask)
+        h = self.decode_hidden(tgt_in, memory, src_mask)
+        if not fused_xent_enabled() or self.out_proj.has_p("weight_q"):
+            return nmt_loss(self.out_proj(h), tgt_out, pad_id,
+                            label_smoothing)
+        ce = fused_xent(h, self.out_proj.p("weight"), tgt_out,
+                        weight_layout="hv", label_smoothing=label_smoothing)
+        valid = (tgt_out != pad_id).astype(jnp.float32)
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
 
 def nmt_loss(logits, labels, pad_id=0, label_smoothing=0.1):
     """Label-smoothed CE ignoring pads (ref: the reference transformer recipe
-    uses label_smooth + softmax_with_cross_entropy soft labels)."""
+    uses label_smooth + softmax_with_cross_entropy soft labels). Parity
+    reference for Transformer.loss's fused path (PT_FUSED_XENT gates)."""
     vocab = logits.shape[-1]
     valid = (labels != pad_id).astype(jnp.float32)
     import jax

@@ -26,6 +26,17 @@ def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
 
 
+def _pmean_varying(x, axis_name):
+    """pmean whose output is typed varying-over-axis where the type system
+    exists: jax >= 0.6 shard_map (check_vma) needs the explicit pcast so
+    both lax.cond branches carry the same type; jax 0.4.x (check_rep) has
+    no lax.pcast and needs no cast back."""
+    out = lax.pmean(x, axis_name)
+    if hasattr(lax, "pcast"):
+        out = lax.pcast(out, axis_name, to="varying")
+    return out
+
+
 class GradientMerge:
     """Accumulate `merge_steps` gradients, then apply their mean once.
 
@@ -115,9 +126,8 @@ class LocalSGD:
             do_sync,
             # pmean output is unvarying over the axis; pcast back to varying
             # so both cond branches carry the same shard_map type
-            lambda p: _tmap(lambda x: lax.pcast(
-                lax.pmean(x, self.axis_name), self.axis_name, to="varying"),
-                p),
+            lambda p: _tmap(
+                lambda x: _pmean_varying(x, self.axis_name), p),
             lambda p: p, params)
         since = jnp.where(do_sync, 0, since)
         return loss, params, {"inner": inner, "since_sync": since}, aux
@@ -162,9 +172,8 @@ class DCASGD:
         comp = _tmap(
             lambda g, a, p: g + self.lambda_ * g * g * (a - p),
             grads, anchor, params)
-        mean_comp = _tmap(lambda d: lax.pcast(
-            lax.pmean(d, self.axis_name), self.axis_name, to="varying"),
-            comp)
+        mean_comp = _tmap(
+            lambda d: _pmean_varying(d, self.axis_name), comp)
         anchor = _tmap(lambda a, d: a - self.lr * d, anchor, mean_comp)
         since = state["since_pull"] + 1
         do_pull = since >= self.pull_steps
@@ -203,9 +212,8 @@ class GeoSGD:
         def sync_branch(operand):
             params, anchor = operand
             delta = _tmap(lambda p, a: p - a, params, anchor)
-            mean_delta = _tmap(lambda d: lax.pcast(
-                lax.pmean(d, self.axis_name), self.axis_name, to="varying"),
-                delta)
+            mean_delta = _tmap(
+                lambda d: _pmean_varying(d, self.axis_name), delta)
             new_anchor = _tmap(lambda a, d: a + d, anchor, mean_delta)
             return new_anchor, new_anchor
 

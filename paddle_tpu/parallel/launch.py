@@ -42,6 +42,41 @@ def init_distributed(coordinator_address=None, num_processes=None,
     return True
 
 
+def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0):
+    """All-gather host numpy arrays across local processes via the shared
+    filesystem — no XLA collectives, so it works on backends where
+    multi-process computations are unimplemented (jax 0.4.x CPU, where
+    multihost_utils.process_allgather raises inside the worker). Each
+    rank atomically publishes its array (temp file + os.replace), then
+    polls for the others. `tag` must be unique per collective call site.
+    Returns [world, *arr.shape]."""
+    import time as _time
+
+    import numpy as np
+
+    os.makedirs(exchange_dir, exist_ok=True)
+    arr = np.asarray(arr)
+    tmp = os.path.join(exchange_dir, f".{tag}_{rank}.tmp.npy")
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+    os.replace(tmp, os.path.join(exchange_dir, f"{tag}_{rank}.npy"))
+    out = []
+    deadline = _time.monotonic() + timeout
+    for r in range(world):
+        path = os.path.join(exchange_dir, f"{tag}_{r}.npy")
+        while True:
+            try:
+                out.append(np.load(path))
+                break
+            except (FileNotFoundError, ValueError):  # absent / mid-replace
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"host_allgather({tag}): rank {r} did not publish "
+                        f"within {timeout}s")
+                _time.sleep(0.02)
+    return np.stack(out)
+
+
 def launch_local(nproc, script, script_args=(), base_port=12355,
                  env_extra=None):
     """Spawn nproc local processes wired into one JAX distributed job

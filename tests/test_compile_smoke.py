@@ -1,0 +1,30 @@
+"""Tier-1 jit-compilability smoke for the fused train step (no silicon).
+
+Drives ``python bench.py --compile-only --model gpt --tiny`` through
+tools/compile_smoke.py: the chunked fused cross-entropy (custom VJP), the
+scan-over-layers + remat GPT encoder, and the fused LN path must lower AND
+compile inside one jitted train step on the CPU backend. This is the
+in-suite stand-in for the silicon bench while the tunnel is down — a
+trace-time regression in the step-fusion layer fails here, not in the
+next bench window.
+"""
+
+import pytest
+
+
+@pytest.mark.perf
+def test_bench_gpt_compile_only_tiny():
+    import tools.compile_smoke as cs
+    row = cs.run(model="gpt", tiny=True, timeout=420)
+    assert row["metric"] == "gpt_compile_only"
+    assert row["value"] == 1.0 and row["unit"] == "compiled"
+
+
+@pytest.mark.perf
+def test_bench_gpt_compile_only_tiny_remat():
+    """The remat-enabled scan step must also compile (dots_saveable is
+    the policy the silicon runs will flip on first)."""
+    import tools.compile_smoke as cs
+    row = cs.run(model="gpt", tiny=True, timeout=420,
+                 extra_env={"PT_BENCH_REMAT": "dots_saveable"})
+    assert row["metric"] == "gpt_compile_only"

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from paddle_tpu.parallel.pipeline import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as pt

@@ -408,7 +408,7 @@ class TestGPT:
     def test_sequence_parallel_matches_single_device(self):
         # seq_axis: the WHOLE forward under shard_map with the sequence
         # sharded over 8 devices must match the single-device forward
-        from jax import shard_map
+        from paddle_tpu.parallel.pipeline import shard_map
         from jax.sharding import PartitionSpec as P
 
         import paddle_tpu as pt

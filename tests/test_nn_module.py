@@ -106,12 +106,15 @@ def test_mha_layer():
 
 
 def test_spectral_norm():
-    m = nn.SpectralNorm((8, 4))
+    # 2 power iterations: 1 leaves sigma at ~1.53 on this jax/BLAS (the
+    # random u/v start), 2 converges to ~1.11 — comfortably inside the
+    # roughly-unit-spectral-norm bound
+    m = nn.SpectralNorm((8, 4), power_iters=2)
     v = m.init(jax.random.key(0))
     w = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype(np.float32))
     wn, new_state = m.apply(v, w, training=True)
     s = np.linalg.svd(np.asarray(wn), compute_uv=False)
-    assert s[0] < 1.5  # roughly unit spectral norm after 1 power iter
+    assert s[0] < 1.5
 
 
 def test_profiler_trace_op_table(tmp_path):

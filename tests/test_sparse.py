@@ -360,8 +360,11 @@ class TestShardedHostTable:
 
     def test_two_process_sharded_serving(self, tmp_path):
         """Each of 2 real processes serves its shard; the pull completes
-        with a psum over the 'ps' mesh axis (the RPC-as-collective design;
-        ref fleet_wrapper.h:55 + downpour_worker.cc)."""
+        with a host-side all-gather (launch.host_allgather — the
+        RPC-as-collective design, ref fleet_wrapper.h:55 +
+        downpour_worker.cc, over the shared filesystem: jax 0.4.x's CPU
+        backend refuses multi-process XLA collectives, and the exchange
+        is host data either way)."""
         script = tmp_path / "ps_worker.py"
         script.write_text(
             "import os, sys\n"
@@ -371,29 +374,31 @@ class TestShardedHostTable:
             "from paddle_tpu.parallel import launch\n"
             "launch.init_distributed()\n"
             "import numpy as np\n"
-            "from jax.experimental import multihost_utils\n"
             "from paddle_tpu.optimizer.optimizers import SGD\n"
             "from paddle_tpu.parallel.sparse import ShardedHostTable\n"
             "rank = jax.process_index()\n"
+            "xdir = os.environ['PT_EXCHANGE_DIR']\n"
             "tbl = ShardedHostTable(4, 32, rank, 2, optimizer=SGD(0.1),\n"
             "                       seed=9)\n"
             "uniq = np.array([2, 5, 8, 13])\n"
             "buf, ctx = tbl.pull_local(uniq, return_ctx=True)\n"
-            "gathered = multihost_utils.process_allgather(buf)  # [2, k, D]\n"
-            "rows = np.asarray(gathered).sum(0)    # complete the pull\n"
+            "gathered = launch.host_allgather(buf, rank, 2, xdir, 'pull1')\n"
+            "rows = gathered.sum(0)                # complete the pull\n"
             "# every sign's row must be nonzero after the exchange\n"
             "assert (np.abs(rows).sum(-1) > 0).all(), rows\n"
             "# update owned rows only; re-pull must reflect the sgd step\n"
             "tbl.push_local(np.ones((4, 4), np.float32), ctx)\n"
             "buf2 = tbl.pull_local(uniq)\n"
-            "rows2 = np.asarray(\n"
-            "    multihost_utils.process_allgather(buf2)).sum(0)\n"
+            "rows2 = launch.host_allgather(buf2, rank, 2, xdir,\n"
+            "                              'pull2').sum(0)\n"
             "np.testing.assert_allclose(rows2, rows - 0.1, atol=1e-6)\n"
             "print('rank', rank, 'sharded pull/push OK')\n")
         import os
         from paddle_tpu.parallel import launch as launch_mod
         port = 21000 + os.getpid() % 9000
-        ps = launch_mod.launch_local(2, str(script), base_port=port)
+        ps = launch_mod.launch_local(
+            2, str(script), base_port=port,
+            env_extra={"PT_EXCHANGE_DIR": str(tmp_path / "exchange")})
         launch_mod.wait_all(ps, timeout=120)
 
 

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""CI smoke: prove the fused train step jit-compiles without silicon.
+
+Runs ``python bench.py --compile-only --model <m>`` on the CPU backend and
+asserts the compile-marker row lands. This is the tier-1 guard for the
+step-fusion layer: the chunked fused cross-entropy (custom VJP), the
+scan-over-layers + remat encoders, and the fused add+LN path all have to
+lower and compile inside one jitted train step — a regression in any of
+them trips here, not in the next silicon bench window.
+
+Usage:
+  python tools/compile_smoke.py                  # gpt, full-size config
+  python tools/compile_smoke.py --tiny           # tiny config (CI budget)
+  python tools/compile_smoke.py --model bert --tiny
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(model="gpt", tiny=False, timeout=600, extra_env=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    args = [sys.executable, os.path.join(REPO, "bench.py"),
+            "--compile-only", "--model", model]
+    if tiny:
+        args.append("--tiny")
+    proc = subprocess.run(args, stdout=subprocess.PIPE, text=True,
+                          timeout=timeout, env=env, cwd=REPO)
+    lines = proc.stdout.strip().splitlines()
+    if not lines:
+        raise SystemExit(f"no bench output (rc={proc.returncode})")
+    row = json.loads(lines[-1])
+    if not str(row.get("metric", "")).endswith("_compile_only"):
+        raise SystemExit(f"fused step failed to compile: {row}")
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt")
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--timeout", type=float, default=600)
+    args = ap.parse_args()
+    row = run(args.model, args.tiny, args.timeout)
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,63 @@ def _cost_flops(jitted, *args):
 
 COMPILE_ONLY = False
 TINY = False
+DUMP_HLO = None    # --dump-hlo: write the compiled (post-SPMD) HLO text
+MESH_AXES = None   # --mesh: {"dp": 2, "tp": 2} parsed from "dp2,tp2"
+
+
+def _parse_mesh(spec):
+    """"dp2,tp2" -> {"dp": 2, "tp": 2}. A bare trailing-digit-less axis
+    means: the FIRST such axis takes the remaining devices (-1), later
+    ones default to 2 — so "--mesh dp,tp" reads as dp x tp=2."""
+    if not spec:
+        return None
+    import re
+    axes = {}
+    first_bare = True
+    for part in spec.split(","):
+        m = re.fullmatch(r"([a-z]+)(\d*)", part.strip())
+        if not m:
+            raise SystemExit(f"--mesh: cannot parse {part!r} "
+                             "(want e.g. dp2,tp2)")
+        name, size = m.group(1), m.group(2)
+        if size:
+            axes[name] = int(size)
+        else:
+            axes[name] = -1 if first_bare else 2
+            first_bare = False
+    return axes
+
+
+def _mesh_setup(params, opt, cfg_vocab, batch):
+    """Build the dp x tp mesh, shard params with the Megatron-flavored LM
+    plan (vocab-dim embedding/projection over tp), and return everything
+    the sharded step needs. Returns (mesh, params, opt_state, vocab_axis,
+    batch_axis, batch) — batch rounded up to a dp multiple."""
+    import paddle_tpu as pt
+    mesh = pt.parallel.make_mesh(dict(MESH_AXES))
+    dp = mesh.shape.get("dp", 1)
+    tp = mesh.shape.get("tp", 1)
+    batch = ((batch + dp - 1) // dp) * dp
+    MESH_AXES.update({k: int(v) for k, v in mesh.shape.items()})
+    params = pt.parallel.tp_lm_sharding(mesh, params)
+    opt_state = opt.init(params)
+    vocab_axis = "tp" if tp > 1 and cfg_vocab % tp == 0 else None
+    if tp > 1 and cfg_vocab % tp:
+        print(f"--mesh: vocab {cfg_vocab} not divisible by tp={tp}; "
+              "fused xent runs unsharded", file=sys.stderr)
+    batch_axis = "dp" if dp > 1 else None
+    return mesh, params, opt_state, vocab_axis, batch_axis, batch
+
+
+def _mesh_ctx(mesh):
+    import contextlib
+    return mesh if mesh is not None else contextlib.nullcontext()
+
+
+def _mesh_row(row):
+    if MESH_AXES:
+        row["mesh"] = dict(MESH_AXES)
+    return row
 
 
 def _scan_env(cfg):
@@ -70,12 +127,19 @@ def _co(name, jitted, *args):
     cache so later bench runs start executing immediately) and stop.
     Both round-4 tunnel wedges followed a client kill mid-XLA-compile —
     prewarming moves every compile into one pass so timed bench attempts
-    never straddle a compile."""
+    never straddle a compile. --dump-hlo additionally writes the compiled
+    (post-SPMD-partitioning, per-device shapes) HLO text — what
+    tools/compile_smoke.py greps for full-vocab-scale temporaries."""
     t0 = time.perf_counter()
-    jitted.lower(*args).compile()
-    return {"metric": f"{name}_compile_only", "value": 1.0,
-            "unit": "compiled", "vs_baseline": 0.0,
-            "compile_s": round(time.perf_counter() - t0, 1)}
+    compiled = jitted.lower(*args).compile()
+    row = {"metric": f"{name}_compile_only", "value": 1.0,
+           "unit": "compiled", "vs_baseline": 0.0,
+           "compile_s": round(time.perf_counter() - t0, 1)}
+    if DUMP_HLO:
+        with open(DUMP_HLO, "w") as f:
+            f.write(compiled.as_text())
+        row["hlo"] = DUMP_HLO
+    return _mesh_row(row)
 
 
 def _timed_steps(step_once, steps):
@@ -149,7 +213,12 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
 
     policy = pt.amp.bf16_policy()
     opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
-    opt_state = opt.init(params)
+    mesh = vocab_axis = batch_axis = None
+    if MESH_AXES:
+        mesh, params, opt_state, vocab_axis, batch_axis, batch = \
+            _mesh_setup(params, opt, cfg.vocab_size, batch)
+    else:
+        opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq), dtype=np.int32))
@@ -172,11 +241,24 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
             rng.randint(0, cfg.vocab_size, (batch, n_mask), dtype=np.int32))
         mask = jnp.ones((batch, n_mask), jnp.float32)
 
+    if mesh is not None:
+        # dp-shard the host batch; the sharded train state keeps its
+        # donate_argnums (donation works per-shard under pjit/GSPMD)
+        ids, mlm_labels, nsp_labels, mask = (
+            pt.parallel.shard_batch(mesh, x) for x in (
+                ids, mlm_labels, nsp_labels, mask))
+        if mask_pos is not None:
+            mask_pos = pt.parallel.shard_batch(mesh, mask_pos)
+
     def loss_fn(p, ids, mlm_l, nsp_l, m):
         # .loss entry point: chunked fused vocab cross-entropy (no
-        # [B, M, V] logits; PT_FUSED_XENT=0 restores logits+pretrain_loss)
+        # [B, M, V] logits; PT_FUSED_XENT=0 restores logits+pretrain_loss).
+        # Under --mesh the vocab-sharded fused path combines per-shard
+        # stats with pmax/psum instead of gathering the tied table.
         return model.apply({"params": p, "state": {}}, ids, mlm_l, nsp_l, m,
-                           mask_positions=mask_pos, method="loss"), 0.0
+                           mask_positions=mask_pos, method="loss",
+                           vocab_axis=vocab_axis, batch_axis=batch_axis,
+                           mesh=mesh), 0.0
 
     def train_step(params, opt_state, ids, mlm_l, nsp_l, m):
         loss, params, opt_state, _ = opt.minimize(
@@ -184,15 +266,16 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    if COMPILE_ONLY:
-        return _co(name, jitted, params, opt_state, ids, mlm_labels,
-                   nsp_labels, mask)
-    flops_per_step = _cost_flops(jitted, params, opt_state, ids, mlm_labels,
-                                 nsp_labels, mask)
-    # warmup/compile
-    loss, params, opt_state = jitted(params, opt_state, ids, mlm_labels,
-                                     nsp_labels, mask)
-    _ = float(loss)
+    with _mesh_ctx(mesh):
+        if COMPILE_ONLY:
+            return _co(name, jitted, params, opt_state, ids, mlm_labels,
+                       nsp_labels, mask)
+        flops_per_step = _cost_flops(jitted, params, opt_state, ids,
+                                     mlm_labels, nsp_labels, mask)
+        # warmup/compile
+        loss, params, opt_state = jitted(params, opt_state, ids, mlm_labels,
+                                         nsp_labels, mask)
+        _ = float(loss)
 
     st = {"params": params, "opt": opt_state}
 
@@ -205,7 +288,7 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
     tokens_per_sec = batch * seq / dt
     achieved = flops_per_step / dt if flops_per_step else 0.0
     mfu = achieved / peak_flops()
-    return {
+    return _mesh_row({
         "metric": f"{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
@@ -214,7 +297,7 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
         "loss": loss_v,
         "flash": bool(use_flash),
         "seq": seq,
-    }
+    })
 
 
 def bench_transformer(steps, batch, seq):
@@ -234,7 +317,12 @@ def bench_transformer(steps, batch, seq):
 
     policy = pt.amp.bf16_policy()
     opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
-    opt_state = opt.init(params)
+    mesh = vocab_axis = batch_axis = None
+    if MESH_AXES:
+        mesh, params, opt_state, vocab_axis, batch_axis, batch = \
+            _mesh_setup(params, opt, cfg.tgt_vocab, batch)
+    else:
+        opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)
     src = jnp.asarray(rng.randint(1, cfg.src_vocab, (batch, seq),
@@ -243,12 +331,17 @@ def bench_transformer(steps, batch, seq):
                                      dtype=np.int32))
     tgt_out = jnp.asarray(rng.randint(1, cfg.tgt_vocab, (batch, seq),
                                       dtype=np.int32))
+    if mesh is not None:
+        src, tgt_in, tgt_out = (pt.parallel.shard_batch(mesh, x)
+                                for x in (src, tgt_in, tgt_out))
 
     def loss_fn(p, src, tgt_in, tgt_out):
         # .loss entry point: fused label-smoothed vocab cross-entropy (no
-        # [B, T, V] logits or one-hot; PT_FUSED_XENT=0 restores nmt_loss)
+        # [B, T, V] logits or one-hot; PT_FUSED_XENT=0 restores nmt_loss).
+        # Under --mesh the hv-layout out_proj stays vocab-sharded.
         return model.apply({"params": p, "state": {}}, src, tgt_in, tgt_out,
-                           method="loss"), 0.0
+                           method="loss", vocab_axis=vocab_axis,
+                           batch_axis=batch_axis, mesh=mesh), 0.0
 
     def train_step(params, opt_state, src, tgt_in, tgt_out):
         loss, params, opt_state, _ = opt.minimize(
@@ -256,13 +349,15 @@ def bench_transformer(steps, batch, seq):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    if COMPILE_ONLY:
-        return _co("transformer_big", jitted, params, opt_state, src, tgt_in,
-                   tgt_out)
-    flops_per_step = _cost_flops(jitted, params, opt_state, src, tgt_in,
-                                 tgt_out)
-    loss, params, opt_state = jitted(params, opt_state, src, tgt_in, tgt_out)
-    _ = float(loss)
+    with _mesh_ctx(mesh):
+        if COMPILE_ONLY:
+            return _co("transformer_big", jitted, params, opt_state, src,
+                       tgt_in, tgt_out)
+        flops_per_step = _cost_flops(jitted, params, opt_state, src, tgt_in,
+                                     tgt_out)
+        loss, params, opt_state = jitted(params, opt_state, src, tgt_in,
+                                         tgt_out)
+        _ = float(loss)
 
     st = {"params": params, "opt": opt_state}
 
@@ -274,7 +369,7 @@ def bench_transformer(steps, batch, seq):
     dt, loss_v = _timed_steps(step_once, steps)
     achieved = flops_per_step / dt if flops_per_step else 0.0
     mfu = achieved / peak_flops()
-    return {
+    return _mesh_row({
         "metric": "transformer_big_tokens_per_sec_per_chip",
         "value": round(batch * seq / dt, 1),
         "unit": "tokens/s/chip",
@@ -282,7 +377,7 @@ def bench_transformer(steps, batch, seq):
         "step_ms": round(dt * 1e3, 2),
         "loss": loss_v,
         "seq": seq,
-    }
+    })
 
 
 def bench_gpt_decode(steps, batch, seq):
@@ -398,17 +493,26 @@ def bench_gpt(steps, batch, seq):
 
     policy = pt.amp.bf16_policy()
     opt = pt.amp.decorate(pt.optimizer.Adam(1e-4), policy)
-    opt_state = opt.init(params)
+    mesh = vocab_axis = batch_axis = None
+    if MESH_AXES:
+        mesh, params, opt_state, vocab_axis, batch_axis, batch = \
+            _mesh_setup(params, opt, cfg.vocab_size, batch)
+    else:
+        opt_state = opt.init(params)
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq),
                                   dtype=np.int32))
+    if mesh is not None:
+        ids = pt.parallel.shard_batch(mesh, ids)
 
     def loss_fn(p, ids):
         # .loss entry point: fused shifted CE against the tied embedding
-        # (no [B, T, V] logits; PT_FUSED_XENT=0 restores logits+lm_loss)
+        # (no [B, T, V] logits; PT_FUSED_XENT=0 restores logits+lm_loss).
+        # Under --mesh the tied table stays vocab-sharded over tp.
         return model.apply({"params": p, "state": {}}, ids,
-                           method="loss"), 0.0
+                           method="loss", vocab_axis=vocab_axis,
+                           batch_axis=batch_axis, mesh=mesh), 0.0
 
     def train_step(params, opt_state, ids):
         loss, params, opt_state, _ = opt.minimize(
@@ -416,11 +520,12 @@ def bench_gpt(steps, batch, seq):
         return loss, params, opt_state
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1))
-    if COMPILE_ONLY:
-        return _co("gpt", jitted, params, opt_state, ids)
-    flops_per_step = _cost_flops(jitted, params, opt_state, ids)
-    loss, params, opt_state = jitted(params, opt_state, ids)
-    _ = float(loss)
+    with _mesh_ctx(mesh):
+        if COMPILE_ONLY:
+            return _co("gpt", jitted, params, opt_state, ids)
+        flops_per_step = _cost_flops(jitted, params, opt_state, ids)
+        loss, params, opt_state = jitted(params, opt_state, ids)
+        _ = float(loss)
 
     st = {"params": params, "opt": opt_state}
 
@@ -431,7 +536,7 @@ def bench_gpt(steps, batch, seq):
     dt, loss_v = _timed_steps(step_once, steps)
     achieved = flops_per_step / dt if flops_per_step else 0.0
     mfu = achieved / peak_flops()
-    return {
+    return _mesh_row({
         "metric": "gpt_small_tokens_per_sec_per_chip",
         "value": round(batch * seq / dt, 1),
         "unit": "tokens/s/chip",
@@ -439,7 +544,7 @@ def bench_gpt(steps, batch, seq):
         "step_ms": round(dt * 1e3, 2),
         "loss": loss_v,
         "seq": seq,
-    }
+    })
 
 
 def bench_resnet(steps, batch):
@@ -611,9 +716,16 @@ def _enable_compile_cache():
 
 
 def _run_inner(args):
-    global COMPILE_ONLY, TINY
+    global COMPILE_ONLY, TINY, DUMP_HLO, MESH_AXES
     COMPILE_ONLY = bool(getattr(args, "compile_only", False))
     TINY = bool(getattr(args, "tiny", False))
+    DUMP_HLO = getattr(args, "dump_hlo", None)
+    MESH_AXES = _parse_mesh(getattr(args, "mesh", None))
+    if MESH_AXES and args.model not in ("bert", "ernie", "gpt",
+                                        "transformer_big"):
+        raise SystemExit(f"--mesh supports the transformer LM rows "
+                         f"(bert/ernie/gpt/transformer_big), not "
+                         f"{args.model}")
     _enable_compile_cache()
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
@@ -757,10 +869,16 @@ def _run_suite(args, deadline):
                   file=sys.stderr)
             timed_out = True
             break
+        # --mesh only applies to the transformer LM rows; other suite
+        # rows keep their single-chip configuration
+        mesh_extra = (["--mesh", args.mesh]
+                      if args.mesh and model in ("bert", "ernie", "gpt",
+                                                 "transformer_big")
+                      else [])
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--model", model, *extra, "--_inner"],
+                 "--model", model, *extra, *mesh_extra, "--_inner"],
                 stdout=subprocess.PIPE, text=True,
                 timeout=min(per_model_cap, remaining - 10))
         except subprocess.TimeoutExpired:
@@ -825,6 +943,16 @@ def main():
                     help="tiny model configs (CI smoke: proves the fused "
                          "step compiles without paying the full-size "
                          "trace; transformer-family models only)")
+    ap.add_argument("--mesh", default=None,
+                    help="dp x tp sharded train step, e.g. 'dp2,tp2': "
+                         "params shard with the Megatron LM plan (vocab-"
+                         "dim embedding over tp), the batch over dp, and "
+                         "the fused cross-entropy runs vocab-sharded. "
+                         "bert/ernie/gpt/transformer_big only.")
+    ap.add_argument("--dump-hlo", default=None,
+                    help="with --compile-only: write the compiled (post-"
+                         "SPMD) HLO text here (tools/compile_smoke.py "
+                         "asserts no full-vocab temporaries on it)")
     ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 

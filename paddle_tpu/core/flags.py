@@ -97,6 +97,12 @@ define_flag("xent_chunk", 8192,
 define_flag("use_pallas_xent", True,
             "Use the Pallas forward-stats kernel for the fused cross-"
             "entropy on TPU; False forces the chunked XLA formulation.")
+# fused-xent backward: Pallas dh + dw/db kernels recomputing chunk
+# probabilities from the saved logsumexp (flash-attn-2 style) vs the
+# chunked-XLA recompute
+define_flag("use_pallas_xent_bwd", True,
+            "Use the Pallas backward kernels for the fused cross-entropy "
+            "on TPU; False falls back to the chunked XLA recompute.")
 # scan-over-layers remat policy for transformer encoders (models pass
 # cfg.remat to override per-model): nothing | dots_saveable | full
 define_flag("remat_policy", "nothing",

@@ -141,11 +141,17 @@ class BertForPretraining(nn.Module):
         return mlm_logits, nsp_logits
 
     def loss(self, input_ids, mlm_labels, nsp_labels, mlm_mask,
-             token_type_ids=None, attention_mask=None, mask_positions=None):
+             token_type_ids=None, attention_mask=None, mask_positions=None,
+             vocab_axis=None, batch_axis=None, mesh=None):
         """MLM + NSP pretraining loss as an apply() entry point. Default
         path fuses the MLM vocab projection into the chunked cross-entropy
         (no [B, M, V] logits, no tied-head matmul output in HBM);
-        PT_FUSED_XENT=0 restores forward() + pretrain_loss."""
+        PT_FUSED_XENT=0 restores forward() + pretrain_loss.
+
+        vocab_axis/batch_axis: mesh axis names when the tied embedding
+        (and mlm_bias) are vocab-partitioned and the batch dp-sharded
+        under GSPMD — the fused CE then runs per vocab shard with
+        pmax/psum combines instead of gathering the table."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
         if (not fused_xent_enabled()
                 or self.encoder.tok_emb.has_p("weight_q")):
@@ -158,7 +164,9 @@ class BertForPretraining(nn.Module):
             h, mask_positions[..., None], axis=1)
         mlm_h = self.mlm_ln(self.mlm_transform(hm))
         ce = fused_xent(mlm_h, self.encoder.tok_emb.p("weight"),
-                        mlm_labels, bias=self.p("mlm_bias"))
+                        mlm_labels, bias=self.p("mlm_bias"),
+                        vocab_axis=vocab_axis, batch_axis=batch_axis,
+                        mesh=mesh)
         mlm = (jnp.sum(ce * mlm_mask)
                / jnp.maximum(jnp.sum(mlm_mask), 1))
         nsp_logits = self.nsp(self.pooler(h[:, 0]))

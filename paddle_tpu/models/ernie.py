@@ -44,7 +44,10 @@ class ErnieForPretraining(BertForPretraining):
     masking happens in the data pipeline (knowledge_mask). The step-fusion
     perf surface rides along through the shared backbone: cfg.scan_layers /
     cfg.remat (scan-over-layers encoder) and the fused .loss() entry point
-    (chunked vocab cross-entropy, PT_FUSED_XENT)."""
+    (chunked vocab cross-entropy, PT_FUSED_XENT) — including the
+    vocab-sharded GSPMD path (.loss(vocab_axis="tp", batch_axis="dp")
+    with the tied table P(tp, None), inherited from
+    BertForPretraining.loss)."""
 
     def __init__(self, cfg: ErnieConfig):
         super().__init__(cfg)

@@ -141,12 +141,18 @@ class GPT(nn.Module):
         return nn.tied_vocab_head(self.tok_emb,
                                   self.hidden(input_ids, pos_offset))
 
-    def loss(self, input_ids, labels=None, pad_id=None):
+    def loss(self, input_ids, labels=None, pad_id=None, vocab_axis=None,
+             batch_axis=None, mesh=None):
         """Shifted next-token CE as an apply() entry point
         (``model.apply(vars, ids, method="loss")``). Default path: the
         chunked fused cross-entropy against the tied embedding table —
         no [B, T, V] logits. PT_FUSED_XENT=0 restores the
-        logits-then-lm_loss reference composition."""
+        logits-then-lm_loss reference composition.
+
+        vocab_axis/batch_axis: mesh axis names when the tied embedding is
+        vocab-partitioned (P(tp, None)) and the batch dp-sharded under
+        GSPMD — the fused CE then runs per vocab shard with pmax/psum
+        combines instead of gathering the table (ops/fused.py)."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
         if labels is None:
             labels = input_ids
@@ -154,7 +160,9 @@ class GPT(nn.Module):
         if not fused_xent_enabled() or self.tok_emb.has_p("weight_q"):
             return lm_loss(nn.tied_vocab_head(self.tok_emb, h), labels,
                            pad_id)
-        ce = fused_xent(h[:, :-1], self.tok_emb.p("weight"), labels[:, 1:])
+        ce = fused_xent(h[:, :-1], self.tok_emb.p("weight"), labels[:, 1:],
+                        vocab_axis=vocab_axis, batch_axis=batch_axis,
+                        mesh=mesh)
         if pad_id is not None:
             valid = (labels[:, 1:] != pad_id).astype(ce.dtype)
             return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)

@@ -132,12 +132,18 @@ class Transformer(nn.Module):
         return self.decode(tgt, memory, src_mask)
 
     def loss(self, src, tgt_in, tgt_out, src_mask=None, pad_id=0,
-             label_smoothing=0.1):
+             label_smoothing=0.1, vocab_axis=None, batch_axis=None,
+             mesh=None):
         """Label-smoothed NMT loss as an apply() entry point. Default path
         fuses the vocab projection into the chunked cross-entropy — no
         [B, T, V] logits and no same-shape one_hot soft labels (the two
         HBM sinks of the reference recipe). PT_FUSED_XENT=0 restores
-        forward() + nmt_loss."""
+        forward() + nmt_loss.
+
+        vocab_axis/batch_axis: mesh axis names when out_proj is
+        vocab-partitioned (P(None, tp), the hv layout) and the batch
+        dp-sharded under GSPMD — the fused CE then runs per vocab shard
+        with pmax/psum combines instead of gathering the projection."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
         memory = self.encode(src, src_mask)
         h = self.decode_hidden(tgt_in, memory, src_mask)
@@ -145,7 +151,9 @@ class Transformer(nn.Module):
             return nmt_loss(self.out_proj(h), tgt_out, pad_id,
                             label_smoothing)
         ce = fused_xent(h, self.out_proj.p("weight"), tgt_out,
-                        weight_layout="hv", label_smoothing=label_smoothing)
+                        weight_layout="hv", label_smoothing=label_smoothing,
+                        vocab_axis=vocab_axis, batch_axis=batch_axis,
+                        mesh=mesh)
         valid = (tgt_out != pad_id).astype(jnp.float32)
         return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 

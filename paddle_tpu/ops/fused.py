@@ -72,8 +72,13 @@ def _chunk_logits(h, w, b, c0, c1, layout):
     return logits + b[c0:c1].astype(jnp.float32)[None, :]
 
 
-def _xent_stats_xla(h, w, b, labels, layout, chunk, need_sum):
-    """Online (logz, picked, sum_logits) per row, vocab tiled by `chunk`."""
+def _xent_stats_parts(h, w, b, labels, layout, chunk, need_sum):
+    """Online (m, s, picked, sum_logits) per row, vocab tiled by `chunk`.
+
+    Out-of-range labels contribute 0 to `picked` — the vocab-sharded
+    caller exploits this: each shard passes labels offset by its base, so
+    only the owning shard's `picked` is nonzero and a cross-shard psum
+    recovers the label logit."""
     n = h.shape[0]
     v = w.shape[0] if layout == "vh" else w.shape[1]
     m = jnp.full((n,), -jnp.inf, jnp.float32)
@@ -94,7 +99,26 @@ def _xent_stats_xla(h, w, b, labels, layout, chunk, need_sum):
                 axis=1)[:, 0], 0.0)
         if need_sum:
             sl = sl + jnp.sum(logits, axis=1)
+    return m, s, picked, sl
+
+
+def _xent_stats_xla(h, w, b, labels, layout, chunk, need_sum):
+    """Online (logz, picked, sum_logits) per row, vocab tiled by `chunk`."""
+    m, s, picked, sl = _xent_stats_parts(h, w, b, labels, layout, chunk,
+                                         need_sum)
     return m + jnp.log(s), picked, sl
+
+
+def _loss_from_stats(logz, picked, sl, v, ls):
+    """The smoothed-CE closed form from the three per-row reductions. `v`
+    is the GLOBAL vocab size — under vocab sharding the stats arrive
+    already combined across shards but the smoothing constants still span
+    the whole vocab."""
+    if ls:
+        sn = ls / (v - 1)
+        sp = 1.0 - ls
+        return (sp - sn) * (logz - picked) + sn * (v * logz - sl)
+    return logz - picked
 
 
 def _xent_forward(h, w, b, labels, layout, ls, chunk):
@@ -107,13 +131,7 @@ def _xent_forward(h, w, b, labels, layout, ls, chunk):
         stats = _xent_stats_xla(h, w, b, labels, layout, chunk,
                                 need_sum=ls != 0.0)
     logz, picked, sl = stats
-    if ls:
-        sn = ls / (v - 1)
-        sp = 1.0 - ls
-        loss = (sp - sn) * (logz - picked) + sn * (v * logz - sl)
-    else:
-        loss = logz - picked
-    return loss, logz
+    return _loss_from_stats(logz, picked, sl, v, ls), logz
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -126,12 +144,19 @@ def _fx_fwd(h, w, b, labels, layout, ls, chunk):
     return loss, (h, w, b, labels, logz)
 
 
-def _fx_bwd(layout, ls, chunk, res, g):
-    h, w, b, labels, logz = res
+def _xent_bwd_impl(h, w, b, labels, logz, g, layout, sn, sp, chunk,
+                   context=""):
+    """(dh, dw, db) in f32 for per-row cotangent g [N] f32 — the Pallas
+    backward kernels when they apply (vh layout, TPU/interpret, flag on),
+    else the chunked XLA recompute. Labels may be out of range (the
+    vocab-sharded per-shard call): they never hit, so the one-hot term is
+    zero on non-owning shards, exactly the sharded math."""
+    if layout == "vh":
+        from paddle_tpu.ops.pallas.xent import xent_bwd
+        out = xent_bwd(h, w, b, labels, logz, g, sn, sp, context=context)
+        if out is not None:
+            return out
     v = w.shape[0] if layout == "vh" else w.shape[1]
-    sn = ls / (v - 1) if ls else 0.0
-    sp = 1.0 - ls if ls else 1.0
-    g = g.astype(jnp.float32)
     dh = jnp.zeros(h.shape, jnp.float32)
     dw_parts, db_parts = [], []
     for c0, c1 in _vocab_chunks(v, chunk):
@@ -158,6 +183,22 @@ def _fx_bwd(layout, ls, chunk, res, g):
         db_parts.append(jnp.sum(gch, axis=0))
     dw = jnp.concatenate(dw_parts, axis=0 if layout == "vh" else 1)
     db = jnp.concatenate(db_parts, axis=0)
+    return dh, dw, db
+
+
+def _smooth_consts(v, ls):
+    sn = ls / (v - 1) if ls else 0.0
+    sp = 1.0 - ls if ls else 1.0
+    return sn, sp
+
+
+def _fx_bwd(layout, ls, chunk, res, g):
+    h, w, b, labels, logz = res
+    v = w.shape[0] if layout == "vh" else w.shape[1]
+    sn, sp = _smooth_consts(v, ls)
+    dh, dw, db = _xent_bwd_impl(h, w, b, labels, logz,
+                                g.astype(jnp.float32), layout, sn, sp,
+                                chunk)
     return (dh.astype(h.dtype), dw.astype(w.dtype), db.astype(b.dtype),
             np.zeros(labels.shape, jax.dtypes.float0))
 
@@ -165,9 +206,147 @@ def _fx_bwd(layout, ls, chunk, res, g):
 _fused_xent_rows.defvjp(_fx_fwd, _fx_bwd)
 
 
+# ---- vocab-sharded (GSPMD / shard_map) fused cross-entropy ---------------
+# The same online-logsumexp math lifted one level: each vocab shard runs
+# the intra-chip chunk loop over ITS slice of the projection weight (the
+# Pallas kernels apply per shard unchanged), then the running (m, s) pair,
+# the label-gather term and the logit sum combine across the mesh axis
+# with one pmax + three psums of [rows]-sized vectors. No [rows, V] logits
+# and no gathered full-vocab weight ever exist — the collective traffic is
+# O(rows), not O(rows x V) or O(V x H). The backward mirrors it: each
+# shard recomputes its chunk probabilities from the shared logz, keeps
+# dw/db local (they are vocab-sharded like w/b) and psums only the [rows,
+# H] partial dh. Autodiff never crosses shard_map — the custom VJP wraps
+# both shard_map calls, so no reliance on collective transpose rules.
+
+
+def _shard_specs(layout, vocab_axis, batch_axis):
+    from jax.sharding import PartitionSpec as P
+    wspec = (P(vocab_axis, None) if layout == "vh"
+             else P(None, vocab_axis))
+    return P(batch_axis, None), wspec, P(vocab_axis), P(batch_axis)
+
+
+def _sharded_fwd(h, w, b, labels, layout, ls, chunk, vocab_axis,
+                 batch_axis, mesh):
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.pipeline import shard_map
+    v = w.shape[0] if layout == "vh" else w.shape[1]
+    need_sum = ls != 0.0
+
+    def local_fwd(h, w, b, lbl):
+        vl = w.shape[0] if layout == "vh" else w.shape[1]
+        off = (jax.lax.axis_index(vocab_axis) * vl).astype(lbl.dtype)
+        lbl_loc = lbl - off
+        parts = None
+        if layout == "vh":
+            from paddle_tpu.ops.pallas.xent import xent_stats
+            parts = xent_stats(h, w, b, lbl_loc, return_parts=True,
+                               context=f"; requested vocab_axis="
+                                       f"{vocab_axis!r} layout={layout!r}")
+        if parts is None:
+            parts = _xent_stats_parts(h, w, b, lbl_loc, layout, chunk,
+                                      need_sum)
+        m, s, picked, sl = parts
+        m_g = jax.lax.pmax(m, vocab_axis)
+        s_g = jax.lax.psum(s * jnp.exp(m - m_g), vocab_axis)
+        logz = m_g + jnp.log(s_g)
+        picked_g = jax.lax.psum(picked, vocab_axis)
+        sl_g = jax.lax.psum(sl, vocab_axis) if need_sum else sl
+        return _loss_from_stats(logz, picked_g, sl_g, v, ls), logz
+
+    hspec, wspec, bspec, lspec = _shard_specs(layout, vocab_axis,
+                                              batch_axis)
+    return shard_map(local_fwd, mesh=mesh,
+                     in_specs=(hspec, wspec, bspec, lspec),
+                     out_specs=(P(batch_axis), P(batch_axis)),
+                     check_vma=False)(h, w, b, labels)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _fused_xent_rows_sharded(h, w, b, labels, layout, ls, chunk,
+                             vocab_axis, batch_axis, mesh):
+    return _sharded_fwd(h, w, b, labels, layout, ls, chunk, vocab_axis,
+                        batch_axis, mesh)[0]
+
+
+def _fxs_fwd(h, w, b, labels, layout, ls, chunk, vocab_axis, batch_axis,
+             mesh):
+    loss, logz = _sharded_fwd(h, w, b, labels, layout, ls, chunk,
+                              vocab_axis, batch_axis, mesh)
+    return loss, (h, w, b, labels, logz)
+
+
+def _fxs_bwd(layout, ls, chunk, vocab_axis, batch_axis, mesh, res, g):
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.pipeline import shard_map
+    h, w, b, labels, logz = res
+    v = w.shape[0] if layout == "vh" else w.shape[1]
+    sn, sp = _smooth_consts(v, ls)
+    ctx = f"; requested vocab_axis={vocab_axis!r} layout={layout!r}"
+
+    def local_bwd(h, w, b, lbl, logz, g):
+        vl = w.shape[0] if layout == "vh" else w.shape[1]
+        off = (jax.lax.axis_index(vocab_axis) * vl).astype(lbl.dtype)
+        dh, dw, db = _xent_bwd_impl(h, w, b, lbl - off, logz,
+                                    g.astype(jnp.float32), layout, sn, sp,
+                                    chunk, context=ctx)
+        # dh sums partial per-shard contributions over the vocab axis;
+        # dw/db stay vocab-local (sharded exactly like w/b) but sum the
+        # row contributions each batch shard computed from its own rows
+        if batch_axis is not None:
+            dw = jax.lax.psum(dw, batch_axis)
+            db = jax.lax.psum(db, batch_axis)
+        return jax.lax.psum(dh, vocab_axis), dw, db
+
+    hspec, wspec, bspec, lspec = _shard_specs(layout, vocab_axis,
+                                              batch_axis)
+    dh, dw, db = shard_map(
+        local_bwd, mesh=mesh,
+        in_specs=(hspec, wspec, bspec, lspec, P(batch_axis),
+                  P(batch_axis)),
+        out_specs=(hspec, wspec, bspec), check_vma=False)(
+        h, w, b, labels, logz, g)
+    return (dh.astype(h.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            np.zeros(labels.shape, jax.dtypes.float0))
+
+
+_fused_xent_rows_sharded.defvjp(_fxs_fwd, _fxs_bwd)
+
+
+def _infer_sharded_call(weight, labels, layout):
+    """(vocab_axis, batch_axis, mesh) read off CONCRETE array shardings —
+    tracers carry no sharding on this jax, so under jit callers pass
+    vocab_axis explicitly (the model .loss() entry points plumb it)."""
+    try:
+        sh = weight.sharding
+        spec = tuple(sh.spec)
+        mesh = sh.mesh
+    except Exception:
+        return None, None, None
+
+    def _axis(entry):
+        if isinstance(entry, (tuple, list)):
+            return entry[0] if entry else None
+        return entry
+
+    dim = 0 if layout == "vh" else 1
+    vocab_axis = _axis(spec[dim]) if dim < len(spec) else None
+    if vocab_axis is None:
+        return None, None, None
+    batch_axis = None
+    try:
+        lspec = tuple(labels.sharding.spec)
+        batch_axis = _axis(lspec[0]) if lspec else None
+    except Exception:
+        pass
+    return vocab_axis, batch_axis, mesh
+
+
 @register_op("fused_xent")
 def fused_xent(hidden, weight, labels, bias=None, weight_layout="vh",
-               label_smoothing=0.0, chunk=None):
+               label_smoothing=0.0, chunk=None, vocab_axis=None,
+               batch_axis=None, mesh=None):
     """Per-position softmax cross entropy WITHOUT materializing logits.
 
     hidden [..., H]; weight [V, H] ("vh", the tied-embedding layout) or
@@ -175,15 +354,48 @@ def fused_xent(hidden, weight, labels, bias=None, weight_layout="vh",
     bias [V] optional. Returns f32 loss with labels' shape — equal to
     ``softmax_with_cross_entropy(project(hidden), labels)`` (plus the
     label-smoothed soft-label form when label_smoothing > 0), with value
-    and gradient fused/tiled over the vocab axis."""
+    and gradient fused/tiled over the vocab axis.
+
+    vocab_axis: mesh axis name the VOCAB dim of weight/bias is partitioned
+    over (tensor parallelism). The chunk loop then runs per shard inside
+    shard_map and the (m, s)/picked/sum stats combine with pmax/psum — no
+    full-vocab weight gather, no [rows, V] temporary, O(rows) collective
+    traffic. Auto-detected from ``weight.sharding`` when the arrays are
+    concrete (eager); under jit pass it explicitly.
+    batch_axis: mesh axis the row (batch*seq) dim of hidden/labels is
+    sharded over (usually "dp"); None keeps rows replicated per shard.
+    mesh: Mesh for the sharded path; defaults to the enclosing
+    ``with mesh:`` context, else the weight's own sharding mesh."""
     if chunk is None:
         from paddle_tpu.core.flags import get_flag
         chunk = get_flag("xent_chunk")
+    if vocab_axis is None and mesh is None:
+        vocab_axis, auto_batch, mesh = _infer_sharded_call(
+            weight, labels, weight_layout)
+        if batch_axis is None:
+            batch_axis = auto_batch
     lead = labels.shape
     h2 = hidden.reshape(-1, hidden.shape[-1])
     lbl = labels.reshape(-1).astype(jnp.int32)
     v = weight.shape[0] if weight_layout == "vh" else weight.shape[1]
     b = bias if bias is not None else jnp.zeros((v,), jnp.float32)
+    if vocab_axis is not None:
+        from paddle_tpu.core.enforce import enforce
+        if mesh is None:
+            from paddle_tpu.parallel.mesh import current_mesh
+            mesh = current_mesh()
+        enforce(mesh is not None,
+                "fused_xent(vocab_axis=...) needs a mesh: pass mesh= or "
+                "call under `with mesh:`")
+        tp = mesh.shape[vocab_axis]
+        if tp > 1:
+            enforce(v % tp == 0,
+                    f"vocab {v} not divisible by mesh axis "
+                    f"{vocab_axis!r} size {tp}")
+            loss = _fused_xent_rows_sharded(
+                h2, weight, b, lbl, weight_layout, float(label_smoothing),
+                int(chunk), vocab_axis, batch_axis, mesh)
+            return loss.reshape(lead)
     loss = _fused_xent_rows(h2, weight, b, lbl, weight_layout,
                             float(label_smoothing), int(chunk))
     return loss.reshape(lead)

@@ -9,7 +9,39 @@ Kernels gate on TPU availability and fall back to pure-XLA reference
 implementations elsewhere (CPU tests run the fallback).
 """
 
+import logging
+
 import jax
+
+logger = logging.getLogger("paddle_tpu.pallas")
+_fallback_logged = set()
+
+
+def log_fallback(kernel, reason, level=logging.WARNING):
+    """One-time notice when a Pallas fast path is refused, so a user
+    benchmarking the "fused" configuration knows they are measuring the
+    chunked XLA fallback. Callers include the *requested* configuration
+    (shapes, layout, sharding) vs. what the kernel supports in `reason` —
+    a silent drop under GSPMD is otherwise invisible."""
+    key = (kernel, reason)
+    if key not in _fallback_logged:
+        _fallback_logged.add(key)
+        logger.log(level, "%s: Pallas path refused (%s); "
+                          "using chunked XLA fallback", kernel, reason)
+
+
+def describe_sharding(**arrays):
+    """Compact "name=shape@spec" string for fallback log lines. Concrete
+    arrays report their NamedSharding spec; tracers (inside jit, where
+    shardings are GSPMD-deferred) report '?'."""
+    parts = []
+    for name, a in arrays.items():
+        try:
+            spec = a.sharding.spec
+        except Exception:
+            spec = "?"
+        parts.append(f"{name}={tuple(getattr(a, 'shape', ()))}@{spec}")
+    return ", ".join(parts)
 
 
 def on_tpu():

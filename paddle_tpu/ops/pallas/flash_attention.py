@@ -48,21 +48,17 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from paddle_tpu.ops.pallas import on_tpu
+from paddle_tpu.ops.pallas import describe_sharding, log_fallback, on_tpu
 
 NEG_INF = -1e30
 
 logger = logging.getLogger("paddle_tpu.flash")
-_fallback_logged = set()
 
 
 def _log_fallback(reason):
     """One-time notice when the Pallas fast path is refused — so a user
     benchmarking "flash" knows they are measuring the chunked fallback."""
-    if reason not in _fallback_logged:
-        _fallback_logged.add(reason)
-        logger.warning("flash_attention: Pallas path refused (%s); "
-                       "using chunked XLA fallback", reason)
+    log_fallback("flash_attention", reason)
 
 
 def _block_valid(qi, ki, *, block_q, block_k, tq, tk, causal, causal_offset,
@@ -580,7 +576,13 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
                                    block_k, False)
             return _flash_core(q, k, v, kv_mask.astype(jnp.float32), scale,
                                causal, block_q, block_k, True)
+        # include the requested shardings: under GSPMD/shard_map the
+        # PER-SHARD T is what must divide by 8, so a globally-legal shape
+        # can still land here once the sequence axis is partitioned — the
+        # log must show what was asked for vs what the kernel supports
         _log_fallback(f"D={q.shape[-1]} not a multiple of 64 or "
-                      f"T={q.shape[2]}/{k.shape[2]} not a multiple of 8")
+                      f"T={q.shape[2]}/{k.shape[2]} not a multiple of 8; "
+                      f"requested {describe_sharding(q=q, k=k)} "
+                      "(supported: per-shard D%64==0 and T%8==0)")
     return chunked_attention(q, k, v, scale=scale, causal=causal,
                              kv_mask=kv_mask, chunk_size=block_k)

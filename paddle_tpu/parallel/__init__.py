@@ -42,6 +42,7 @@ from paddle_tpu.parallel.heartbeat import (FileHeartbeat, HeartBeatMonitor,
                                            barrier_with_timeout, kv_barrier)
 from paddle_tpu.parallel.mesh import (
     DP, EP, FSDP, PP, SP, TP,
+    current_mesh,
     data_parallel_mesh,
     make_hybrid_mesh,
     make_mesh,
@@ -51,7 +52,10 @@ from paddle_tpu.parallel.mesh import (
 from paddle_tpu.parallel.api import (
     DataParallel,
     fsdp_sharding,
+    infer_vocab_axis,
     local_sgd_sync,
     replicate,
     shard_batch,
+    tp_lm_sharding,
+    tp_lm_specs,
 )

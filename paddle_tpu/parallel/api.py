@@ -58,6 +58,76 @@ def fsdp_sharding(mesh, tree, axis=FSDP, min_size=2 ** 12):
         lambda x: jax.device_put(x, NamedSharding(mesh, spec_for(x))), tree)
 
 
+def tp_lm_specs(tree, tp=TP, min_size=2 ** 11):
+    """Megatron-flavored tensor-parallel PartitionSpecs for the
+    transformer LM families (GPT/BERT/ERNIE/Transformer):
+
+      * token-embedding tables (`tok_emb`/`src_emb`/`tgt_emb` weight,
+        the [V, H] "vh" layout) shard their VOCAB dim -> P(tp, None),
+        so the tied-embedding fused cross-entropy (ops/fused.py
+        fused_xent vocab_axis=) runs per shard with no weight gather;
+      * the NMT output projection (`out_proj` weight, [H, V] "hv")
+        shards its vocab dim -> P(None, tp);
+      * vocab-length biases (`mlm_bias`) follow the table -> P(tp);
+      * remaining large 2-D weights (FFN/attention) column-shard
+        -> P(None, tp); everything else replicates.
+
+    Returns a pytree of PartitionSpec mirroring `tree`.
+    """
+    vocab_tables = {"tok_emb", "src_emb", "tgt_emb"}
+
+    def spec(path, x):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path]
+        leaf = names[-1] if names else ""
+        if (leaf == "weight" and x.ndim == 2
+                and vocab_tables & set(names)):
+            return P(tp, None)
+        if leaf == "weight" and x.ndim == 2 and "out_proj" in names:
+            return P(None, tp)
+        if leaf == "mlm_bias" and x.ndim == 1:
+            return P(tp)
+        if x.ndim == 2 and x.size >= min_size:
+            return P(None, tp)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def tp_lm_sharding(mesh, tree, tp=TP, min_size=2 ** 11):
+    """device_put `tree` onto `mesh` with tp_lm_specs — skipping any leaf
+    whose named dim is not divisible by the tp axis size (replicated
+    instead), so tiny demo configs never trap on divisibility."""
+    size = mesh.shape[tp]
+
+    def place(x, s):
+        dims = tuple(s)
+        ok = all(d is None or x.shape[i] % size == 0
+                 for i, d in enumerate(dims))
+        return jax.device_put(
+            x, NamedSharding(mesh, s if ok else P()))
+
+    specs = tp_lm_specs(tree, tp=tp, min_size=min_size)
+    return jax.tree_util.tree_map(place, tree, specs)
+
+
+def infer_vocab_axis(arr, dim):
+    """Mesh-axis name partitioning `dim` of a CONCRETE array's
+    NamedSharding, else None (tracers, replicated dims, non-named
+    shardings). The eager-mode half of fused_xent's sharding
+    auto-detection."""
+    try:
+        spec = tuple(arr.sharding.spec)
+    except Exception:
+        return None
+    if dim >= len(spec):
+        return None
+    entry = spec[dim]
+    if isinstance(entry, (tuple, list)):
+        return entry[0] if entry else None
+    return entry
+
+
 class DataParallel:
     """Single-controller data-parallel trainer (ref: ParallelExecutor +
     CompiledProgram.with_data_parallel, compiler.py:138).

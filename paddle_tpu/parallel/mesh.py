@@ -97,6 +97,22 @@ def make_hybrid_mesh(ici_axes, dcn_axes, devices=None):
     return Mesh(dev_array, tuple(dcn_names) + tuple(ici_names))
 
 
+def current_mesh():
+    """The Mesh installed by an enclosing ``with mesh:`` block, or None.
+
+    Lets mesh-aware ops (fused_xent's vocab-sharded path) resolve the mesh
+    at trace time without threading it through every model signature —
+    the same contract GSPMD's own `with mesh` constraint APIs use."""
+    try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.thread_resources.env.physical_mesh
+        if m is not None and not m.empty:
+            return m
+    except Exception:
+        pass
+    return None
+
+
 def named_sharding(mesh, *spec):
     return NamedSharding(mesh, P(*spec))
 

@@ -28,3 +28,31 @@ def test_bench_gpt_compile_only_tiny_remat():
     row = cs.run(model="gpt", tiny=True, timeout=420,
                  extra_env={"PT_BENCH_REMAT": "dots_saveable"})
     assert row["metric"] == "gpt_compile_only"
+
+
+@pytest.mark.perf
+def test_bench_gpt_sharded_dp_tp_hlo_contract():
+    """The dp2,tp2 GSPMD train step (4 fake CPU devices, vocab-sharded
+    tied embedding) must compile AND its per-device HLO must contain no
+    [rows, V]-scale temporary and no all-gather of the vocab-sharded
+    weight; the PT_FUSED_XENT=0 reference step must TRIP the detector
+    (positive control — proves the grep sees full-vocab logits)."""
+    import tools.compile_smoke as cs
+    out = cs.sharded_vocab_check(model="gpt", timeout=420)
+    assert out["clean"], (out["vocab_temporaries"],
+                          out["weight_all_gathers"])
+    assert out["positive_control_trips"]
+    assert out["row"]["mesh"] == {"dp": 2, "tp": 2}
+
+
+@pytest.mark.perf
+def test_bench_bert_sharded_dp_tp_hlo_contract():
+    """Same contract for the BERT-pretrain step (masked-position MLM head
+    over the vocab-sharded table + tp-sharded mlm_bias). Detector
+    validity is already proven by the GPT positive control; skipping the
+    extra reference compile keeps the tier-1 budget."""
+    import tools.compile_smoke as cs
+    out = cs.sharded_vocab_check(model="bert", timeout=420,
+                                 positive_control=False)
+    assert out["clean"], (out["vocab_temporaries"],
+                          out["weight_all_gathers"])

@@ -8,30 +8,69 @@ scan-over-layers + remat encoders, and the fused add+LN path all have to
 lower and compile inside one jitted train step — a regression in any of
 them trips here, not in the next silicon bench window.
 
+The sharded mode (--mesh dp2,tp2) additionally compiles the dp x tp GSPMD
+train step on fake CPU devices and greps the compiled (post-SPMD,
+per-device shapes) HLO: the fused sharded step must materialize NO
+[rows, V]-scale temporary and NO all-gather of the vocab-sharded
+projection weight. `sharded_vocab_check` wraps the full contract —
+fused run must be clean, a PT_FUSED_XENT=0 positive-control run must
+trip the detector (proving the grep actually detects full-vocab logits).
+
 Usage:
   python tools/compile_smoke.py                  # gpt, full-size config
   python tools/compile_smoke.py --tiny           # tiny config (CI budget)
   python tools/compile_smoke.py --model bert --tiny
+  python tools/compile_smoke.py --model gpt --tiny --mesh dp2,tp2 --hlo-check
 """
 
 import argparse
 import json
+import math
 import os
+import re
 import subprocess
 import sys
+import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run(model="gpt", tiny=False, timeout=600, extra_env=None):
+def _mesh_devices(mesh):
+    """Device count a '--mesh dp2,tp2' spec needs (explicit sizes only)."""
+    n = 1
+    for part in mesh.split(","):
+        m = re.fullmatch(r"([a-z]+)(\d+)", part.strip())
+        if not m:
+            raise SystemExit(f"--mesh {mesh!r}: compile_smoke needs "
+                             "explicit sizes (e.g. dp2,tp2)")
+        n *= int(m.group(2))
+    return n
+
+
+def run(model="gpt", tiny=False, timeout=600, extra_env=None, mesh=None,
+        batch=None, seq=None, dump_hlo=None):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the tunnel
     env["JAX_PLATFORMS"] = "cpu"
+    if mesh:
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count"))
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                            f"count={_mesh_devices(mesh)}").strip()
     env.update(extra_env or {})
     args = [sys.executable, os.path.join(REPO, "bench.py"),
             "--compile-only", "--model", model]
     if tiny:
         args.append("--tiny")
+    if mesh:
+        args += ["--mesh", mesh]
+    if batch:
+        args += ["--batch", str(batch)]
+    if seq:
+        args += ["--seq", str(seq)]
+    if dump_hlo:
+        args += ["--dump-hlo", dump_hlo]
     proc = subprocess.run(args, stdout=subprocess.PIPE, text=True,
                           timeout=timeout, env=env, cwd=REPO)
     lines = proc.stdout.strip().splitlines()
@@ -43,13 +82,108 @@ def run(model="gpt", tiny=False, timeout=600, extra_env=None):
     return row
 
 
+def _hlo_shapes(text):
+    """All f32/bf16 shapes in a compiled HLO module's text."""
+    return [tuple(int(d) for d in m.group(2).split(","))
+            for m in re.finditer(r"\b(f32|bf16)\[([0-9,]+)\]", text)]
+
+
+def vocab_temporaries(hlo_text, vocab, tp, min_rows):
+    """Shapes carrying a vocab-sized dim (global V or the V/tp shard)
+    next to >= min_rows row elements — i.e. a materialized
+    [rows, vocab]-scale logits temporary in the per-device module.
+    min_rows is chosen ABOVE the model width so the [V/tp, H] weight
+    shard (a legitimate vocab-axis resident) never trips it."""
+    vdims = {vocab, vocab // tp}
+    hits = set()
+    for shp in _hlo_shapes(hlo_text):
+        for d in shp:
+            if d in vdims and math.prod(shp) // d >= min_rows:
+                hits.add(shp)
+    return sorted(hits)
+
+
+def weight_all_gathers(hlo_text, vocab, hidden):
+    """all-gather ops whose RESULT carries the full global-vocab dim at
+    weight scale — i.e. GSPMD re-assembled the vocab-sharded projection
+    weight (or a same-scale vocab tensor) instead of computing on shards."""
+    hits = []
+    for line in hlo_text.splitlines():
+        if "all-gather" not in line:
+            continue
+        for m in re.finditer(r"\[([0-9,]+)\]", line):
+            shp = tuple(int(d) for d in m.group(1).split(","))
+            if vocab in shp and math.prod(shp) >= vocab * hidden:
+                hits.append(line.strip()[:160])
+                break
+    return hits
+
+
+# per-model shapes for the sharded HLO contract: tiny configs, batch/seq
+# picked so no legitimate dim collides with {V, V/tp} and the row
+# threshold clears the model width with >= 2x margin. xent_chunk=64 keeps
+# even the fused path's per-chunk logits tile far below the threshold.
+_SHARDED_CASES = {
+    # model: (batch, seq, vocab, hidden, rows_fn)
+    "gpt": (16, 128, 512, 64, lambda b, s: b * s),
+    "bert": (32, 128, 1024, 64, lambda b, s: b * max(1, int(0.15 * s))),
+}
+
+
+def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
+                        positive_control=True):
+    """Compile the dp x tp fused train step and enforce the sharded-HLO
+    contract; optionally also compile the PT_FUSED_XENT=0 reference step
+    and require the detector to TRIP on it (positive control)."""
+    batch, seq, vocab, hidden, rows_fn = _SHARDED_CASES[model]
+    dp = 2
+    min_rows = rows_fn(batch, seq) // dp // 2
+    chunk_env = {"PT_FLAGS_xent_chunk": "64"}
+    out = {"model": model, "mesh": mesh}
+    with tempfile.TemporaryDirectory() as td:
+        fused_hlo = os.path.join(td, "fused.hlo")
+        row = run(model=model, tiny=True, timeout=timeout, mesh=mesh,
+                  batch=batch, seq=seq, dump_hlo=fused_hlo,
+                  extra_env=chunk_env)
+        text = open(fused_hlo).read()
+        temps = vocab_temporaries(text, vocab, 2, min_rows)
+        gathers = weight_all_gathers(text, vocab, hidden)
+        out.update(row=row, vocab_temporaries=temps,
+                   weight_all_gathers=gathers,
+                   clean=not temps and not gathers)
+        if positive_control:
+            ref_hlo = os.path.join(td, "reference.hlo")
+            run(model=model, tiny=True, timeout=timeout, mesh=mesh,
+                batch=batch, seq=seq, dump_hlo=ref_hlo,
+                extra_env={**chunk_env, "PT_FUSED_XENT": "0"})
+            ref_temps = vocab_temporaries(open(ref_hlo).read(), vocab, 2,
+                                          min_rows)
+            out["positive_control_trips"] = bool(ref_temps)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt")
     ap.add_argument("--tiny", action="store_true")
     ap.add_argument("--timeout", type=float, default=600)
+    ap.add_argument("--mesh", default=None,
+                    help="compile the dp x tp sharded step on fake CPU "
+                         "devices, e.g. dp2,tp2")
+    ap.add_argument("--hlo-check", action="store_true",
+                    help="with --mesh: enforce the sharded-HLO contract "
+                         "(no [rows, V] temporary, no vocab-weight "
+                         "all-gather) with a positive control")
     args = ap.parse_args()
-    row = run(args.model, args.tiny, args.timeout)
+    if args.hlo_check:
+        if not args.mesh:
+            raise SystemExit("--hlo-check needs --mesh")
+        out = sharded_vocab_check(args.model, args.mesh, args.timeout)
+        print(json.dumps(out))
+        if not out["clean"] or not out.get("positive_control_trips", True):
+            raise SystemExit("sharded-HLO contract violated")
+        return
+    row = run(args.model, args.tiny, args.timeout, mesh=args.mesh)
     print(json.dumps(row))
 
 

@@ -258,6 +258,40 @@ def main():
         F.pool2d(x_, 2, "max", 2) ** 2)))(xi)
     check("maxpool_index_vjp_dx", _maxdiff(gi, ri), 1e-3)
 
+    # ---- 4e. fused-xent Pallas kernels (fwd stats + bwd dh/dw/db) ------
+    # parity vs the chunked XLA twins, incl. an out-of-range label (the
+    # vocab-sharded per-shard call path: that row's one-hot must vanish)
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops.fused import (_smooth_consts, _xent_bwd_impl,
+                                      _xent_stats_xla)
+    from paddle_tpu.ops.pallas.xent import xent_bwd_pallas, xent_stats
+    xh = jnp.asarray(rng.randn(24, 64).astype(np.float32))
+    xw = jnp.asarray(rng.randn(100, 64).astype(np.float32) * 0.1)
+    xb = jnp.asarray(rng.randn(100).astype(np.float32) * 0.1)
+    xl = jnp.asarray(rng.randint(0, 100, (24,)).astype(np.int32))
+    xl = xl.at[3].set(150)  # out of range: never hits
+    xg = jnp.asarray(rng.rand(24).astype(np.float32))
+    logz_r, picked_r, sl_r = _xent_stats_xla(xh, xw, xb, xl, "vh", 32,
+                                             True)
+    st = xent_stats(xh, xw, xb, xl)
+    if st is None:
+        results["xent_fwd_stats"] = {"error": "kernel gated off",
+                                     "ok": False}
+        print("FAIL xent_fwd_stats: kernel gated off", flush=True)
+    else:
+        check("xent_fwd_stats", max(_maxdiff(st[0], logz_r),
+                                    _maxdiff(st[1], picked_r),
+                                    _maxdiff(st[2], sl_r)), 1e-3)
+    sn, sp = _smooth_consts(100, 0.1)
+    set_flags({"use_pallas_xent_bwd": False})
+    dref = _xent_bwd_impl(xh, xw, xb, xl, logz_r, xg, "vh", sn, sp, 32)
+    set_flags({"use_pallas_xent_bwd": True})
+    dk = xent_bwd_pallas(xh, xw, xb, xl, logz_r, xg, sn, sp,
+                         interpret=args.interpret)
+    check("xent_bwd_dh", _maxdiff(dk[0], dref[0]), 1e-3)
+    check("xent_bwd_dw", _maxdiff(dk[1], dref[1]), 1e-3)
+    check("xent_bwd_db", _maxdiff(dk[2], dref[2]), 1e-3)
+
     # ---- 5. micro-timings ---------------------------------------------
     if not args.quick:
         from _timing import device_time

@@ -21,39 +21,22 @@ import numpy as np
 
 
 def peak_flops():
-    """Per-chip peak bf16 FLOP/s; override with PT_PEAK_FLOPS."""
-    if "PT_PEAK_FLOPS" in os.environ:
-        return float(os.environ["PT_PEAK_FLOPS"])
-    import jax
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    # bf16 peaks: v5e (v5 lite) 197 TFLOP/s (394 is the int8 number);
-    # v5p: 459; v4: 275; v6e: 918
-    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v6" in kind:
-        return 918e12
-    if "v4" in kind:
-        return 275e12
-    return 197e12
+    """Per-chip peak bf16 FLOP/s (observability/perf.py owns the table;
+    this thin wrapper keeps the import lazy for the probe path)."""
+    from paddle_tpu.observability.perf import peak_flops as _pf
+    return _pf()
 
 
 def _cost_flops(jitted, *args):
-    try:
-        c = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(c, (list, tuple)):
-            c = c[0]
-        return float(c.get("flops", 0.0))
-    except Exception:
-        return 0.0
+    from paddle_tpu.observability.perf import cost_flops
+    return cost_flops(jitted, *args)
 
 
 COMPILE_ONLY = False
 TINY = False
 DUMP_HLO = None    # --dump-hlo: write the compiled (post-SPMD) HLO text
 MESH_AXES = None   # --mesh: {"dp": 2, "tp": 2} parsed from "dp2,tp2"
+RUN_LOG = None     # --run-log: RunLog streaming per-step bench records
 
 
 def _parse_mesh(spec):
@@ -148,12 +131,28 @@ def _timed_steps(step_once, steps):
     On the tunneled TPU platform `block_until_ready` returns before the
     device finishes, and every sync pays a fixed ~60ms round trip. So: sync
     by fetching the scalar loss to host, and measure two runs (n and 2n
-    steps) — the difference isolates pure device time per step."""
+    steps) — the difference isolates pure device time per step.
+
+    Side channel: each step's host-visible wall time feeds the
+    `bench.step_time_s` histogram (p50/p95 land in the row's `telemetry`
+    field) and, under --run-log, a per-step RunLog record — dispatch
+    wall, not device time, but enough to see stragglers."""
+    from paddle_tpu.observability import metrics as _metrics
+    hist = _metrics.histogram("bench.step_time_s")
+    step_no = {"n": 0}
+
     def run(n):
         t0 = time.perf_counter()
         loss = None
         for _ in range(n):
+            s0 = time.perf_counter()
             loss = step_once()
+            dt_s = time.perf_counter() - s0
+            hist.observe(dt_s)
+            step_no["n"] += 1
+            if RUN_LOG is not None:
+                RUN_LOG.write({"phase": "bench", "step": step_no["n"],
+                               "wall_s": dt_s})
         lv = float(loss)  # host fetch = true barrier
         return time.perf_counter() - t0, lv
 
@@ -716,11 +715,14 @@ def _enable_compile_cache():
 
 
 def _run_inner(args):
-    global COMPILE_ONLY, TINY, DUMP_HLO, MESH_AXES
+    global COMPILE_ONLY, TINY, DUMP_HLO, MESH_AXES, RUN_LOG
     COMPILE_ONLY = bool(getattr(args, "compile_only", False))
     TINY = bool(getattr(args, "tiny", False))
     DUMP_HLO = getattr(args, "dump_hlo", None)
     MESH_AXES = _parse_mesh(getattr(args, "mesh", None))
+    if getattr(args, "run_log", None):
+        from paddle_tpu.observability.runlog import RunLog
+        RUN_LOG = RunLog(args.run_log)
     if MESH_AXES and args.model not in ("bert", "ernie", "gpt",
                                         "transformer_big"):
         raise SystemExit(f"--mesh supports the transformer LM rows "
@@ -754,6 +756,17 @@ def _run_inner(args):
         res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
     else:  # bandwidth-bound rows (decode) have no meaningful MFU framing
         res.setdefault("vs_baseline", 0.0)
+    try:
+        # self-describing row: which degraded paths fired (pallas
+        # fallbacks, retries) + step-time p50/p95 from the registry
+        from paddle_tpu.observability import bench_telemetry
+        res["telemetry"] = bench_telemetry()
+        if RUN_LOG is not None:
+            RUN_LOG.write({"final": True, "metric": res.get("metric"),
+                           **res["telemetry"]})
+            RUN_LOG.close()
+    except Exception as e:  # telemetry must never sink the bench row
+        print(f"bench telemetry unavailable: {e}", file=sys.stderr)
     return res
 
 
@@ -875,10 +888,14 @@ def _run_suite(args, deadline):
                       if args.mesh and model in ("bert", "ernie", "gpt",
                                                  "transformer_big")
                       else [])
+        # per-model run logs: suite children must not interleave one file
+        log_extra = (["--run-log", f"{args.run_log}.{model}"]
+                     if args.run_log else [])
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--model", model, *extra, *mesh_extra, "--_inner"],
+                 "--model", model, *extra, *mesh_extra, *log_extra,
+                 "--_inner"],
                 stdout=subprocess.PIPE, text=True,
                 timeout=min(per_model_cap, remaining - 10))
         except subprocess.TimeoutExpired:
@@ -953,6 +970,11 @@ def main():
                     help="with --compile-only: write the compiled (post-"
                          "SPMD) HLO text here (tools/compile_smoke.py "
                          "asserts no full-vocab temporaries on it)")
+    ap.add_argument("--run-log", default=None,
+                    help="stream a per-step RunLog (observability JSONL) "
+                         "of the timed bench steps here; suite mode "
+                         "writes one file per model (PATH.<model>). "
+                         "tools/run_report.py renders it.")
     ap.add_argument("--_inner", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 

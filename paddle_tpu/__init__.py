@@ -25,6 +25,8 @@ Layer map (mirrors reference SURVEY.md §1, re-architected TPU-first):
   models/     flagship model zoo (ResNet, BERT, Transformer, DeepFM, ...)
   static/     Program/Executor compatibility layer
                                             (ref: framework.py Program, executor.py)
+  observability/ metrics registry + RunLog + trace spans + step telemetry
+                                            (ref: platform/profiler.h, tools/timeline.py)
 """
 
 __version__ = "0.1.0"
@@ -63,5 +65,6 @@ from paddle_tpu import metrics
 from paddle_tpu import quant
 from paddle_tpu import slim
 from paddle_tpu import profiler
+from paddle_tpu import observability
 from paddle_tpu import initializer
 from paddle_tpu.core.random import seed

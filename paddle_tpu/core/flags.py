@@ -139,6 +139,18 @@ define_flag("retry_jitter", 0.25,
 define_flag("retry_deadline_s", 60.0,
             "Overall deadline for one retried operation (<= 0 = none): "
             "give up rather than start a sleep that would cross it.")
+# observability (observability/): Trainer step telemetry defaults —
+# TelemetryConfig fields left None resolve from these, so a run can be
+# instrumented with env vars alone (PT_FLAGS_telemetry=1
+# PT_FLAGS_telemetry_run_log=/runs/x/run.jsonl python train.py)
+define_flag("telemetry", False,
+            "Default-enable Trainer step telemetry (TelemetryConfig "
+            "fields left unset resolve from the telemetry_* flags).")
+define_flag("telemetry_run_log", "",
+            "Default RunLog JSONL path for step telemetry ('' keeps "
+            "records in memory only).")
+define_flag("telemetry_every_n", 1,
+            "Emit a step telemetry record every N steps.")
 # fault tolerance — checkpoint mirroring (io/checkpoint.py): False = a
 # mirror push that still fails after retries is logged and queued for the
 # next save (training continues on the durable local copy); True = raise

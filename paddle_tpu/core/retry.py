@@ -25,6 +25,11 @@ import random as _random
 import time
 
 from paddle_tpu.core import flags as F
+from paddle_tpu.observability import metrics as _metrics
+
+
+def _op_name(fn):
+    return getattr(fn, "__name__", None) or type(fn).__name__
 
 
 def default_retryable(exc):
@@ -81,19 +86,30 @@ class RetryPolicy:
     def call(self, fn, *args, **kwargs):
         """Run fn(*args, **kwargs), retrying retryable failures. The last
         exception is re-raised as itself (not wrapped) so upstream
-        except-clauses keep working."""
+        except-clauses keep working.
+
+        Every retryable failure increments `retry.attempts{op=...}` in
+        the metrics registry, and exhaustion (attempts or deadline)
+        increments `retry.giveups{op=...}` — a run report can say how
+        flaky the remote edges were without log archaeology."""
         start = self._clock()
         failures = 0
+        op = _op_name(fn)
         while True:
             try:
                 return fn(*args, **kwargs)
             except Exception as e:
                 failures += 1
-                if not self.retryable(e) or failures >= self.max_attempts:
+                if not self.retryable(e):
+                    raise
+                _metrics.counter("retry.attempts").inc(op=op)
+                if failures >= self.max_attempts:
+                    _metrics.counter("retry.giveups").inc(op=op)
                     raise
                 delay = self.backoff_s(failures)
                 if (self.deadline_s > 0
                         and self._clock() - start + delay > self.deadline_s):
+                    _metrics.counter("retry.giveups").inc(op=op)
                     raise
                 if self.on_retry is not None:
                     self.on_retry(failures, e, delay)

@@ -26,6 +26,7 @@ import os
 import jax
 import numpy as np
 
+from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.testing.chaos import fault_point
 
 # pushed last into each mirrored step dir; its presence IS the commit
@@ -207,6 +208,7 @@ class CheckpointManager:
             try:
                 self._mirror_one(s)
             except Exception as e:
+                _metrics.counter("checkpoint.mirror_degraded").inc()
                 if self.strict_mirror:
                     # everything from the failed step on is still owed
                     self._mirror_pending = [x for x in sorted(todo)
@@ -239,6 +241,10 @@ class CheckpointManager:
                 continue
             if committed_only and not self._fs.fs_exists(
                     f"{self._remote}/{n}/{COMMIT_MARKER}"):
+                # torn mirror from a crashed writer: invisible to
+                # restore, but counted — a run that keeps resuming past
+                # torn steps is losing work and should say so
+                _metrics.counter("checkpoint.torn_skips").inc()
                 continue
             steps.append(int(n))
         return steps
@@ -279,6 +285,7 @@ class CheckpointManager:
                 saved = self._mgr.save(
                     step, args=ocp.args.StandardSave(state), force=force)
             if saved:
+                _metrics.counter("checkpoint.saves").inc()
                 self._mirror_save(step)
             return saved
         if force or step % self.save_interval == 0:
@@ -288,6 +295,7 @@ class CheckpointManager:
             for old in steps[:-self.max_to_keep]:
                 import shutil
                 shutil.rmtree(os.path.join(self.path, str(old)))
+            _metrics.counter("checkpoint.saves").inc()
             self._mirror_save(step)
             return True
         return False
@@ -330,11 +338,14 @@ class CheckpointManager:
                 if hasattr(x, "shape") else x, template)
             state = self._mgr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
+            _metrics.counter("checkpoint.restores").inc()
             return state, step
         step = step if step is not None else latest_step(self.path)
         if step is None:
             return None, None
-        return load_persistables(self.path, template, step), step
+        state = load_persistables(self.path, template, step)
+        _metrics.counter("checkpoint.restores").inc()
+        return state, step
 
     def wait(self):
         if self._mgr is not None:

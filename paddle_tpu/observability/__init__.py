@@ -1,0 +1,86 @@
+"""Observability — the run-scoped telemetry subsystem.
+
+Reference-framework ancestry (what each piece re-architects):
+
+  metrics.py    counters/gauges/histograms in ONE process registry — the
+                successor of the reference's scattered monitor state
+                (pserver HeartBeatMonitor tallies, profiler totals,
+                ad-hoc VLOG counters); every degraded path in this
+                framework (core/retry.py attempts, ops/pallas fallbacks,
+                io/checkpoint.py torn-commit skips and mirror
+                degradations, parallel/heartbeat.py missed beats,
+                static/trainer.py preemptions + ingest stalls) now
+                increments a named metric here.
+  runlog.py     JSONL step-record sink with rotation — the durable,
+                machine-readable run artifact the reference never had
+                (DeviceWorker VLOG lines were the closest thing).
+  spans.py      nestable span() scopes — platform/profiler.h:81
+                RecordEvent, feeding the sorted text table
+                (profiler.h:166 EnableProfiler), the metrics registry,
+                and jax.profiler.TraceAnnotation (the chrome-trace
+                timeline role of tools/timeline.py).
+  perf.py       peak-FLOPs table + XLA cost-analysis + device memory
+                stats (moved from bench.py so bench rows, step records,
+                and tools/run_report.py share one MFU arithmetic).
+  telemetry.py  TelemetryConfig/StepTelemetry — opt-in per-step records
+                (wall time, tokens/s, MFU, trailing-fetch loss, HBM
+                peaks) emitted from static/trainer.py with no device
+                sync on the hot path.
+
+tools/run_report.py joins a RunLog with an optional XPlane trace dir
+into the human-readable run report (the EnableProfiler/DisableProfiler
+report + timeline.py join, in one CLI).
+
+`metrics` and `runlog` are import-light (stdlib only) so early modules
+(core/retry.py) can use them without cycles; the jax-importing members
+(span, TelemetryConfig, ...) load lazily on first attribute access.
+"""
+
+from paddle_tpu.observability import metrics, runlog
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry, counter,
+                                              gauge, histogram, registry,
+                                              reset_all, snapshot)
+from paddle_tpu.observability.runlog import RunLog, read_records
+
+# lazily-resolved members -> defining submodule (PEP 562): these pull in
+# jax/profiler, which early importers of the metrics registry must not
+_LAZY = {
+    "span": "spans", "annotate_span": "spans", "span_summary": "spans",
+    "span_report": "spans", "reset_spans": "spans", "recorder": "spans",
+    "spans": None, "telemetry": None, "perf": None,
+    "TelemetryConfig": "telemetry", "StepTelemetry": "telemetry",
+    "default_tokens": "telemetry",
+    "peak_flops": "perf", "cost_flops": "perf", "mfu": "perf",
+    "device_memory_stats": "perf",
+}
+
+
+def __getattr__(name):
+    import importlib
+    target = _LAZY.get(name, KeyError)
+    if target is KeyError:
+        raise AttributeError(
+            f"module 'paddle_tpu.observability' has no attribute {name!r}")
+    if target is None:      # the submodule itself
+        return importlib.import_module(f"paddle_tpu.observability.{name}")
+    mod = importlib.import_module(f"paddle_tpu.observability.{target}")
+    val = getattr(mod, name)
+    globals()[name] = val   # cache: subsequent accesses skip __getattr__
+    return val
+
+
+def bench_telemetry():
+    """The self-describing `telemetry` field for bench.py JSON rows:
+    the registry's counter snapshot plus step-time p50/p95 (ms) from the
+    `bench.step_time_s` histogram `_timed_steps` feeds."""
+    snap = metrics.snapshot()
+    out = {"counters": snap.get("counters", {})}
+    h = metrics.registry().get("bench.step_time_s")
+    st = h.stats() if h is not None else None
+    if st:
+        out["step_time_ms"] = {
+            "p50": round(st["p50"] * 1e3, 3),
+            "p95": round(st["p95"] * 1e3, 3),
+            "n": st["count"]}
+    return out

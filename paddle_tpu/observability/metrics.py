@@ -1,0 +1,279 @@
+"""Process-global metrics registry — Counter / Gauge / Histogram.
+
+Ref: the reference framework's monitor surface was scattered — profiler
+event tables (platform/profiler.h:166), pserver-side counters inside
+HeartBeatMonitor, and ad-hoc VLOG lines; none of it was queryable at run
+end. Here every degraded path (retries, Pallas fallbacks, torn-checkpoint
+skips, missed heartbeats, preemptions) increments a named metric in ONE
+registry, and a training run's final RunLog record carries the snapshot —
+a bench row or postmortem can state *which* slow paths fired without
+grepping logs.
+
+Design: deliberately stdlib-only (no jax, no paddle_tpu imports) so hot
+and early-importing modules (core/retry.py, ops/pallas) can depend on it
+without cycles. Thread-safe: ingestion threads, heartbeat monitors, and
+the train loop all write concurrently.
+
+    from paddle_tpu.observability import metrics
+
+    metrics.counter("retry.attempts").inc(op="copy_one")
+    metrics.gauge("trainer.channel_depth").set(3)
+    metrics.histogram("trainer.step_s").observe(0.012)
+    snap = metrics.snapshot()      # {"counters": ..., "gauges": ...,
+                                   #  "histograms": {name: {p50/p95/...}}}
+    metrics.reset_all()            # zero values, keep registrations
+"""
+
+import collections
+import math
+import threading
+
+
+def _label_key(labels):
+    """Stable flat key for a label set: 'k1=v1,k2=v2' ('' when unlabeled)."""
+    if not labels:
+        return ""
+    return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+def _percentile(sorted_vals, q):
+    """Linear-interpolated percentile of a pre-sorted list; q in [0, 1]."""
+    if not sorted_vals:
+        return None
+    idx = (len(sorted_vals) - 1) * q
+    lo, hi = int(math.floor(idx)), int(math.ceil(idx))
+    if lo == hi:
+        return sorted_vals[lo]
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+class Counter:
+    """Monotonic additive metric; one value per label set."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._vals = {}
+
+    def inc(self, n=1, **labels):
+        k = _label_key(labels)
+        with self._lock:
+            self._vals[k] = self._vals.get(k, 0) + n
+
+    def value(self, **labels):
+        with self._lock:
+            return self._vals.get(_label_key(labels), 0)
+
+    def total(self):
+        """Sum across every label set."""
+        with self._lock:
+            return sum(self._vals.values())
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._vals)
+
+    def reset(self):
+        with self._lock:
+            self._vals.clear()
+
+
+class Gauge:
+    """Last-write-wins level metric; one value per label set."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._vals = {}
+
+    def set(self, value, **labels):
+        with self._lock:
+            self._vals[_label_key(labels)] = value
+
+    def value(self, **labels):
+        with self._lock:
+            return self._vals.get(_label_key(labels))
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._vals)
+
+    def reset(self):
+        with self._lock:
+            self._vals.clear()
+
+
+class Histogram:
+    """Distribution metric: exact count/sum/min/max plus percentiles over
+    a bounded window of the most recent `max_samples` observations (the
+    window keeps memory flat over million-step runs; step-time
+    percentiles over the recent window are what regressions show up in
+    anyway)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", max_samples=2048):
+        self.name = name
+        self.help = help
+        self.max_samples = max_samples
+        self._lock = threading.Lock()
+        self._series = {}   # label key -> dict(count, sum, min, max, window)
+
+    def _slot(self, k):
+        s = self._series.get(k)
+        if s is None:
+            s = self._series[k] = {
+                "count": 0, "sum": 0.0, "min": None, "max": None,
+                "window": collections.deque(maxlen=self.max_samples)}
+        return s
+
+    def observe(self, value, **labels):
+        v = float(value)
+        with self._lock:
+            s = self._slot(_label_key(labels))
+            s["count"] += 1
+            s["sum"] += v
+            s["min"] = v if s["min"] is None else min(s["min"], v)
+            s["max"] = v if s["max"] is None else max(s["max"], v)
+            s["window"].append(v)
+
+    def count(self, **labels):
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s["count"] if s else 0
+
+    def percentile(self, q, **labels):
+        """q in [0, 1], over the retained window."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            vals = sorted(s["window"]) if s else []
+        return _percentile(vals, q)
+
+    def stats(self, **labels):
+        """{"count", "sum", "mean", "min", "max", "p50", "p95"} or None."""
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s["count"] == 0:
+                return None
+            vals = sorted(s["window"])
+            out = {"count": s["count"], "sum": s["sum"],
+                   "mean": s["sum"] / s["count"],
+                   "min": s["min"], "max": s["max"]}
+        out["p50"] = _percentile(vals, 0.50)
+        out["p95"] = _percentile(vals, 0.95)
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            keys = list(self._series)
+        out = {}
+        for k in keys:
+            labels = dict(p.split("=", 1) for p in k.split(",")) if k else {}
+            st = self.stats(**labels)
+            if st is not None:
+                out[k] = st
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._series.clear()
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create accessors. One process-global
+    default instance (`registry()`); tests may build private ones."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_make(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help=help, **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_make(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_make(Gauge, name, help)
+
+    def histogram(self, name, help="", max_samples=2048):
+        return self._get_or_make(Histogram, name, help,
+                                 max_samples=max_samples)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def snapshot(self):
+        """JSON-ready nested view. Unlabeled metrics flatten to scalars:
+        {"counters": {"checkpoint.saves": 2,
+                      "retry.attempts": {"op=copy_one": 3}}, ...}"""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        section = {"counter": "counters", "gauge": "gauges",
+                   "histogram": "histograms"}
+        for name, m in items:
+            snap = m.snapshot()
+            if not snap:
+                continue
+            if set(snap) == {""}:
+                snap = snap[""]
+            out[section[m.kind]][name] = snap
+        return out
+
+    def reset(self):
+        """Zero every metric; registrations (and helper text) survive."""
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m.reset()
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def registry():
+    """The process-global registry every framework counter lives in."""
+    return _DEFAULT
+
+
+def counter(name, help=""):
+    return _DEFAULT.counter(name, help)
+
+
+def gauge(name, help=""):
+    return _DEFAULT.gauge(name, help)
+
+
+def histogram(name, help="", max_samples=2048):
+    return _DEFAULT.histogram(name, help, max_samples=max_samples)
+
+
+def snapshot():
+    return _DEFAULT.snapshot()
+
+
+def reset_all():
+    _DEFAULT.reset()

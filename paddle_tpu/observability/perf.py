@@ -1,0 +1,72 @@
+"""Hardware-peak and cost-analysis helpers shared by bench.py and the
+Trainer's step telemetry.
+
+Moved out of bench.py (which keeps thin delegating wrappers) so MFU
+arithmetic has ONE home: the bench rows, the per-step RunLog records, and
+tools/run_report.py all compute achieved/peak from the same table.
+
+jax is imported lazily — bench.py's outer driver path (tunnel probe,
+captured-row fallback) must stay importable without touching the backend.
+"""
+
+import os
+
+
+def peak_flops():
+    """Per-chip peak bf16 FLOP/s; override with PT_PEAK_FLOPS."""
+    if "PT_PEAK_FLOPS" in os.environ:
+        return float(os.environ["PT_PEAK_FLOPS"])
+    import jax
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    # bf16 peaks: v5e (v5 lite) 197 TFLOP/s (394 is the int8 number);
+    # v5p: 459; v4: 275; v6e: 918
+    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def cost_flops(jitted, *args):
+    """FLOPs per call from XLA cost analysis; 0.0 when unavailable (non-
+    jitted callables, backends without cost analysis, tracing failures)."""
+    try:
+        c = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return float(c.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def mfu(flops_per_step, step_s):
+    """Achieved fraction of the chip's peak for one step, or None."""
+    if not flops_per_step or not step_s or step_s <= 0:
+        return None
+    return flops_per_step / step_s / peak_flops()
+
+
+# memory_stats keys worth carrying in a step record (full dict is noisy)
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_alloc_size")
+
+
+def device_memory_stats(device=None):
+    """Compact HBM stats for one device ({'peak_bytes_in_use': ...}), or
+    None where the backend has no allocator stats (CPU)."""
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        ms = d.memory_stats()
+    except Exception:
+        return None
+    if not ms:
+        return None
+    out = {k: int(ms[k]) for k in _MEM_KEYS if k in ms}
+    return out or {k: int(v) for k, v in ms.items()
+                   if isinstance(v, (int, float))} or None

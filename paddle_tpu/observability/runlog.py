@@ -1,0 +1,112 @@
+"""RunLog — append-only JSONL sink for per-step telemetry records.
+
+Ref: the reference framework printed step stats to stdout from each
+DeviceWorker thread and kept nothing machine-readable; its profiler wrote
+a one-shot chrome-trace (tools/timeline.py). The RunLog is the durable
+middle ground: one JSON object per line, flushed as written (a preempted
+or crashed run keeps everything up to its last step), with size-bounded
+rotation so million-step runs don't grow an unbounded artifact.
+
+    log = RunLog("/runs/exp1/run.jsonl", rotate_records=100_000)
+    log.write({"step": 10, "wall_s": 0.012, "loss": 3.2})
+    log.close()
+
+    for rec in read_records("/runs/exp1/run.jsonl"):  # rotated-aware
+        ...
+
+tools/run_report.py renders a RunLog (optionally joined with an XPlane
+trace dir) into the human-readable run report.
+"""
+
+import glob
+import json
+import os
+import threading
+
+
+class RunLog:
+    """Thread-safe JSONL writer with optional record-count rotation.
+
+    rotate_records=N (0 = never rotate): after N records the live file is
+    rolled to ``<path>.1`` (existing rolls shift up, the oldest beyond
+    ``keep_rotated`` is dropped) and a fresh file starts. ``read_records``
+    reassembles the full stream oldest-first.
+    """
+
+    def __init__(self, path, rotate_records=0, keep_rotated=3):
+        self.path = str(path)
+        self.rotate_records = int(rotate_records or 0)
+        self.keep_rotated = max(1, int(keep_rotated))
+        self._lock = threading.Lock()
+        self._fh = None
+        self._count = 0
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        return self._fh
+
+    def _rotate(self):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        oldest = f"{self.path}.{self.keep_rotated}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep_rotated - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        if os.path.exists(self.path):
+            os.replace(self.path, f"{self.path}.1")
+        self._count = 0
+
+    def write(self, record):
+        """Append one record (a JSON-serializable dict) and flush."""
+        line = json.dumps(record)
+        with self._lock:
+            if self.rotate_records and self._count >= self.rotate_records:
+                self._rotate()
+            fh = self._open()
+            fh.write(line + "\n")
+            fh.flush()
+            self._count += 1
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def read_records(path):
+    """Every record of a (possibly rotated) RunLog, oldest first.
+
+    Tolerates a torn final line (a run killed mid-write leaves at most
+    one truncated record; it is skipped, everything durable is kept)."""
+    files = sorted(
+        glob.glob(glob.escape(str(path)) + ".[0-9]*"),
+        key=lambda p: -int(p.rsplit(".", 1)[1]))
+    if os.path.exists(path):
+        files.append(str(path))
+    out = []
+    for f in files:
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue    # torn tail of a killed writer
+    return out

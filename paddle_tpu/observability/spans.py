@@ -1,0 +1,90 @@
+"""Trace spans — nestable host-side scopes backed by the metrics registry
+AND the device trace.
+
+Ref: /root/reference/paddle/fluid/platform/profiler.h:81 — the RAII
+``RecordEvent`` the reference wrapped around every op run, feeding both
+the sorted event tables (profiler.h:166) and the chrome-trace timeline
+(tools/timeline.py). Here one ``span()`` context manager feeds all three
+successors at once:
+
+  * the process-global `EventRecorder` text table (`span_report()`),
+  * a `span.<path>` Histogram in the metrics registry (p50/p95 land in
+    RunLog final snapshots and bench telemetry), and
+  * `jax.profiler.TraceAnnotation`, so the scope shows up as a named
+    range inside an XPlane trace next to the device ops it contains.
+
+Nesting concatenates names with '/': a span("ingest") inside
+span("step") records as "step/ingest" (per-thread stacks — ingestion
+threads and the device loop don't interleave each other's paths).
+
+    from paddle_tpu import observability as obs
+
+    with obs.span("step"):
+        with obs.span("stage"):
+            ...
+    print(obs.span_report())
+"""
+
+import contextlib
+import threading
+import time
+
+import jax
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.profiler import EventRecorder
+
+_TLS = threading.local()
+_RECORDER = EventRecorder()
+
+
+def recorder():
+    """The process-global EventRecorder behind span()."""
+    return _RECORDER
+
+
+@contextlib.contextmanager
+def span(name):
+    """Time a scope into the span table + metrics registry and annotate
+    the device trace. Nestable; cheap enough for per-step use (a
+    perf_counter pair and a TraceAnnotation — no device sync)."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(str(name))
+    full = "/".join(stack)
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(str(name)):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        _RECORDER.add(full, dt)
+        _metrics.histogram("span." + full).observe(dt)
+
+
+def annotate_span(name):
+    """Decorator twin of span() (ref: profiler.annotate_fn)."""
+    def deco(fn):
+        def wrapped(*a, **kw):
+            with span(name):
+                return fn(*a, **kw)
+        return wrapped
+    return deco
+
+
+def span_summary(sort_by="total"):
+    """Structured rows of every recorded span (EventRecorder.summary)."""
+    return _RECORDER.summary(sort_by=sort_by)
+
+
+def span_report():
+    """The sorted text table (ref: DisableProfiler's event table)."""
+    return _RECORDER.report()
+
+
+def reset_spans():
+    """Drop recorded span timings (registry histograms are reset
+    separately via metrics.reset_all)."""
+    _RECORDER.reset()

@@ -1,0 +1,192 @@
+"""Step telemetry — per-step run records for the Trainer, with no device
+sync on the hot path.
+
+Ref: the reference trainer printed loss from each DeviceWorker thread
+(device_worker.cc VLOGs) and had no notion of achieved utilization; its
+profiler had to be switched on globally. Here telemetry is a run-scoped,
+opt-in sidecar: the Trainer hands it (step, batch, loss) after every
+step and it emits JSONL records to a RunLog every N steps — wall time,
+tokens/s, achieved MFU (XLA cost analysis over perf.peak_flops),
+host-visible loss/grad-norm, and device memory peaks.
+
+Hot-path discipline: the loss scalar is NOT fetched for the step that
+just dispatched — that would serialize host and device exactly like the
+`float(loss)` logging path. Instead the device array is parked and
+fetched via `jax.device_get` at the NEXT emission point, by which time
+its step has long completed — the fetch returns without waiting on the
+in-flight step (tests assert no `block_until_ready` appears on the
+path). Records therefore trail by one interval; `finish()` flushes the
+last one plus a final metrics-registry snapshot.
+"""
+
+import dataclasses
+import time
+
+import jax
+
+from paddle_tpu.core import flags as F
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import perf as _perf
+from paddle_tpu.observability.runlog import RunLog
+from paddle_tpu.observability.spans import span_summary
+
+
+@dataclasses.dataclass
+class TelemetryConfig:
+    """Knobs for Trainer step telemetry. ``None`` fields resolve from the
+    ``telemetry*`` flags (env: PT_FLAGS_telemetry,
+    PT_FLAGS_telemetry_run_log, PT_FLAGS_telemetry_every_n) so a run can
+    be instrumented without code changes."""
+
+    enabled: bool = None          # None -> flag "telemetry"
+    run_log: str = None           # JSONL path; None -> flag ('' = memory)
+    every_n_steps: int = None     # None -> flag "telemetry_every_n"
+    rotate_records: int = 0       # RunLog rotation (0 = never)
+    flops_per_step: float = None  # known FLOPs; skips the estimate
+    estimate_flops: bool = True   # cost-analysis estimate when unknown
+    tokens_fn: object = None      # batch -> tokens/step (None = infer)
+    grad_norm_fn: object = None   # state -> device scalar (optional)
+
+    def resolve(self):
+        """A copy with every None filled from the current flags."""
+        c = dataclasses.replace(self)
+        if c.enabled is None:
+            c.enabled = bool(F.get_flag("telemetry"))
+        if c.run_log is None:
+            c.run_log = F.get_flag("telemetry_run_log") or None
+        if c.every_n_steps is None:
+            c.every_n_steps = int(F.get_flag("telemetry_every_n"))
+        c.every_n_steps = max(1, int(c.every_n_steps))
+        return c
+
+
+def default_tokens(batch):
+    """Tokens per step inferred from the batch: the first >=2-D array
+    contributes batch*seq; else the first array's leading dim (examples
+    stand in for tokens); else 0."""
+    arrays = [a for a in batch if getattr(a, "shape", None)]
+    for a in arrays:
+        if len(a.shape) >= 2:
+            return int(a.shape[0]) * int(a.shape[1])
+    for a in arrays:
+        if len(a.shape) >= 1:
+            return int(a.shape[0])
+    return 0
+
+
+class StepTelemetry:
+    """Accumulates per-step records and writes them to a RunLog.
+
+    Usage (what static/trainer.py does):
+
+        tele = StepTelemetry(TelemetryConfig(enabled=True, run_log=p))
+        tele.maybe_estimate_flops(jitted_step, state, *batch)   # once
+        for ...:
+            loss, state = jitted_step(state, *batch)
+            tele.on_step(step, batch, loss, state, wall_s)
+        tele.finish({"steps": step})
+    """
+
+    def __init__(self, config=None):
+        self.cfg = (config or TelemetryConfig()).resolve()
+        self.enabled = bool(self.cfg.enabled)
+        self.records = []          # in-memory mirror (tests, no-sink runs)
+        self._log = None
+        if self.enabled and self.cfg.run_log:
+            self._log = RunLog(self.cfg.run_log,
+                               rotate_records=self.cfg.rotate_records)
+        self._flops = self.cfg.flops_per_step
+        self._pending = None       # (step, wall_s, tokens, loss, gnorm)
+        self._hist = _metrics.histogram(
+            "trainer.step_s", "Per-step wall time seen by the Trainer.")
+        self._finished = False
+
+    # -- setup ------------------------------------------------------------
+    def maybe_estimate_flops(self, step_fn, *args):
+        """One-time FLOPs-per-step estimate via XLA cost analysis (only
+        when the config didn't supply flops_per_step). Runs BEFORE the
+        first step so donated buffers are still live; the lower+compile
+        hits the in-process executable cache for jitted fns. Failure
+        degrades to mfu=None records, never into the train loop."""
+        if not self.enabled or self._flops is not None:
+            return
+        if not self.cfg.estimate_flops or not hasattr(step_fn, "lower"):
+            self._flops = 0.0
+            return
+        self._flops = _perf.cost_flops(step_fn, *args)
+
+    # -- per-step ---------------------------------------------------------
+    def on_step(self, step, batch, loss, state=None, wall_s=None):
+        """Record one completed step. `loss` stays a device array — it is
+        parked and host-fetched at the next emission (trailing), so this
+        call never blocks on the device."""
+        if not self.enabled:
+            return
+        if wall_s is not None:
+            self._hist.observe(wall_s)
+        if step % self.cfg.every_n_steps != 0:
+            return
+        self._flush_pending(at_step=step)
+        tokens = (self.cfg.tokens_fn(batch) if self.cfg.tokens_fn
+                  else default_tokens(batch))
+        gnorm = (self.cfg.grad_norm_fn(state)
+                 if self.cfg.grad_norm_fn is not None else None)
+        self._pending = (int(step), wall_s, tokens, loss, gnorm)
+
+    def _flush_pending(self, at_step=None):
+        """Emit the parked record. When called from on_step(at_step), the
+        parked step is strictly older than `at_step` — its loss has been
+        computed for >= one full interval, so device_get returns without
+        stalling the in-flight step."""
+        if self._pending is None:
+            return
+        step, wall_s, tokens, loss, gnorm = self._pending
+        self._pending = None
+        rec = {"step": step, "time": time.time(), "wall_s": wall_s}
+        rec["tokens_per_s"] = (
+            tokens / wall_s if tokens and wall_s else None)
+        rec["mfu"] = _perf.mfu(self._flops, wall_s)
+        try:
+            rec["loss"] = (float(jax.device_get(loss))
+                           if loss is not None else None)
+        except Exception:
+            rec["loss"] = None
+        try:
+            rec["grad_norm"] = (float(jax.device_get(gnorm))
+                                if gnorm is not None else None)
+        except Exception:
+            rec["grad_norm"] = None
+        rec["memory"] = _perf.device_memory_stats()
+        self._write(rec)
+
+    def _write(self, rec):
+        self.records.append(rec)
+        if self._log is not None:
+            self._log.write(rec)
+
+    # -- teardown ---------------------------------------------------------
+    def finish(self, extra=None):
+        """Flush the trailing record and write the final snapshot record:
+        the full metrics-registry state (retry / pallas-fallback /
+        checkpoint / heartbeat / trainer counters) + step-time stats +
+        the span table — the run's whole degraded-path story in one
+        JSON object."""
+        if not self.enabled or self._finished:
+            return
+        self._finished = True
+        self._flush_pending()
+        snap = _metrics.snapshot()
+        rec = {"final": True, "time": time.time(),
+               "counters": snap.get("counters", {}),
+               "gauges": snap.get("gauges", {}),
+               "histograms": snap.get("histograms", {}),
+               "step_time": self._hist.stats(),
+               "spans": span_summary()}
+        if extra:
+            rec.update(extra)
+        self._write(rec)
+        if self._log is not None:
+            self._log.close()
+
+    def close(self):
+        self.finish()

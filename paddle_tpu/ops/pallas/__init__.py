@@ -13,6 +13,8 @@ import logging
 
 import jax
 
+from paddle_tpu.observability import metrics as _metrics
+
 logger = logging.getLogger("paddle_tpu.pallas")
 _fallback_logged = set()
 
@@ -22,7 +24,13 @@ def log_fallback(kernel, reason, level=logging.WARNING):
     benchmarking the "fused" configuration knows they are measuring the
     chunked XLA fallback. Callers include the *requested* configuration
     (shapes, layout, sharding) vs. what the kernel supports in `reason` —
-    a silent drop under GSPMD is otherwise invisible."""
+    a silent drop under GSPMD is otherwise invisible.
+
+    Every refusal (not just the first) also increments the
+    `pallas.fallback{kernel=...}` counter, so a run's final telemetry
+    snapshot names which kernels ran their XLA fallback — the log line
+    is one-time, the counter is the record."""
+    _metrics.counter("pallas.fallback").inc(kernel=kernel)
     key = (kernel, reason)
     if key not in _fallback_logged:
         _fallback_logged.add(key)

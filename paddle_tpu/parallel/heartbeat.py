@@ -21,6 +21,7 @@ import threading
 import time
 
 from paddle_tpu.core import flags as F
+from paddle_tpu.observability import metrics as _metrics
 
 UNINITED = "UNINITED"
 RUNNING = "RUNNING"
@@ -68,6 +69,7 @@ class HeartBeatMonitor:
                 st = self._state.get(w, UNINITED)
                 if st == RUNNING and age > self.timeout_s:
                     st = self._state[w] = STALLED
+                    _metrics.counter("heartbeat.missed").inc(worker=w)
                     if self.on_stall is not None:
                         self.on_stall(w, age)
                 out[w] = (st, age)
@@ -257,6 +259,7 @@ class KVMonitor:
             if age > self.timeout_s:
                 if w not in self._stalled:
                     self._stalled.add(w)
+                    _metrics.counter("heartbeat.missed").inc(worker=w)
                     if self.on_stall is not None:
                         self.on_stall(w, age)
                 out[w] = (STALLED, age)
@@ -272,6 +275,7 @@ def kv_barrier(name, timeout_s=300.0, client=None):
     elastic controller keeps waiting on the former and evicts/restarts on
     the latter (same classification as KVMonitor.scan)."""
     client = client if client is not None else _kv_client()
+    t0 = time.monotonic()
     try:
         client.wait_at_barrier(name, int(timeout_s * 1000))
     except Exception as e:
@@ -283,6 +287,9 @@ def kv_barrier(name, timeout_s=300.0, client=None):
         raise PeerFailureError(
             f"kv_barrier '{name}': coordination service error — a peer "
             f"task likely died: {msg}") from e
+    finally:
+        _metrics.counter("heartbeat.barrier_wait_s").inc(
+            time.monotonic() - t0, barrier=name)
 
 
 def barrier_with_timeout(directory, worker, num_workers, timeout_s=300.0,
@@ -298,14 +305,19 @@ def barrier_with_timeout(directory, worker, num_workers, timeout_s=300.0,
     with open(mine, "w") as f:
         f.write(str(worker))
     deadline = time.time() + timeout_s
-    while True:
-        present = {i for i in range(num_workers)
-                   if os.path.exists(os.path.join(directory, f"{tag}.{i}"))}
-        if len(present) == num_workers:
-            return
-        if time.time() > deadline:
-            missing = sorted(set(range(num_workers)) - present)
-            raise TimeoutError(
-                f"barrier '{tag}' timed out after {timeout_s}s; "
-                f"missing workers {missing}")
-        time.sleep(poll_s)
+    t0 = time.monotonic()
+    try:
+        while True:
+            present = {i for i in range(num_workers) if os.path.exists(
+                os.path.join(directory, f"{tag}.{i}"))}
+            if len(present) == num_workers:
+                return
+            if time.time() > deadline:
+                missing = sorted(set(range(num_workers)) - present)
+                raise TimeoutError(
+                    f"barrier '{tag}' timed out after {timeout_s}s; "
+                    f"missing workers {missing}")
+            time.sleep(poll_s)
+    finally:
+        _metrics.counter("heartbeat.barrier_wait_s").inc(
+            time.monotonic() - t0, barrier=tag)

@@ -40,6 +40,15 @@ def record_event(name):
     return jax.profiler.TraceAnnotation(name)
 
 
+def span(name):
+    """record_event promoted: the registry-backed span
+    (observability/spans.py) — times the scope into the global
+    EventRecorder table AND a metrics histogram AND the device trace.
+    Lazy import: spans.py imports this module for EventRecorder."""
+    from paddle_tpu.observability.spans import span as _span
+    return _span(name)
+
+
 def annotate_fn(name):
     def deco(fn):
         def wrapped(*a, **kw):
@@ -51,7 +60,13 @@ def annotate_fn(name):
 
 class EventRecorder:
     """Host-side timing table (ref: profiler.cc event tables printed by
-    DisableProfiler). Times python-visible spans (incl. dispatch+block)."""
+    DisableProfiler). Times python-visible spans (incl. dispatch+block).
+
+    This is the recorder behind observability.span(); `add()` is the
+    non-context entry those spans feed, `reset()` starts a fresh epoch
+    (state is otherwise append-forever), and summary/report carry
+    p50/p95 alongside min/max — the tail is where step-time regressions
+    live."""
 
     def __init__(self):
         self._events = defaultdict(list)
@@ -62,26 +77,46 @@ class EventRecorder:
         try:
             yield
         finally:
-            self._events[name].append(time.perf_counter() - t0)
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name, seconds):
+        """Record one externally-timed occurrence of `name`."""
+        self._events[name].append(seconds)
+
+    def reset(self):
+        """Drop all recorded events (ref: ResetProfiler)."""
+        self._events.clear()
+
+    @staticmethod
+    def _pctl(sorted_times, q):
+        idx = (len(sorted_times) - 1) * q
+        lo, hi = int(idx), min(int(idx) + 1, len(sorted_times) - 1)
+        frac = idx - lo
+        return sorted_times[lo] * (1.0 - frac) + sorted_times[hi] * frac
 
     def summary(self, sort_by="total"):
         rows = []
         for name, times in self._events.items():
+            ts = sorted(times)
             rows.append({
                 "name": name, "calls": len(times),
                 "total_s": sum(times),
                 "avg_ms": 1e3 * sum(times) / len(times),
-                "min_ms": 1e3 * min(times), "max_ms": 1e3 * max(times),
+                "min_ms": 1e3 * ts[0], "max_ms": 1e3 * ts[-1],
+                "p50_ms": 1e3 * self._pctl(ts, 0.50),
+                "p95_ms": 1e3 * self._pctl(ts, 0.95),
             })
         rows.sort(key=lambda r: -r["total_s"])
         return rows
 
     def report(self):
         lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(ms)':>12}"
+                 f"{'p50(ms)':>12}{'p95(ms)':>12}"
                  f"{'Min(ms)':>12}{'Max(ms)':>12}"]
         for r in self.summary():
             lines.append(f"{r['name']:<40}{r['calls']:>8}{r['total_s']:>12.4f}"
-                         f"{r['avg_ms']:>12.3f}{r['min_ms']:>12.3f}"
+                         f"{r['avg_ms']:>12.3f}{r['p50_ms']:>12.3f}"
+                         f"{r['p95_ms']:>12.3f}{r['min_ms']:>12.3f}"
                          f"{r['max_ms']:>12.3f}")
         return "\n".join(lines)
 
@@ -95,7 +130,9 @@ def trace_op_table(trace_dir, device_filter="TPU", top=30, steps=1):
 
     trace_dir: the directory passed to jax.profiler.trace / pt.profiler.
     device_filter: substring of the process/device lane name to aggregate
-    ("TPU" for device ops; use "CPU" on the host platform).
+    ("TPU" for device ops; "CPU" on the host platform; None = every
+    lane, including events whose pid has no process_name metadata —
+    some XPlane exports name only a subset of lanes).
     steps: divide totals by this to report per-step time.
 
     Returns a list of {"name", "total_us", "per_step_us", "count"} sorted
@@ -114,16 +151,22 @@ def trace_op_table(trace_dir, device_filter="TPU", top=30, steps=1):
     with gzip.open(files[-1]) as f:
         data = json.load(f)
     ev = data.get("traceEvents", [])
-    lanes = {e["pid"]: e["args"].get("name", "")
+    # metadata events may carry no "args" dict at all (observed in real
+    # XPlane exports) — e.get("args", {}) instead of e["args"], and a
+    # lane without a pid key is simply unnamed
+    lanes = {e.get("pid"): e.get("args", {}).get("name", "")
              for e in ev if e.get("ph") == "M"
              and e.get("name") == "process_name"}
     dur = collections.Counter()
     cnt = collections.Counter()
     for e in ev:
-        if e.get("ph") != "X":
+        if e.get("ph") != "X" or "name" not in e:
             continue
-        if device_filter not in lanes.get(e.get("pid"), ""):
-            continue
+        if device_filter is not None:
+            # events whose pid never got a process_name lane fall
+            # through as "" — they match only an empty/None filter
+            if device_filter not in lanes.get(e.get("pid"), ""):
+                continue
         dur[e["name"]] += e.get("dur", 0)
         cnt[e["name"]] += 1
     rows = [{"name": n, "total_us": d, "per_step_us": d / max(steps, 1),

@@ -26,6 +26,8 @@ import time
 import jax
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.spans import span
 from paddle_tpu.testing.chaos import fault_point
 
 # conventional "rescheduleable interruption" exit status (BSD EX_TEMPFAIL);
@@ -83,6 +85,13 @@ class TrainerConfig:
     # of losing up to checkpoint_every steps
     handle_preemption: bool = False
     preemption_signals: tuple = None  # default (SIGTERM, SIGINT)
+    # step telemetry (observability/telemetry.py): an opt-in
+    # TelemetryConfig; None also honors the global `telemetry` flag
+    # (PT_FLAGS_telemetry=1 instruments without code changes). Records
+    # (wall time, tokens/s, MFU, trailing-fetch loss, memory peaks) go
+    # to the configured RunLog every N steps with no device sync added
+    # to the hot path.
+    telemetry: object = None
 
 
 class _EndOfData:
@@ -109,6 +118,7 @@ class Trainer:
         self.cfg = config or TrainerConfig()
         self.sparse_tables = sparse_tables or []
         self.history = []
+        self.telemetry = None    # StepTelemetry after train() when enabled
 
     # -- DataFeed channel (ref data_feed.cc multi-threaded file->channel) --
     def _start_ingest(self, readers):
@@ -260,6 +270,9 @@ class Trainer:
                 for w, (st, age) in scan_once().items():
                     if w != wid and st == STALLED and w not in stalled:
                         stalled.add(w)
+                        if not kv_mode:   # KVMonitor counts its own latch
+                            _metrics.counter("heartbeat.missed").inc(
+                                worker=w)
                         if cfg.on_peer_stall is not None:
                             cfg.on_peer_stall(w, age)
                         else:
@@ -281,6 +294,22 @@ class Trainer:
             t.join(timeout=5)
 
         return ping, finish
+
+    def _start_telemetry(self):
+        """StepTelemetry when TrainerConfig.telemetry is set (or the
+        global `telemetry` flag is on); None = zero telemetry work in
+        the loop. The instance is kept on self.telemetry so callers can
+        read .records after train()."""
+        from paddle_tpu.core import flags as F
+        tcfg = self.cfg.telemetry
+        if tcfg is None and not F.get_flag("telemetry"):
+            return None
+        from paddle_tpu.observability.telemetry import (StepTelemetry,
+                                                        TelemetryConfig)
+        tele = StepTelemetry(tcfg if tcfg is not None
+                             else TelemetryConfig())
+        self.telemetry = tele
+        return tele if tele.enabled else None
 
     def train(self, state, dataset, batch_size=None, num_workers=None,
               worker_id=None):
@@ -314,21 +343,36 @@ class Trainer:
         chan, stop, errors = self._start_ingest(
             self._split_readers(dataset))
         hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
+        tele = self._start_telemetry()
         t0 = time.perf_counter()
         loss = None
+        stall_ctr = _metrics.counter(
+            "trainer.ingest_stall_s",
+            "Wall time the device loop spent blocked on the ingest "
+            "channel.")
+        depth_gauge = _metrics.gauge(
+            "trainer.channel_depth",
+            "Ingest channel occupancy sampled at each dequeue.")
 
         def stage(batch):
             # host->device transfer starts now, overlapping the running step
             return tuple(jax.device_put(a) for a in batch)
 
+        def get_item():
+            tw0 = time.perf_counter()
+            item = chan.get()
+            stall_ctr.inc(time.perf_counter() - tw0)
+            depth_gauge.set(chan.qsize())
+            return item
+
         def next_batch():
             if batch_size is None:
-                item = chan.get()
+                item = get_item()
                 return None if isinstance(item, _EndOfData) else item
             from paddle_tpu.data.loader import _collate
             buf = []
             while len(buf) < batch_size:
-                item = chan.get()
+                item = get_item()
                 if isinstance(item, _EndOfData):
                     return None  # drop_last on the merged stream
                 buf.append(item)
@@ -337,19 +381,37 @@ class Trainer:
         clean = False
         preempted_sig = None
         try:
-            nxt = next_batch()
+            with span("ingest"):
+                nxt = next_batch()
+            first = True
+            it_t = time.perf_counter()
             while nxt is not None:
                 if cfg.max_steps is not None and step >= cfg.max_steps:
                     break
-                staged = stage(nxt)
+                with span("stage"):
+                    staged = stage(nxt)
                 # prefetch the following batch while this step runs
-                nxt = next_batch() if cfg.prefetch else nxt
+                if cfg.prefetch:
+                    with span("ingest"):
+                        nxt = next_batch()
+                if first and tele is not None and not self.sparse_tables:
+                    tele.maybe_estimate_flops(self.step_fn, state, *staged)
+                first = False
 
-                if self.sparse_tables:
-                    state, loss = self._sparse_step(state, staged)
-                else:
-                    loss, state = self.step_fn(state, *staged)
+                with span("step"):
+                    if self.sparse_tables:
+                        state, loss = self._sparse_step(state, staged)
+                    else:
+                        loss, state = self.step_fn(state, *staged)
                 step += 1
+                now = time.perf_counter()
+                if tele is not None:
+                    # the loss stays a device array here — telemetry
+                    # fetches it one interval later (trailing), never
+                    # syncing on the step just dispatched
+                    tele.on_step(step, staged, loss, state,
+                                 wall_s=now - it_t)
+                it_t = now
                 hb_ping()
                 if preempt["signum"] is not None:
                     # step boundary after a preemption notice: flush a
@@ -358,6 +420,7 @@ class Trainer:
                     if ckpt_mgr is not None:
                         ckpt_mgr.save(step, state, force=True)
                     preempted_sig = preempt["signum"]
+                    _metrics.counter("trainer.preempted").inc()
                     print(f"[trainer] preemption signal {preempted_sig}: "
                           f"checkpointed step {step}, exiting for resume")
                     break
@@ -368,7 +431,8 @@ class Trainer:
                     self.history.append((step, lv))
                     print(f"[trainer] step {step} loss {lv:.6f}")
                 if not cfg.prefetch:
-                    nxt = next_batch()
+                    with span("ingest"):
+                        nxt = next_batch()
             clean = preempted_sig is None
         finally:
             stop.set()  # release producers even when step_fn raises
@@ -378,6 +442,9 @@ class Trainer:
             hb_finish(clean)
             if ckpt_mgr is not None:
                 ckpt_mgr.close()
+            if tele is not None:
+                tele.finish({"steps": step, "preempted":
+                             preempted_sig is not None})
         if preempted_sig is not None:
             raise Preempted(step, preempted_sig)
         run_steps = step - start_step

@@ -107,11 +107,19 @@ class TestDriverPaths:
              "PT_BENCH_TIMEOUT": "45"})
         assert row["metric"] == "bench_failed"
 
-    def test_compile_only_emits_marker_row(self):
-        row = _run_bench(["--model", "ctr", "--compile-only"], {},
-                         timeout=420)
+    def test_compile_only_emits_marker_row(self, tmp_path):
+        run_log = tmp_path / "bench_run.jsonl"
+        row = _run_bench(["--model", "ctr", "--compile-only",
+                          "--run-log", str(run_log)], {}, timeout=420)
         assert row["metric"] == "ctr_compile_only"
         assert row["unit"] == "compiled" and row["compile_s"] >= 0
+        # every row is self-describing: registry counter snapshot rides
+        # along (observability satellite), and --run-log streamed the
+        # final record
+        assert "telemetry" in row and "counters" in row["telemetry"]
+        recs = [json.loads(line) for line in
+                run_log.read_text().splitlines()]
+        assert recs and recs[-1]["final"] is True
 
     def test_suite_wedge_after_probe_uses_cached_flagship(self, tmp_path):
         """Suite mode, probe alive, children HANG past their cap (the

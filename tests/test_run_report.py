@@ -1,0 +1,98 @@
+"""tools/run_report.py — the RunLog + trace join CLI.
+
+The --selftest subprocess is the tier-1 smoke (marker `perf`, like the
+compile smokes): a tiny GPT trained through the Trainer with telemetry
+on must produce a complete RunLog (wall time, tokens/s, MFU, loss,
+memory, pallas-fallback + checkpoint counters) and this CLI must render
+it — so the telemetry path can never silently rot."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_REPORT = os.path.join(REPO, "tools", "run_report.py")
+
+
+def _records():
+    steps = [{"step": s, "time": 100.0 + s, "wall_s": 0.01 + 0.001 * s,
+              "tokens_per_s": 1000.0 - s, "mfu": 0.3 + 0.01 * s,
+              "loss": 5.0 - 0.1 * s, "grad_norm": None,
+              "memory": {"peak_bytes_in_use": 1 << 20}}
+             for s in range(1, 11)]
+    final = {"final": True, "steps": 10,
+             "counters": {"checkpoint.saves": 2,
+                          "pallas.fallback": {"kernel=xent_stats": 3}},
+             "spans": [{"name": "step", "calls": 10, "total_s": 0.5,
+                        "p50_ms": 10.0, "p95_ms": 20.0}]}
+    return steps + [final]
+
+
+def test_render_report_sections():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from run_report import render_report
+    finally:
+        sys.path.pop(0)
+    rep = render_report(_records())
+    assert "step records: 10" in rep
+    assert "p50=" in rep and "p95=" in rep and "p99=" in rep
+    assert "MFU curve:" in rep
+    assert "loss:" in rep and "first=4.900000" in rep
+    assert "memory peak: 1.0 MiB" in rep
+    assert "pallas.fallback{kernel=xent_stats}" in rep
+    assert "checkpoint.saves" in rep
+    assert "spans:" in rep
+
+
+def test_cli_renders_runlog(tmp_path):
+    p = tmp_path / "run.jsonl"
+    with open(p, "w") as f:
+        for r in _records():
+            f.write(json.dumps(r) + "\n")
+    proc = subprocess.run(
+        [sys.executable, RUN_REPORT, str(p)], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "RUN REPORT" in proc.stdout
+    assert "checkpoint.saves" in proc.stdout
+
+
+def test_cli_counter_deltas_across_snapshots(tmp_path):
+    """Two final snapshots (a resumed run appending to one RunLog) ->
+    the report shows deltas since the first."""
+    recs = _records()
+    recs.append({"final": True, "steps": 20,
+                 "counters": {"checkpoint.saves": 5,
+                              "pallas.fallback": {"kernel=xent_stats": 3}}})
+    p = tmp_path / "run.jsonl"
+    with open(p, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    proc = subprocess.run(
+        [sys.executable, RUN_REPORT, str(p)], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "delta since first snapshot" in proc.stdout
+    assert "(+3)" in proc.stdout        # saves went 2 -> 5
+
+
+@pytest.mark.perf
+def test_run_report_selftest_smoke():
+    """Tier-1: tiny GPT through the Trainer with telemetry on (CPU),
+    RunLog completeness asserted, report rendered — end to end in a
+    child process (the acceptance-criteria path)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, RUN_REPORT, "--selftest"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    assert "SELFTEST OK" in proc.stdout
+    assert "RUN REPORT" in proc.stdout
+    assert "pallas.fallback" in proc.stdout

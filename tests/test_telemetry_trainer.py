@@ -1,0 +1,256 @@
+"""Trainer step telemetry + chaos-driven counter wiring.
+
+The observability acceptance surface: a Trainer run with
+TelemetryConfig(enabled=True) produces RunLog records (wall time,
+tokens/s, MFU, loss, memory) with monotonically increasing step ids and
+a final counter snapshot — while adding NO device sync to the hot path
+(the loss fetch trails by one emission interval); and the degraded-path
+counters (retry, torn-checkpoint) increment under injected faults
+(testing/chaos.FaultPlan)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.observability import TelemetryConfig, metrics as M
+from paddle_tpu.observability.runlog import read_records
+from paddle_tpu.static import Trainer, TrainerConfig
+
+
+def _linreg_step():
+    opt = pt.optimizer.SGD(0.1)
+    params = {"w": jnp.zeros((4, 1))}
+    state = {"params": params, "opt": opt.init(params)}
+
+    @jax.jit
+    def step(st, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] - y))
+        loss, grads = jax.value_and_grad(loss_fn)(st["params"])
+        p, o = opt.apply_gradients(st["params"], grads, st["opt"])
+        return loss, {"params": p, "opt": o}
+
+    return step, state
+
+
+def _dataset(n=10, b=8):
+    rng = np.random.RandomState(0)
+    return pt.data.InMemoryDataset(
+        [(rng.rand(b, 4).astype(np.float32),
+          rng.rand(b, 1).astype(np.float32)) for _ in range(n)])
+
+
+class TestTrainerTelemetry:
+    def test_runlog_records_monotonic_and_complete(self, tmp_path):
+        step, state = _linreg_step()
+        run_log = str(tmp_path / "run.jsonl")
+        cfg = TrainerConfig(
+            num_ingest_threads=1,
+            telemetry=TelemetryConfig(enabled=True, run_log=run_log,
+                                      every_n_steps=2))
+        tr = Trainer(step, cfg)
+        _, stats = tr.train(state, _dataset(n=7))
+        assert stats["steps"] == 7
+
+        records = read_records(run_log)
+        steps = [r for r in records if "step" in r and not r.get("final")]
+        finals = [r for r in records if r.get("final")]
+        ids = [r["step"] for r in steps]
+        assert ids == [2, 4, 6]                       # every_n=2, trailing
+        assert ids == sorted(ids) and len(set(ids)) == len(ids)
+        for r in steps:
+            assert isinstance(r["wall_s"], float) and r["wall_s"] > 0
+            assert r["tokens_per_s"] > 0              # 8x4 batch -> tokens
+            assert isinstance(r["loss"], float)
+            assert "mfu" in r and "memory" in r       # null ok on CPU
+        assert len(finals) == 1
+        assert finals[-1]["steps"] == 7
+        assert "counters" in finals[-1]
+        assert finals[-1]["step_time"]["count"] == 7
+        # the trainer's own instrumentation appears in the snapshot
+        assert "trainer.ingest_stall_s" in finals[-1]["counters"]
+        # in-memory mirror matches the file
+        assert len(tr.telemetry.records) == len(records)
+
+    def test_no_device_sync_on_hot_path(self, tmp_path, monkeypatch):
+        """The acceptance assertion: telemetry adds no
+        block_until_ready-style sync while steps dispatch, and every
+        mid-run loss fetch is TRAILING (the parked step is strictly
+        older than the step just dispatched)."""
+        import paddle_tpu.observability.telemetry as T
+
+        def no_sync(*a, **kw):
+            raise AssertionError("block_until_ready on the telemetry "
+                                 "hot path")
+
+        monkeypatch.setattr(jax, "block_until_ready", no_sync)
+
+        flushes = []
+        orig = T.StepTelemetry._flush_pending
+
+        def spy(self, at_step=None):
+            if self._pending is not None:
+                flushes.append((self._pending[0], at_step))
+            return orig(self, at_step=at_step)
+
+        monkeypatch.setattr(T.StepTelemetry, "_flush_pending", spy)
+
+        step, state = _linreg_step()
+        run_log = str(tmp_path / "run.jsonl")
+        cfg = TrainerConfig(
+            num_ingest_threads=1,
+            telemetry=TelemetryConfig(enabled=True, run_log=run_log,
+                                      every_n_steps=1))
+        Trainer(step, cfg).train(state, _dataset(n=6))
+
+        mid_run = [(p, a) for p, a in flushes if a is not None]
+        assert mid_run, "no trailing flush observed"
+        for parked, current in mid_run:
+            assert parked < current     # fetch is >= 1 interval behind
+        # the last record flushed at finish (at_step=None)
+        assert flushes[-1][1] is None
+        recs = read_records(run_log)
+        assert [r["step"] for r in recs if "step" in r
+                and not r.get("final")] == [1, 2, 3, 4, 5, 6]
+
+    def test_flag_driven_enablement(self, tmp_path):
+        """PT_FLAGS_telemetry-style enablement: cfg.telemetry=None but
+        the global flags turn telemetry on (env-only instrumentation)."""
+        from paddle_tpu.core import flags as F
+        run_log = str(tmp_path / "flag_run.jsonl")
+        old = {k: F.get_flag(k) for k in
+               ("telemetry", "telemetry_run_log", "telemetry_every_n")}
+        F.set_flags({"telemetry": True, "telemetry_run_log": run_log,
+                     "telemetry_every_n": 1})
+        try:
+            step, state = _linreg_step()
+            Trainer(step, TrainerConfig(num_ingest_threads=1)).train(
+                state, _dataset(n=3))
+        finally:
+            F.set_flags(old)
+        recs = read_records(run_log)
+        assert [r["step"] for r in recs
+                if "step" in r and not r.get("final")] == [1, 2, 3]
+
+    def test_disabled_telemetry_is_free(self):
+        step, state = _linreg_step()
+        tr = Trainer(step, TrainerConfig(num_ingest_threads=1))
+        tr.train(state, _dataset(n=2))
+        assert tr.telemetry is None     # no StepTelemetry built at all
+
+    def test_grad_norm_fn_and_tokens_fn(self, tmp_path):
+        step, state = _linreg_step()
+        run_log = str(tmp_path / "run.jsonl")
+        cfg = TrainerConfig(
+            num_ingest_threads=1,
+            telemetry=TelemetryConfig(
+                enabled=True, run_log=run_log, every_n_steps=1,
+                tokens_fn=lambda batch: 123,
+                grad_norm_fn=lambda st: jnp.linalg.norm(st["params"]["w"])))
+        Trainer(step, cfg).train(state, _dataset(n=3))
+        recs = [r for r in read_records(run_log)
+                if "step" in r and not r.get("final")]
+        for r in recs:
+            assert r["tokens_per_s"] == pytest.approx(123 / r["wall_s"])
+            assert isinstance(r["grad_norm"], float)
+
+    def test_preempted_counter_and_final_record(self, tmp_path):
+        """A preempted run still lands its final telemetry record, and
+        the preemption is counted."""
+        import signal
+        from paddle_tpu.static.trainer import Preempted
+
+        c0 = M.counter("trainer.preempted").total()
+        run_log = str(tmp_path / "run.jsonl")
+
+        step, state = _linreg_step()
+        fired = {"done": False}
+
+        def step_with_sig(st, x, y):
+            if not fired["done"]:
+                fired["done"] = True
+                os.kill(os.getpid(), signal.SIGTERM)
+            return step(st, x, y)
+
+        cfg = TrainerConfig(
+            num_ingest_threads=1, handle_preemption=True,
+            checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=100,
+            telemetry=TelemetryConfig(enabled=True, run_log=run_log,
+                                      every_n_steps=1))
+        with pytest.raises(Preempted):
+            Trainer(step_with_sig, cfg).train(state, _dataset(n=6))
+        assert M.counter("trainer.preempted").total() == c0 + 1
+        finals = [r for r in read_records(run_log) if r.get("final")]
+        assert finals and finals[-1]["preempted"] is True
+
+
+@pytest.mark.chaos
+class TestChaosCounterWiring:
+    """Satellite: injected faults must show up in the registry — retry
+    attempts on flaky remote writes, torn-commit skips on a crashed
+    mirror (reusing testing/chaos.FaultPlan + ChaosFS over MemFS)."""
+
+    def test_retry_attempts_increment_under_injected_write_faults(
+            self, tmp_path):
+        from paddle_tpu.io import fs
+        from paddle_tpu.testing import chaos
+
+        plan = chaos.FaultPlan(seed=1).fail("write", times=2)
+        fs.register_filesystem("obscha1", chaos.ChaosFS(fs.MemFS(), plan))
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "a.bin").write_bytes(b"x" * 64)
+
+        att = M.counter("retry.attempts")
+        before = att.value(op="copy_one")
+        fs.put_tree(str(src), "obscha1://ck/1")        # retries through
+        assert plan.fired("write") == 2
+        assert att.value(op="copy_one") == before + 2
+
+    def test_torn_commit_and_mirror_degraded_counters(self, tmp_path):
+        """A mirror whose COMMIT push keeps failing: the save degrades
+        (queued, counted), the remote step stays torn, and the next
+        discovery counts the torn skip and refuses the step."""
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.io import fs
+        from paddle_tpu.io.checkpoint import CheckpointManager
+        from paddle_tpu.testing import chaos
+
+        plan = chaos.FaultPlan(seed=2).fail("write", path=r"COMMIT",
+                                            times=20)
+        store = chaos.ChaosFS(fs.MemFS(), plan)
+        fs.register_filesystem("obscha2", store)
+        # unique remote path per run: the local staging dir is keyed on
+        # the remote URL hash and persists across pytest invocations
+        import uuid
+        remote = f"obscha2://{uuid.uuid4().hex[:10]}/ck"
+
+        deg = M.counter("checkpoint.mirror_degraded")
+        torn = M.counter("checkpoint.torn_skips")
+        d0, t0 = deg.total(), torn.total()
+
+        old = {k: F.get_flag(k) for k in ("retry_max_attempts",
+                                          "retry_backoff_base_s")}
+        F.set_flags({"retry_max_attempts": 2,
+                     "retry_backoff_base_s": 0.001})
+        try:
+            mgr = CheckpointManager(remote, save_interval_steps=1)
+            state = {"w": np.ones((2,), np.float32)}
+            assert mgr.save(1, state)          # mirror degrades, queued
+            assert deg.total() == d0 + 1
+            assert mgr._mirror_pending == [1]
+
+            # the torn remote step is invisible to discovery — and
+            # counted
+            mgr2 = CheckpointManager(remote)
+            restored, at = mgr2.restore(state)
+            assert restored is None and at is None
+            assert torn.total() > t0
+        finally:
+            F.set_flags(old)
+            mgr.close()

@@ -125,7 +125,7 @@ def _co(name, jitted, *args):
     return _mesh_row(row)
 
 
-def _timed_steps(step_once, steps):
+def _timed_steps(step_once, steps, tokens_per_step=None):
     """Per-step wall time with the remote-dispatch latency cancelled.
 
     On the tunneled TPU platform `block_until_ready` returns before the
@@ -151,8 +151,15 @@ def _timed_steps(step_once, steps):
             hist.observe(dt_s)
             step_no["n"] += 1
             if RUN_LOG is not None:
-                RUN_LOG.write({"phase": "bench", "step": step_no["n"],
-                               "wall_s": dt_s})
+                rec = {"phase": "bench", "step": step_no["n"],
+                       "wall_s": dt_s}
+                if tokens_per_step:
+                    # decode rows: each "step" emits a whole generation
+                    # burst, so the record carries its own tokens/s
+                    rec["tokens"] = tokens_per_step
+                    rec["tokens_per_s"] = round(tokens_per_step
+                                                / max(dt_s, 1e-9), 1)
+                RUN_LOG.write(rec)
         lv = float(loss)  # host fetch = true barrier
         return time.perf_counter() - t0, lv
 
@@ -440,7 +447,7 @@ def bench_gpt_decode(steps, batch, seq):
         st["prompt"] = out[:, -prompt_len:]
         return out[0, -1]
 
-    dt, _ = _timed_steps(step_once, steps)
+    dt, _ = _timed_steps(step_once, steps, tokens_per_step=batch * max_new)
     toks_per_s = batch * max_new / dt
     # decode is bandwidth-bound: every decode step reads all params once
     # AND streams the whole padded KV cache (at serving batch sizes the
@@ -471,6 +478,86 @@ def bench_gpt_decode(steps, batch, seq):
         "note": "KV-cache greedy decode; bandwidth-bound — vs_baseline "
                 "is fraction of HBM roofline over params + padded KV "
                 "cache per decoded token",
+    }
+
+
+def bench_gpt_serve(steps, batch, seq):
+    """Continuous-batching serving throughput (paddle_tpu/serving/):
+    mixed-length prompts streamed through `batch` decode slots over the
+    paged KV cache — the production serving shape, vs gpt_decode's
+    fixed lockstep batch. Reports decoded tokens/s/chip plus
+    telemetry-backed p50/p95 per-token latency and TTFT from the
+    serve.* histograms (the PR-4 registry). Request mix: 4x slots
+    requests, prompt lengths uniform in [seq//8, prefill_len],
+    max_new=64 each. PT_BENCH_PAGE_SIZE overrides the page size
+    (default 64; 128 fills a TPU lane tile)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.serving import ServeConfig, ServingEngine
+
+    cfg = GPTConfig.tiny() if TINY else GPTConfig.small()
+    cfg.dropout = 0.0
+    model = GPTDecoder(cfg)
+    variables = model.init(jax.random.key(0))
+
+    max_new = 32 if TINY else 64
+    page = int(os.environ.get("PT_BENCH_PAGE_SIZE", "64"))
+    prefill_len = min(max(page, seq // 2), cfg.max_position - max_new)
+    cache_dtype = (jnp.float32
+                   if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
+                   else jnp.bfloat16)
+    sc = ServeConfig(num_slots=batch, page_size=page,
+                     max_len=prefill_len + max_new,
+                     prefill_len=prefill_len, cache_dtype=cache_dtype,
+                     run_log=RUN_LOG)
+    engine = ServingEngine(model, variables, sc)
+
+    if COMPILE_ONLY:
+        t0 = time.perf_counter()
+        engine.compiled_decode()
+        return {"metric": "gpt_serve_compile_only", "value": 1.0,
+                "unit": "compiled", "vs_baseline": 0.0,
+                "compile_s": round(time.perf_counter() - t0, 1)}
+
+    rng = np.random.RandomState(0)
+
+    def mixed_requests(n):
+        for _ in range(n):
+            plen = int(rng.randint(max(1, seq // 8), prefill_len + 1))
+            engine.submit(rng.randint(0, cfg.vocab_size, (plen,),
+                                      dtype=np.int32), max_new=max_new)
+
+    # warmup: compile prefill + decode and fill the latency histograms'
+    # cold-start tail outside the timed window
+    mixed_requests(batch)
+    engine.drain()
+    from paddle_tpu.observability import metrics as _metrics
+    for h in ("serve.token_latency_s", "serve.ttft_s"):
+        hist = _metrics.registry().get(h)
+        if hist is not None:
+            hist.reset()
+    n_req = max(4 * batch, steps)
+    mixed_requests(n_req)
+    t0 = time.perf_counter()
+    done = engine.drain()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    total_tokens = sum(len(r.tokens) for r in done)
+    stats = engine.latency_stats()
+    return {
+        "metric": "gpt_serve_tokens_per_sec_per_chip",
+        "value": round(total_tokens / dt, 1),
+        "unit": "decoded tokens/s/chip",
+        "vs_baseline": 0.0,
+        "requests": n_req,
+        "slots": batch,
+        "page_size": page,
+        "max_new": max_new,
+        "token_ms": stats.get("token_ms"),
+        "ttft_ms": stats.get("ttft_ms"),
+        "decode_traces": engine.decode_traces,
+        "note": "continuous batching over the paged KV cache; mixed "
+                "prompt lengths, admissions between decode steps",
     }
 
 
@@ -745,6 +832,8 @@ def _run_inner(args):
         res = bench_gpt(args.steps, args.batch or 16, args.seq)
     elif args.model == "gpt_decode":
         res = bench_gpt_decode(args.steps, args.batch or 16, args.seq)
+    elif args.model == "gpt_serve":
+        res = bench_gpt_serve(args.steps, args.batch or 8, args.seq)
     elif args.model == "ernie":
         res = bench_ernie(args.steps, args.batch or 64, args.seq,
                           use_flash=args.flash)
@@ -843,7 +932,7 @@ def _probe(timeout_s):
 # the tunnel is slow enough that bert's 240s cap trips. Override with
 # PT_BENCH_SUITE="bert,gpt".
 _MODELS = ["bert", "resnet50", "transformer_big", "gpt", "gpt_decode",
-           "ernie", "ctr"]
+           "gpt_serve", "ernie", "ctr"]
 
 
 def _suite_list():
@@ -945,7 +1034,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="all",
                     choices=["all", "bert", "resnet50", "transformer_big",
-                             "gpt", "gpt_decode", "ernie", "ctr"])
+                             "gpt", "gpt_decode", "gpt_serve", "ernie",
+                             "ctr"])
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)

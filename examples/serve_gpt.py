@@ -1,0 +1,65 @@
+"""Continuous-batching serving over the paged KV cache.
+
+CPU smoke:  python examples/serve_gpt.py --requests 12 --slots 4
+(untrained tiny model — demonstrates the serving engine: mixed-length
+prompts stream through a fixed set of decode slots; admissions land in
+freed slots between decode steps, the jitted serve step compiles once,
+and the paged cache grows/frees page-by-page.)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    args = ap.parse_args()
+
+    import jax
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.serving import ServeConfig, ServingEngine
+
+    cfg = GPTConfig.tiny()
+    cfg.dropout = 0.0
+    cfg.use_flash = False
+    model = GPTDecoder(cfg)
+    v = model.init(jax.random.key(0))
+
+    engine = ServingEngine(model, v, ServeConfig(
+        num_slots=args.slots, page_size=args.page_size,
+        max_len=32 + args.max_new, prefill_len=32,
+        temperature=args.temperature))
+
+    rng = np.random.RandomState(0)
+    t0 = time.time()
+    for _ in range(args.requests):
+        plen = int(rng.randint(2, 32))
+        engine.submit(rng.randint(0, cfg.vocab_size, (plen,),
+                                  dtype=np.int32),
+                      max_new=int(rng.randint(4, args.max_new + 1)))
+    done = engine.drain()
+    dt = time.time() - t0
+    for r in sorted(done, key=lambda r: r.id):
+        print(f"req {r.id}: prompt {len(r.prompt):2d} tok -> "
+              f"+{len(r.tokens):2d} generated  {r.output.tolist()}")
+    total = sum(len(r.tokens) for r in done)
+    print(f"{len(done)} requests, {total} tokens in {dt:.2f}s "
+          f"({total / max(dt, 1e-9):.1f} tok/s incl. compile); "
+          f"serve step traced {engine.decode_traces}x")
+    print("latency:", engine.latency_stats())
+
+
+if __name__ == "__main__":
+    main()

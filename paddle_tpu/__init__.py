@@ -61,6 +61,7 @@ from paddle_tpu import data
 from paddle_tpu import io
 from paddle_tpu import static
 from paddle_tpu import models
+from paddle_tpu import serving
 from paddle_tpu import metrics
 from paddle_tpu import quant
 from paddle_tpu import slim

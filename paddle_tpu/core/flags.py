@@ -115,6 +115,20 @@ define_flag("remat_policy", "nothing",
 define_flag("flash_pallas_bwd", True,
             "Use the Pallas flash-attention backward kernels; False falls "
             "back to recompute via the chunked XLA formulation.")
+# serving fast path — paged KV cache decode attention (ops/attention.py
+# paged_decode_attention; kernel in ops/pallas/decode_attention.py). The
+# XLA escape hatch gathers live pages densely and masks by length — the
+# parity reference, but it materializes a [slots, Tmax]-scale score
+# temporary the kernel never does.
+define_flag("use_pallas_decode", True,
+            "Use the Pallas paged decode-attention kernel on TPU; False "
+            "falls back to the XLA gather-and-mask formulation.")
+define_flag("serve_page_size", 16,
+            "Tokens per KV-cache page in the serving engine (multiples of "
+            "8; 128 fills a TPU lane tile exactly).")
+define_flag("serve_slots", 4,
+            "Concurrent decode slots in the serving engine (the fixed "
+            "batch dimension of the jitted serve step).")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

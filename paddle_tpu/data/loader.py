@@ -79,6 +79,15 @@ class DataLoader:
             return shard_batch(self._mesh, batch, self._axis)
         return jax.tree_util.tree_map(jax.device_put, batch)
 
+    def place(self, batch):
+        """Stage one host batch onto the device(s) through the loader's
+        placement path (mesh sharding when configured, plain async
+        device_put otherwise). The serving engine uses this at submit()
+        time so prompt bytes are already in flight before admission —
+        PJRT transfers are async, so this returns immediately and the
+        decode step never blocks on host I/O."""
+        return self._place(batch)
+
     def __iter__(self):
         q = queue.Queue(maxsize=self._prefetch)
         stop = object()

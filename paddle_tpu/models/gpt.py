@@ -100,6 +100,25 @@ class GPTBlock(nn.Module):
         x = x + self._ffn(self.ln2(x))
         return x, cache
 
+    def paged_decode_step(self, x, pool, page_table, att_lengths,
+                          write_pages, write_offsets):
+        """Incremental twin of forward against the paged serving cache
+        (same pre-norm residual structure as decode_step)."""
+        h, pool = self.attn.paged_decode_step(
+            self.ln1(x), pool, page_table, att_lengths, write_pages,
+            write_offsets)
+        x = x + h
+        x = x + self._ffn(self.ln2(x))
+        return x, pool
+
+    def paged_prefill(self, x, pool, page_ids, offsets):
+        """Batched prompt fill into this block's page pool."""
+        h, pool = self.attn.paged_prefill(self.ln1(x), pool, page_ids,
+                                          offsets)
+        x = x + h
+        x = x + self._ffn(self.ln2(x))
+        return x, pool
+
 
 class GPT(nn.Module):
     """Causal LM: returns next-token logits [B, T, V] (weight-tied head)."""
@@ -224,6 +243,72 @@ class GPTDecoder(GPT):
     def decode_step(self, token, caches, pos):
         """token: [B, 1] int32; pos: scalar. -> (logits [B, 1, V], caches)."""
         return _gpt_decode_step(self, token, caches, pos)
+
+    # --- paged serving cache (slot/page-pool layout; ops/attention.py) ---
+
+    def init_paged_caches(self, num_pages, page_size, dtype=jnp.float32):
+        """Per-layer page pools for the serving engine. Unlike
+        init_caches, capacity is pages (shared across slots), not a
+        padded [B, Tmax] rectangle per request."""
+        from paddle_tpu.core.enforce import enforce
+        enforce(self.cfg.seq_axis is None,
+                "paged decoding needs an unsharded sequence")
+        return [blk.attn.init_page_pool(num_pages, page_size, dtype)
+                for blk in self.blocks]
+
+    def paged_decode_step(self, tokens, caches, page_table, lengths,
+                          active):
+        """One serve-step forward for all slots. tokens: [S] int32 (the
+        pending token per slot, sits at position `lengths`); page_table:
+        [S, Pmax] int32 (in-range everywhere); lengths: [S] tokens
+        already in the cache; active: [S] bool. The new token's K/V lands
+        at page_table[s, lengths//ps] offset lengths%ps (dropped for
+        inactive slots); attention covers lengths+1 tokens.
+        -> (logits [S, V], new_caches)."""
+        s = tokens.shape[0]
+        num_pages, _, page_size, _ = caches[0]["k"].shape
+        write_pages = page_table[jnp.arange(s), lengths // page_size]
+        write_pages = jnp.where(active, write_pages, num_pages)  # drop
+        write_offsets = lengths % page_size
+        att_lengths = lengths + active.astype(lengths.dtype)
+        pos = jnp.minimum(lengths, self.cfg.max_position - 1)
+        x = (self.tok_emb(tokens[:, None])
+             + self.pos_emb(pos[:, None])
+             ).reshape(s, 1, self.cfg.hidden_size)
+        new_caches = []
+        for blk, pool in zip(self.blocks, caches):
+            x, pool = blk.paged_decode_step(x, pool, page_table,
+                                            att_lengths, write_pages,
+                                            write_offsets)
+            new_caches.append(pool)
+        x = self.ln_f(x)
+        return nn.tied_vocab_head(self.tok_emb, x)[:, 0], new_caches
+
+    def paged_prefill(self, prompt, lengths, caches, page_rows):
+        """Admission prefill: one causal forward over the padded prompt
+        batch writes each request's K/V into its pages. prompt: [B, Lp]
+        int32 (padded; Lp fixed so admission never retraces); lengths:
+        [B] true prompt lengths; page_rows: [B, Pmax] int32. Pad
+        positions route to the out-of-range drop page. Returns (logits
+        of each request's LAST real token [B, V], new_caches)."""
+        b, lp = prompt.shape
+        num_pages, _, page_size, _ = caches[0]["k"].shape
+        pos = jnp.arange(lp)
+        page_ids = jnp.take_along_axis(page_rows,
+                                       (pos[None, :] // page_size),
+                                       axis=1)                  # [B, Lp]
+        page_ids = jnp.where(pos[None, :] < lengths[:, None], page_ids,
+                             num_pages)
+        offsets = jnp.broadcast_to(pos % page_size, (b, lp))
+        x = self.tok_emb(prompt) + self.pos_emb(pos[None, :])
+        new_caches = []
+        for blk, pool in zip(self.blocks, caches):
+            x, pool = blk.paged_prefill(x, pool, page_ids, offsets)
+            new_caches.append(pool)
+        x = self.ln_f(x)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)
+        return nn.tied_vocab_head(self.tok_emb, last)[:, 0], new_caches
 
     def generate(self, prompt, max_new, temperature=0.0, key=None,
                  cache_dtype=jnp.float32):

@@ -602,6 +602,74 @@ class MultiHeadAttention(Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, e)
         return self._project(ctx, "o"), {"k": k, "v": v}
 
+    # --- paged KV cache (serving fast path; ops/attention.py layout) ---
+
+    def init_page_pool(self, num_pages, page_size, dtype=jnp.float32):
+        """This layer's slice of the paged serving cache:
+        {"k","v"} [num_pages, H, page_size, hd]. Reads the embed dim from
+        the declaration (ParamSpec), so it works outside apply() — the
+        serving engine allocates pools before any forward runs."""
+        from paddle_tpu.ops.attention import init_page_pool
+        hd = self._params["wq"].shape[0] // self.num_heads
+        return init_page_pool(num_pages, self.num_heads, page_size, hd,
+                              dtype)
+
+    def paged_decode_step(self, x_t, pool, page_table, att_lengths,
+                          write_pages, write_offsets):
+        """One incremental step against the paged cache. x_t: [S, 1, E]
+        (one pending token per slot); page_table: [S, Pmax] int32;
+        att_lengths: [S] valid tokens INCLUDING the one written now;
+        write_pages/write_offsets: [S] destination of the new K/V
+        (out-of-range page id = drop, for inactive slots).
+        Returns (out [S, 1, E], new_pool)."""
+        from paddle_tpu.ops.attention import (paged_decode_attention,
+                                              paged_write)
+        s, one, e = x_t.shape
+        hd = e // self.num_heads
+
+        def proj(n):
+            return self._project(x_t, n).reshape(s, self.num_heads, hd)
+
+        q = proj("q")
+        pool = paged_write(pool, proj("k"), proj("v"), write_pages,
+                           write_offsets)
+        ctx = paged_decode_attention(q, pool["k"], pool["v"], page_table,
+                                     att_lengths)
+        return self._project(ctx.reshape(s, 1, e), "o"), pool
+
+    def paged_prefill(self, x, pool, page_ids, offsets):
+        """Batched prompt fill into pages: one causal forward over the
+        (padded) prompt, K/V scattered to (page_ids, offsets) per
+        position ([B, T] int32; out-of-range page id drops the write —
+        how pad positions are discarded). Returns (out [B, T, E],
+        new_pool). Causal masking alone keeps pad-at-the-end garbage out
+        of every valid position's context."""
+        from paddle_tpu.ops.attention import paged_write
+        b, t, e = x.shape
+        hd = e // self.num_heads
+
+        def heads(y):
+            return y.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
+
+        q = heads(self._project(x, "q"))
+        k = heads(self._project(x, "k"))
+        v = heads(self._project(x, "v"))
+        pool = paged_write(
+            pool,
+            k.transpose(0, 2, 1, 3).reshape(b * t, self.num_heads, hd),
+            v.transpose(0, 2, 1, 3).reshape(b * t, self.num_heads, hd),
+            page_ids.reshape(b * t), offsets.reshape(b * t))
+        if self.use_flash:
+            from paddle_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            ctx = flash_attention(q, k, v, causal=True)
+        else:
+            from paddle_tpu.ops.attention import \
+                scaled_dot_product_attention
+            ctx = scaled_dot_product_attention(q, k, v, causal=True)
+        out = ctx.transpose(0, 2, 1, 3).reshape(b, t, e)
+        return self._project(out, "o"), pool
+
 
 class FC(Linear):
     """ref: dygraph/nn.py FC — Linear with num_flatten_dims semantics."""

@@ -15,6 +15,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
 
+NEG_INF = -1e30
+
 
 @register_op("scaled_dot_product_attention")
 def scaled_dot_product_attention(q, k, v, mask=None, scale=None,
@@ -79,6 +81,102 @@ def _as_key_padding_mask(mask, batch, tk):
     elif m.shape[0] != batch:
         return None
     return m.astype(bool)
+
+
+# --- paged KV cache (serving fast path) -----------------------------------
+#
+# The per-request contiguous [B, H, Tmax, hd] decode cache streams the whole
+# padded buffer every generated token and welds requests into one fixed
+# lockstep batch. The paged layout replaces it with a slot/page-pool scheme:
+# one pool of fixed-size pages per layer ([N, H, page_size, hd]) plus a
+# per-slot page table ([slots, Pmax] int32) and token counts ([slots]
+# int32). Memory scales with tokens actually held, mixed-length requests
+# share one batch, and a finished request frees its pages without reshaping
+# anything — the jitted serve step's shapes never change across admissions
+# (paddle_tpu/serving/ owns the host-side allocator).
+
+
+def init_page_pool(num_pages, num_heads, page_size, head_dim,
+                   dtype=jnp.float32):
+    """One layer's KV page pool: {"k","v"} [num_pages, H, page_size, hd]."""
+    shape = (num_pages, num_heads, page_size, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def paged_write(pool, k_t, v_t, page_ids, offsets):
+    """Scatter per-token K/V into pool pages. k_t/v_t: [T, H, hd];
+    page_ids/offsets: [T] int32. An out-of-range page id DROPS the write
+    (mode="drop") — the engine routes inactive slots and pad positions to
+    page id == num_pages on purpose."""
+    return {
+        "k": pool["k"].at[page_ids, :, offsets, :].set(
+            k_t.astype(pool["k"].dtype), mode="drop"),
+        "v": pool["v"].at[page_ids, :, offsets, :].set(
+            v_t.astype(pool["v"].dtype), mode="drop"),
+    }
+
+
+def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale):
+    """Gather-and-mask reference: pull every table page densely and mask by
+    length. Materializes [S, H, Pmax*ps]-scale score temporaries — the
+    parity oracle for the Pallas kernel and the CPU fallback, never the
+    serving hot path (compile_smoke's serve probe asserts the kernel path
+    holds no such temporary, with this path as the positive control)."""
+    s_slots, h, hd = q.shape
+    page_size = k_pages.shape[2]
+    p_max = page_table.shape[1]
+    t = p_max * page_size
+    k = jnp.moveaxis(k_pages[page_table], 2, 1).reshape(s_slots, h, t, hd)
+    v = jnp.moveaxis(v_pages[page_table], 2, 1).reshape(s_slots, h, t, hd)
+    scores = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    valid = (jnp.arange(t)[None, :] < lengths[:, None])[:, None, :]
+    scores = jnp.where(valid, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # mask p, not just scores: a fully-masked slot (length 0) keeps m at
+    # the NEG_INF sentinel where exp(s - m) would be 1
+    p = jnp.where(valid, jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("sht,shtd->shd", p, v.astype(jnp.float32))
+    out = jnp.where(l > 0, out / jnp.maximum(l, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+@register_op("paged_decode_attention")
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None):
+    """Single-query attention over a paged KV cache (the serving decode
+    read). q: [S, H, hd] — one query token per slot; k_pages/v_pages:
+    [N, H, page_size, hd]; page_table: [S, Pmax] int32 with IN-RANGE
+    entries everywhere (0 for unallocated); lengths: [S] int32 valid
+    token counts (0 = inactive slot -> exactly-zero output).
+
+    On TPU (or under pallas_interpret): the Pallas kernel gathers only
+    live pages through the page table and runs flash-style online softmax
+    over page tiles. Elsewhere, or with use_pallas_decode=False: the XLA
+    gather-and-mask formulation (same semantics, dense temporaries)."""
+    from paddle_tpu.core.flags import get_flag
+    from paddle_tpu.ops.pallas import log_fallback, on_tpu
+    scale = (float(scale) if scale is not None
+             else 1.0 / (q.shape[-1] ** 0.5))
+    page_size = k_pages.shape[2]
+    if get_flag("use_pallas_decode"):
+        interpret = get_flag("pallas_interpret")
+        if (on_tpu() or interpret):
+            from paddle_tpu.ops.pallas.decode_attention import (
+                paged_decode_attention_tpu, pltpu)
+            if pltpu is not None and page_size % 8 == 0 \
+                    and (interpret or q.shape[-1] % 64 == 0):
+                return paged_decode_attention_tpu(
+                    q, k_pages, v_pages, page_table, lengths, scale,
+                    interpret=interpret)
+            log_fallback(
+                "decode_attention",
+                f"page_size={page_size} not a multiple of 8 or "
+                f"hd={q.shape[-1]} not a multiple of 64 "
+                "(supported: page_size%8==0, hd%64==0 on silicon)")
+    return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                                scale)
 
 
 @register_op("multihead_attention")

@@ -1,0 +1,122 @@
+"""Paged decode attention — Pallas TPU kernel for the serving fast path.
+
+Single-query attention over a paged KV cache: each decode slot reads ONLY
+its live pages (gathered through the page table by the BlockSpec index
+map — the scalar-prefetch idiom, so the DMA engine fetches exactly the
+pages a slot owns) and masks by the slot's true token count. Flash-style
+online softmax carries (m, l, acc) in VMEM scratch across page tiles, so
+no [slots, Tmax] score row ever exists — the XLA escape hatch in
+ops/attention.py gathers densely and does materialize one, which is what
+tools/compile_smoke.py's serve probe greps for (with the fallback as the
+positive control).
+
+Layout: q [S, H, hd] (one query token per slot), k_pages/v_pages
+[N, H, page_size, hd] (the pool the whole engine shares), page_table
+[S, Pmax] int32, lengths [S] int32 (tokens valid in the cache INCLUDING
+the one written this step). Grid (S, Pmax) with the page axis innermost
+(sequential on TPU) carrying the softmax state. fp32 statistics and
+accumulation regardless of the pool dtype (bf16 pools re-read through
+f32 math — same contract as flash_attention).
+
+Every page_table entry must be an IN-RANGE page index (0 for unallocated
+slots/pages is fine — the kernel skips blocks past `length`, but the
+BlockSpec still issues the gather DMA for them). A slot with length 0
+(inactive) skips every block and emits exactly zero output, matching the
+fully-masked-row semantics of the flash/chunked paths.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lens_ref[s]
+
+    @pl.when(j * page_size < length)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)               # [H, hd]
+        k = k_ref[0].astype(jnp.float32)               # [H, ps, hd]
+        v = v_ref[0].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [H, ps]
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < length                            # [1, ps] -> rows
+        sc = jnp.where(valid, sc, NEG_INF)
+        m_prev = m_scr[:]                               # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        # mask p, not just scores: with the whole tile masked m_new stays
+        # at the NEG_INF sentinel and exp(sc - m_new) would be 1
+        p = jnp.where(valid, jnp.exp(sc - m_new), 0.0)  # [H, ps]
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)         # [H, hd]
+        m_scr[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:]
+        o_ref[0] = jnp.where(l > 0, acc_scr[:] / jnp.maximum(l, 1e-30),
+                             0.0).astype(o_ref.dtype)
+
+
+def paged_decode_attention_tpu(q, k_pages, v_pages, page_table, lengths,
+                               scale, interpret=None):
+    """q [S, H, hd]; k_pages/v_pages [N, H, ps, hd]; page_table [S, Pmax]
+    int32 (in-range everywhere); lengths [S] int32. -> [S, H, hd]."""
+    if interpret is None:
+        from paddle_tpu.core.flags import get_flag
+        interpret = get_flag("pallas_interpret")
+    s_slots, h, hd = q.shape
+    page_size = k_pages.shape[2]
+    p_max = page_table.shape[1]
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               page_size=page_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_slots, p_max),
+        in_specs=[
+            pl.BlockSpec((1, h, hd), lambda s, j, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, h, page_size, hd),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, h, page_size, hd),
+                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, hd), lambda s, j, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, h, hd), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)

@@ -1,0 +1,19 @@
+"""Serving — the continuous-batching inference engine.
+
+The reference stack ships a standalone inference engine (AnalysisPredictor
++ the server-side runtime); its Python-visible surface is
+load_inference_model → run loops over fixed-shape batches. This package is
+the TPU-native successor for autoregressive decoding: a slot/page-pool KV
+cache (ops/attention.py), a Pallas decode-attention kernel
+(ops/pallas/decode_attention.py), and a request scheduler that admits new
+prompts into freed slots between decode steps — mixed prompt lengths, one
+jitted fixed-shape serve step, no per-admission retrace.
+
+    engine = ServingEngine(model, variables, ServeConfig(num_slots=8))
+    rid = engine.submit([1, 2, 3], max_new=32)
+    finished = engine.drain()
+"""
+
+from paddle_tpu.serving.engine import Request, ServeConfig, ServingEngine
+
+__all__ = ["Request", "ServeConfig", "ServingEngine"]

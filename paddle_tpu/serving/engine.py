@@ -1,0 +1,434 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Architecture (the three serving invariants):
+
+  * ONE jitted decode step, fixed slot count, donated page pools — its
+    shapes never depend on which requests are live, so admissions,
+    completions and ragged lengths never retrace it (`decode_traces`
+    counts trace-time entries; tools/compile_smoke.py asserts == 1
+    across admission waves).
+  * Paged KV memory — requests own pages, not a [B, Tmax] rectangle.
+    A finished request frees its pages between steps; an admitted one
+    takes pages for its prompt and grows one page at a time as it
+    decodes. The page table / length / active arrays are tiny host
+    numpy state, re-fed to the step each call (values change, shapes
+    don't).
+  * Prefill-on-admit — a second fixed-shape jit (prompts padded to
+    `prefill_len`) runs once per admission, writes the prompt K/V into
+    the request's pages and samples the first token, so time-to-first-
+    token is one forward, not `prompt_len` decode steps.
+
+Telemetry (PR-4 registry): serve.queue_depth / serve.active_slots
+gauges, serve.ttft_s + serve.token_latency_s histograms, serve.tokens +
+serve.requests{status} + serve.page_stalls counters; optional per-step
+RunLog records (`ServeConfig.run_log`) that tools/run_report.py renders.
+"""
+
+import collections
+import dataclasses
+import itertools
+import time
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.flags import get_flag
+from paddle_tpu.observability import metrics as _metrics
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    num_slots: int = None        # None -> serve_slots flag
+    page_size: int = None        # None -> serve_page_size flag
+    max_len: int = 256           # per-request cap: prompt + generated
+    prefill_len: int = 64        # padded admission prompt length (fixed)
+    num_pages: int = None        # None -> num_slots * ceil(max_len/page)
+    cache_dtype: typing.Any = jnp.float32
+    temperature: float = 0.0     # 0 = greedy; >0 samples per step
+    seed: int = 0
+    eos_id: int = None           # default EOS (submit() can override)
+    default_max_new: int = 32
+    run_log: str = None          # per-step RunLog JSONL path
+    prefetch: int = None         # host->device staging depth (None->flag)
+
+    def resolve(self):
+        if self.num_slots is None:
+            self.num_slots = get_flag("serve_slots")
+        if self.page_size is None:
+            self.page_size = get_flag("serve_page_size")
+        pages_per_slot = -(-self.max_len // self.page_size)
+        if self.num_pages is None:
+            self.num_pages = self.num_slots * pages_per_slot
+        enforce(self.prefill_len <= self.max_len,
+                "prefill_len must not exceed max_len")
+        enforce(self.num_pages >= pages_per_slot,
+                f"num_pages={self.num_pages} cannot hold even one "
+                f"max_len={self.max_len} request "
+                f"({pages_per_slot} pages of {self.page_size}) — the "
+                "preemption guarantee needs a lone request to fit")
+        return self
+
+
+@dataclasses.dataclass
+class Request:
+    id: int
+    prompt: np.ndarray            # true (unpadded) prompt, int32 [L]
+    max_new: int
+    eos_id: int = None
+    tokens: list = dataclasses.field(default_factory=list)
+    status: str = "queued"        # queued -> running -> done
+    slot: int = None
+    pages: list = dataclasses.field(default_factory=list)
+    submit_t: float = None
+    first_token_t: float = None
+    done_t: float = None
+    device_prompt: typing.Any = None   # staged padded [1, Lp] (async put)
+
+    @property
+    def output(self):
+        """prompt + generated tokens (the generate()-shaped sequence)."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+
+class ServingEngine:
+    """submit()/step()/drain() continuous batching for a GPTDecoder."""
+
+    def __init__(self, model, variables, config=None, clock=time.perf_counter):
+        self.cfg = (config or ServeConfig()).resolve()
+        cfg = self.cfg
+        self._model = model
+        self._params = variables["params"]
+        self._clock = clock
+        self._pages_per_slot = -(-cfg.max_len // cfg.page_size)
+        self._caches = model.init_paged_caches(
+            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype)
+
+        s = cfg.num_slots
+        self._page_table = np.zeros((s, self._pages_per_slot), np.int32)
+        self._lengths = np.zeros(s, np.int32)
+        self._active = np.zeros(s, bool)
+        self._last_tokens = np.zeros(s, np.int32)
+        self._free_slots = list(range(s))
+        self._free_pages = collections.deque(range(cfg.num_pages))
+        self._queue = collections.deque()
+        self._running = {}
+        self._ids = itertools.count()
+        self._step_no = 0
+        self._base_key = jax.random.key(cfg.seed)
+        self.decode_traces = 0
+        self.prefill_traces = 0
+
+        # host->device prompt staging reuses the DataLoader placement path
+        # (async device_put; depth knob = the reader_queue_size flag), so
+        # admission never pays the transfer inside step()
+        from paddle_tpu.data.loader import DataLoader
+        self._stager = DataLoader(None, prefetch=cfg.prefetch)
+
+        self._run_log = None
+        self._own_run_log = False
+        if cfg.run_log:
+            if isinstance(cfg.run_log, str):
+                from paddle_tpu.observability.runlog import RunLog
+                self._run_log = RunLog(cfg.run_log)
+                self._own_run_log = True
+            else:                      # an already-open RunLog (bench.py)
+                self._run_log = cfg.run_log
+
+        temp = float(cfg.temperature)
+
+        def _sample(logits, key):
+            if temp > 0.0:
+                return jax.random.categorical(
+                    key, logits / temp, -1).astype(jnp.int32)
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+
+        self._sample = _sample
+
+        def decode(params, caches, tokens, page_table, lengths, active,
+                   key):
+            self.decode_traces += 1   # trace-time only: counts compiles
+
+            def run(tok):
+                logits, new_caches = model.paged_decode_step(
+                    tok, caches, page_table, lengths, active)
+                return _sample(logits, key), new_caches
+
+            return model.apply({"params": params, "state": {}}, tokens,
+                               method=run)
+
+        def prefill(params, caches, prompt, lengths, page_rows, key):
+            self.prefill_traces += 1
+
+            def run(pr):
+                logits, new_caches = model.paged_prefill(
+                    pr, lengths, caches, page_rows)
+                return _sample(logits, key), new_caches
+
+            return model.apply({"params": params, "state": {}}, prompt,
+                               method=run)
+
+        self._decode_jit = jax.jit(decode, donate_argnums=(1,))
+        self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+
+    # --- public API ---
+
+    def submit(self, prompt, max_new=None, eos_id=None):
+        """Queue a prompt; returns the request id. The padded prompt is
+        staged host->device immediately (async), so admission inside a
+        later step() issues no host transfer."""
+        cfg = self.cfg
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = max_new if max_new is not None else cfg.default_max_new
+        enforce(1 <= prompt.size <= cfg.prefill_len,
+                f"prompt length {prompt.size} not in [1, "
+                f"{cfg.prefill_len}] (prefill_len)")
+        enforce(prompt.size + max_new <= cfg.max_len,
+                f"prompt {prompt.size} + max_new {max_new} exceeds "
+                f"max_len {cfg.max_len}")
+        req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
+                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
+                      submit_t=self._clock())
+        padded = np.zeros((1, cfg.prefill_len), np.int32)
+        padded[0, :prompt.size] = prompt
+        req.device_prompt = self._stager.place(padded)
+        self._queue.append(req)
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        _metrics.counter("serve.requests").inc(status="submitted")
+        return req.id
+
+    def step(self):
+        """One scheduling round: free finished slots happened last round;
+        admit queued prompts into free slots (prefill-on-admit), grow
+        page tables where the next token opens a page, run ONE jitted
+        decode step over all slots, and retire requests that hit EOS or
+        their token budget. Returns the requests finished this round."""
+        t0 = self._clock()
+        finished = []
+        self._admit(finished)
+        stalled = self._grow_pages()
+        while stalled and not self._active.any():
+            # pool deadlock: every live slot needs a fresh page and none
+            # is free. Preempt the YOUNGEST stalled request (free its
+            # pages, requeue it for re-prefill) so the oldest always
+            # makes progress — greedy decoding regenerates the dropped
+            # tokens exactly; sampled runs re-draw (recompute preemption)
+            victim = max(stalled, key=lambda s: self._running[s].id)
+            self._preempt(self._running[victim])
+            stalled = self._grow_pages()
+        new_tokens = 0
+        if self._active.any():
+            key = jax.random.fold_in(self._base_key, self._step_no)
+            toks_dev, self._caches = self._decode_jit(
+                self._params, self._caches, self._last_tokens,
+                self._page_table, self._lengths, self._active, key)
+            toks = np.asarray(toks_dev)        # host sync: the scheduler
+            dt = self._clock() - t0            # needs the tokens
+            lat = _metrics.histogram("serve.token_latency_s")
+            for slot, req in list(self._running.items()):
+                if not self._active[slot]:
+                    continue                   # page-stalled this round
+                self._lengths[slot] += 1       # pending token now cached
+                tok = int(toks[slot])
+                req.tokens.append(tok)
+                self._last_tokens[slot] = tok
+                lat.observe(dt)
+                new_tokens += 1
+                if self._done(req, tok):
+                    self._release(req, finished)
+        _metrics.counter("serve.tokens").inc(new_tokens)
+        _metrics.gauge("serve.active_slots").set(len(self._running))
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        if self._run_log is not None:
+            self._run_log.write({
+                "phase": "serve", "step": self._step_no,
+                "wall_s": self._clock() - t0, "new_tokens": new_tokens,
+                "active": len(self._running),
+                "queue_depth": len(self._queue)})
+        self._step_no += 1
+        return finished
+
+    def drain(self, max_steps=100000):
+        """Run step() until every submitted request finishes; returns the
+        finished requests in completion order."""
+        out = []
+        for _ in range(max_steps):
+            if not (self._queue or self._running):
+                break
+            out.extend(self.step())
+        else:
+            raise RuntimeError(
+                f"drain: {len(self._queue)} queued / {len(self._running)} "
+                f"running requests left after {max_steps} steps")
+        if self._run_log is not None:
+            snap = _metrics.snapshot()
+            self._run_log.write({"final": True, "phase": "serve",
+                                 "counters": snap.get("counters", {})})
+        return out
+
+    def close(self):
+        if self._run_log is not None and self._own_run_log:
+            self._run_log.close()
+        self._run_log = None
+
+    def compiled_decode(self):
+        """AOT-compile the decode step (one extra trace) and return the
+        compiled executable — compile-smoke greps its HLO, bench prewarms
+        with it."""
+        cfg = self.cfg
+        key = jax.random.fold_in(self._base_key, 0)
+        return self._decode_jit.lower(
+            self._params, self._caches,
+            np.zeros(cfg.num_slots, np.int32), self._page_table,
+            np.zeros(cfg.num_slots, np.int32), np.zeros(cfg.num_slots,
+                                                        bool),
+            key).compile()
+
+    def export_decode(self, path):
+        """Export ONE greedy serve step as a StableHLO / jax.export
+        artifact through io.inference.save_train_program's
+        state-feedback contract: state = (params, page pools) fed back
+        output->input each iteration, batch = (tokens, page_table,
+        lengths, active) — so the C++ predictor loop (csrc/) can run the
+        continuous-batching decode with no Python at serve time (the
+        host scheduler only rewrites the tiny page_table/lengths/active
+        inputs between steps)."""
+        from paddle_tpu.io.inference import save_train_program
+        model = self._model
+        cfg = self.cfg
+
+        def step(state, tokens, page_table, lengths, active):
+            params, caches = state
+
+            def run(tok):
+                logits, new_caches = model.paged_decode_step(
+                    tok, caches, page_table, lengths, active)
+                return jnp.argmax(logits, -1).astype(jnp.int32), \
+                    new_caches
+
+            nxt, new_caches = model.apply(
+                {"params": params, "state": {}}, tokens, method=run)
+            return nxt, (params, new_caches)
+
+        example = (np.zeros(cfg.num_slots, np.int32), self._page_table,
+                   np.zeros(cfg.num_slots, np.int32),
+                   np.zeros(cfg.num_slots, bool))
+        return save_train_program(path, step,
+                                  (self._params, self._caches), example)
+
+    def latency_stats(self):
+        """{"ttft_ms": {p50,p95,n}, "token_ms": {...}} from the registry
+        histograms (the bench row's telemetry-backed percentiles)."""
+        out = {}
+        for name, hist in (("ttft_ms", "serve.ttft_s"),
+                           ("token_ms", "serve.token_latency_s")):
+            h = _metrics.registry().get(hist)
+            st = h.stats() if h is not None else None
+            if st:
+                out[name] = {"p50": round(st["p50"] * 1e3, 3),
+                             "p95": round(st["p95"] * 1e3, 3),
+                             "n": st["count"]}
+        return out
+
+    # --- scheduling internals ---
+
+    def _admit(self, finished):
+        cfg = self.cfg
+        ttft = _metrics.histogram("serve.ttft_s")
+        while self._queue and self._free_slots:
+            req = self._queue[0]
+            need = -(-req.prompt.size // cfg.page_size)
+            if need > len(self._free_pages):
+                _metrics.counter("serve.page_stalls").inc(where="admit")
+                break                      # head-of-line waits for pages
+            self._queue.popleft()
+            slot = self._free_slots.pop()
+            req.slot = slot
+            req.pages = [self._free_pages.popleft() for _ in range(need)]
+            row = np.zeros(self._pages_per_slot, np.int32)
+            row[:need] = req.pages
+            self._page_table[slot] = row
+            self._lengths[slot] = req.prompt.size
+            lens = np.asarray([req.prompt.size], np.int32)
+            key = jax.random.fold_in(self._base_key,
+                                     1_000_000 + req.id)
+            tok_dev, self._caches = self._prefill_jit(
+                self._params, self._caches, req.device_prompt, lens,
+                self._page_table[slot][None, :], key)
+            tok = int(np.asarray(tok_dev)[0])
+            now = self._clock()
+            req.first_token_t = now
+            ttft.observe(now - req.submit_t)
+            req.tokens.append(tok)
+            req.status = "running"
+            self._running[slot] = req
+            self._last_tokens[slot] = tok
+            self._active[slot] = True
+            _metrics.counter("serve.tokens").inc()
+            if self._done(req, tok):
+                self._release(req, finished)
+
+    def _grow_pages(self):
+        """Allocate the page each slot's next token write needs where
+        lengths crossed a boundary; slots that cannot get one stall
+        (deactivate) for this round and retry next step. Returns the
+        stalled slots. Idempotent — safe to re-run after a preemption
+        freed pages."""
+        stalled = []
+        ps = self.cfg.page_size
+        for slot, req in self._running.items():
+            self._active[slot] = True
+            ln = int(self._lengths[slot])
+            if ln % ps or ln // ps < len(req.pages):
+                continue                   # room in the current page
+            if self._free_pages:
+                page = self._free_pages.popleft()
+                req.pages.append(page)
+                self._page_table[slot, ln // ps] = page
+            else:
+                _metrics.counter("serve.page_stalls").inc(where="decode")
+                self._active[slot] = False
+                stalled.append(slot)
+        return stalled
+
+    def _preempt(self, req):
+        """Recompute preemption: drop the request's device state and
+        requeue it at the FRONT of the queue (its staged prompt is still
+        device-resident, so re-admission pays only the prefill)."""
+        slot = req.slot
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        self._page_table[slot] = 0
+        self._lengths[slot] = 0
+        self._active[slot] = False
+        self._last_tokens[slot] = 0
+        self._running.pop(slot, None)
+        self._free_slots.append(slot)
+        req.slot = None
+        req.tokens = []
+        req.status = "queued"
+        self._queue.appendleft(req)
+        _metrics.counter("serve.preemptions").inc()
+
+    def _done(self, req, tok):
+        return (req.eos_id is not None and tok == req.eos_id) \
+            or len(req.tokens) >= req.max_new
+
+    def _release(self, req, finished):
+        slot = req.slot
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        self._page_table[slot] = 0
+        self._lengths[slot] = 0
+        self._active[slot] = False
+        self._last_tokens[slot] = 0
+        self._running.pop(slot, None)
+        self._free_slots.append(slot)
+        req.status = "done"
+        req.done_t = self._clock()
+        req.device_prompt = None
+        finished.append(req)
+        _metrics.counter("serve.requests").inc(status="completed")

@@ -46,6 +46,22 @@ def test_bench_gpt_sharded_dp_tp_hlo_contract():
 
 
 @pytest.mark.perf
+def test_serve_step_traced_once_and_paged_hlo_contract():
+    """Serving fast path (in-process, CPU): mixed-length admission waves
+    must leave the jitted serve step traced exactly once, and the
+    paged + Pallas(interpret) decode HLO must hold no [rows, Tmax]-dense
+    gathered-K/V or score temporary — the XLA gather-and-mask fallback
+    (use_pallas_decode=0) is the positive control that proves the
+    detector sees dense decode attention."""
+    import tools.compile_smoke as cs
+    out = cs.serve_smoke()
+    assert out["decode_traces"] == 1 and out["prefill_traces"] == 1, out
+    assert out["clean"], out["dense_temporaries"]
+    assert out["positive_control_trips"]
+    assert out["finished"] == 6
+
+
+@pytest.mark.perf
 def test_bench_bert_sharded_dp_tp_hlo_contract():
     """Same contract for the BERT-pretrain step (masked-position MLM head
     over the vocab-sharded table + tp-sharded mlm_bias). Detector

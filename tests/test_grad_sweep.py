@@ -168,6 +168,10 @@ NON_DIFF = {
                 "which is grad-checked)",
     "box_decoder_and_assign": "eval-time decode + discrete assign",
     "box_clip": "eval-time clip to image window",
+    "paged_decode_attention": "serving decode read over the paged KV "
+                              "cache — inference-only (no training path "
+                              "holds a page pool); parity vs the dense "
+                              "oracle in tests/test_serving.py",
     "ssd_loss": COMPOSITE,  # drives checked primitives + discrete matching
     "data_norm": COMPOSITE,
     "batch_norm": "stateful (running stats); grad covered in "

@@ -337,6 +337,10 @@ def test_examples_run_decode_and_detection(tmp_path):
     r = _run_example("generate_gpt.py",
                      ["--max-new", "6", "--prompt-len", "6"], timeout=560)
     assert "tok/s" in r.stdout
+    r = _run_example("serve_gpt.py",
+                     ["--requests", "5", "--slots", "2", "--max-new",
+                      "8"], timeout=560)
+    assert "serve step traced 1x" in r.stdout
     r = _run_example("nmt_seq2seq.py", ["--steps", "300"], timeout=560)
     assert r.stdout.rstrip().endswith("OK")
     _run_example("train_ssd.py",

@@ -1,0 +1,409 @@
+"""Serving fast path: paged KV cache + decode attention parity, the
+continuous-batching engine, and GPTDecoder.generate sampling coverage.
+
+Parity chain (the acceptance contract): dense per-slot softmax (numpy
+oracle) == XLA gather-and-mask fallback == Pallas decode kernel
+(interpret mode) at <=1e-5 f32 across ragged lengths — then up the
+stack: paged model decode == contiguous-cache decode == full forward,
+and the engine's continuously-batched outputs == per-request
+generate(), token-exact, through mid-stream slot reuse."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.flags import all_flags, set_flags
+
+
+@pytest.fixture
+def flags_guard():
+    saved = all_flags()
+    yield
+    set_flags(saved)
+
+
+def _tiny_decoder(seed=0, use_flash=False):
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    cfg = GPTConfig.tiny()
+    cfg.dropout = 0.0
+    cfg.use_flash = use_flash
+    model = GPTDecoder(cfg)
+    return model, model.init(jax.random.key(seed)), cfg
+
+
+def _ragged_pool(rng, lengths, h=4, hd=16, page_size=8, num_pages=16):
+    """Build a paged pool holding per-slot K/V of the given ragged
+    lengths; returns (pool, page_table, dense per-slot K/V dict)."""
+    from paddle_tpu.ops.attention import init_page_pool, paged_write
+    s = len(lengths)
+    p_max = max(-(-max(lengths) // page_size), 1) + 1
+    pool = init_page_pool(num_pages, h, page_size, hd)
+    ptab = np.zeros((s, p_max), np.int32)
+    free = list(range(num_pages))
+    dense = {}
+    for i, ln in enumerate(lengths):
+        n = -(-ln // page_size)
+        pages = [free.pop() for _ in range(n)]
+        ptab[i, :n] = pages
+        if not ln:
+            continue
+        k = rng.randn(ln, h, hd).astype(np.float32)
+        v = rng.randn(ln, h, hd).astype(np.float32)
+        dense[i] = (k, v)
+        ids = np.asarray([ptab[i, t // page_size] for t in range(ln)],
+                         np.int32)
+        offs = np.arange(ln, dtype=np.int32) % page_size
+        pool = paged_write(pool, jnp.asarray(k), jnp.asarray(v),
+                           jnp.asarray(ids), jnp.asarray(offs))
+    return pool, jnp.asarray(ptab), dense
+
+
+def _dense_reference(q, dense, lengths):
+    """Per-slot full-softmax attention oracle in numpy."""
+    s, h, hd = q.shape
+    out = np.zeros((s, h, hd), np.float32)
+    for i, ln in enumerate(lengths):
+        if not ln:
+            continue
+        k, v = dense[i]
+        sc = np.einsum("hd,lhd->hl", np.asarray(q[i]), k) / np.sqrt(hd)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[i] = np.einsum("hl,lhd->hd", p, v)
+    return out
+
+
+class TestPagedDecodeAttention:
+    LENGTHS = [13, 0, 37, 8]
+
+    def test_xla_gather_matches_dense_ragged(self, rng):
+        from paddle_tpu.ops.attention import _paged_attention_xla
+        pool, ptab, dense = _ragged_pool(rng, self.LENGTHS)
+        q = jnp.asarray(rng.randn(len(self.LENGTHS), 4, 16)
+                        .astype(np.float32))
+        out = _paged_attention_xla(q, pool["k"], pool["v"], ptab,
+                                   jnp.asarray(self.LENGTHS), 1 / 4.0)
+        ref = _dense_reference(q, dense, self.LENGTHS)
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+        assert float(jnp.abs(out[1]).max()) == 0.0  # empty slot -> zeros
+
+    def test_pallas_interpret_matches_xla_ragged(self, rng, flags_guard):
+        from paddle_tpu.ops.attention import (_paged_attention_xla,
+                                              paged_decode_attention)
+        pool, ptab, dense = _ragged_pool(rng, self.LENGTHS)
+        q = jnp.asarray(rng.randn(len(self.LENGTHS), 4, 16)
+                        .astype(np.float32))
+        lens = jnp.asarray(self.LENGTHS)
+        ref = _paged_attention_xla(q, pool["k"], pool["v"], ptab, lens,
+                                   1 / 4.0)
+        set_flags({"pallas_interpret": True, "use_pallas_decode": True})
+        out = paged_decode_attention(q, pool["k"], pool["v"], ptab, lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _dense_reference(q, dense,
+                                                    self.LENGTHS),
+                                   atol=1e-5)
+
+    def test_unaligned_page_size_falls_back_with_counter(self, rng,
+                                                         flags_guard):
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.ops.attention import paged_decode_attention
+        pool, ptab, dense = _ragged_pool(rng, [5, 3], page_size=6,
+                                         num_pages=8)
+        q = jnp.asarray(rng.randn(2, 4, 16).astype(np.float32))
+        set_flags({"pallas_interpret": True, "use_pallas_decode": True})
+        before = _metrics.counter("pallas.fallback").snapshot().get(
+            "kernel=decode_attention", 0)
+        out = paged_decode_attention(q, pool["k"], pool["v"], ptab,
+                                     jnp.asarray([5, 3]))
+        after = _metrics.counter("pallas.fallback").snapshot().get(
+            "kernel=decode_attention", 0)
+        assert after == before + 1
+        np.testing.assert_allclose(np.asarray(out),
+                                   _dense_reference(q, dense, [5, 3]),
+                                   atol=1e-5)
+
+    def test_paged_write_drops_out_of_range(self, rng):
+        from paddle_tpu.ops.attention import init_page_pool, paged_write
+        pool = init_page_pool(4, 2, 8, 16)
+        vals = jnp.asarray(rng.randn(2, 2, 16).astype(np.float32))
+        pool = paged_write(pool, vals, vals,
+                           jnp.asarray([1, 4]),    # 4 == num_pages: drop
+                           jnp.asarray([3, 0]))
+        assert float(jnp.abs(pool["k"][1, :, 3]).max()) > 0.0
+        assert float(jnp.abs(pool["k"][0]).max()) == 0.0
+        assert float(jnp.abs(pool["k"][2:]).max()) == 0.0
+
+
+class TestPagedModelDecode:
+    def test_paged_matches_full_forward_ragged(self, rng):
+        """Teacher-forced paged decoding of three ragged slots must
+        reproduce the full forward's logits position by position."""
+        model, v, cfg = _tiny_decoder()
+        lens = [5, 3, 7]
+        total = 12
+        ids = rng.randint(0, cfg.vocab_size, (3, total)).astype(np.int32)
+        full = np.asarray(model.apply(v, jnp.asarray(ids)))  # [3, T, V]
+
+        def run(_):
+            caches = model.init_paged_caches(num_pages=12, page_size=4)
+            ptab = jnp.asarray(
+                [[3 * s + i for i in range(3)] + [0]
+                 for s in range(3)], jnp.int32)          # 3 pages/slot
+            # ragged prefill in one padded batch
+            lp = max(lens)
+            prompt = jnp.asarray(ids[:, :lp])
+            logits0, caches = model.paged_prefill(
+                prompt, jnp.asarray(lens), caches, ptab)
+            outs = {i: [] for i in range(3)}
+            for i, ln in enumerate(lens):
+                outs[i].append(logits0[i])
+            # teacher-forced continuation to `total` tokens per slot
+            lengths = jnp.asarray(lens)
+            for step in range(total - min(lens)):
+                cur = np.minimum(np.asarray(lengths), total - 1)
+                toks = jnp.asarray(ids[np.arange(3), cur])
+                active = jnp.asarray(np.asarray(lengths) < total - 1)
+                logits, caches = model.paged_decode_step(
+                    toks, caches, ptab, lengths, active)
+                for i in range(3):
+                    if bool(active[i]):
+                        outs[i].append(logits[i])
+                lengths = lengths + active.astype(lengths.dtype)
+            return outs
+
+        outs = model.apply(v, jnp.zeros((1,)), method=run)
+        for i, ln in enumerate(lens):
+            got = np.asarray(jnp.stack(outs[i]))      # logits at pos>=ln-1
+            want = full[i, ln - 1:ln - 1 + got.shape[0]]
+            np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_slot_reuse_after_release(self, rng):
+        """A slot freed by one request and reused by another (different
+        pages, different length) must decode the newcomer exactly as a
+        fresh engine would — token-for-token vs generate()."""
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder(seed=2)
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=1, page_size=8, max_len=32, prefill_len=16,
+            num_pages=4))
+        p1 = rng.randint(0, cfg.vocab_size, (9,)).astype(np.int32)
+        p2 = rng.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+        eng.submit(p1, max_new=5)
+        eng.submit(p2, max_new=7)       # queued until slot 0 frees
+        done = {r.id: r for r in eng.drain()}
+        assert eng.decode_traces == 1
+        for rid, (p, mn) in enumerate([(p1, 5), (p2, 7)]):
+            ref = model.apply(v, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, mn))
+            np.testing.assert_array_equal(done[rid].output,
+                                          np.asarray(ref)[0])
+
+
+class TestServingEngine:
+    def test_continuous_batching_matches_generate(self, rng):
+        """Six mixed-length requests through two slots: every output
+        token-exact vs the per-request generate() reference, one decode
+        trace across all admissions, all pages/slots recycled."""
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=32, prefill_len=16,
+            num_pages=10))
+        specs = [(5, 6), (11, 9), (3, 4), (8, 7), (16, 5), (2, 8)]
+        prompts = [rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L, _ in specs]
+        for p, (_, mn) in zip(prompts, specs):
+            eng.submit(p, max_new=mn)
+        done = {r.id: r for r in eng.drain()}
+        assert len(done) == 6
+        assert eng.decode_traces == 1 and eng.prefill_traces == 1
+        for i, (p, (_, mn)) in enumerate(zip(prompts, specs)):
+            ref = model.apply(v, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, mn))
+            np.testing.assert_array_equal(done[i].output,
+                                          np.asarray(ref)[0])
+        # everything returned to the allocator
+        assert sorted(eng._free_slots) == [0, 1]
+        assert len(eng._free_pages) == 10
+        assert not eng._page_table.any() and not eng._lengths.any()
+
+    def test_eos_terminates_early(self, rng):
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        ref = np.asarray(model.apply(
+            v, jnp.asarray(prompt[None, :]),
+            method=lambda pr: model.generate(pr, 8)))[0]
+        gen = ref[6:]
+        eos = int(gen[2])                # the third generated token
+        expect_n = int(np.where(gen == eos)[0][0]) + 1  # first hit wins
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=1, page_size=8, max_len=32, prefill_len=8))
+        eng.submit(prompt, max_new=8, eos_id=eos)
+        (req,) = eng.drain()
+        assert req.tokens[-1] == eos and len(req.tokens) == expect_n
+
+    def test_temperature_sampling_deterministic_per_seed(self, rng):
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        prompts = [rng.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in (4, 7)]
+
+        def run(seed):
+            eng = ServingEngine(model, v, ServeConfig(
+                num_slots=2, page_size=8, max_len=24, prefill_len=8,
+                temperature=1.0, seed=seed))
+            for p in prompts:
+                eng.submit(p, max_new=6)
+            return {r.id: list(r.tokens) for r in eng.drain()}
+
+        assert run(7) == run(7)          # same seed -> same samples
+        assert all(t < cfg.vocab_size for ts in run(7).values()
+                   for t in ts)
+
+    def test_page_exhaustion_stalls_then_recovers(self, rng):
+        """With a pool too small for both requests' full growth, a slot
+        stalls (counter fires) but decoding still completes correctly
+        once pages free up."""
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        # 2 slots x up to 24 tokens = 6 pages of 8 needed unconstrained;
+        # give 4 so growth competes
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=24, prefill_len=8,
+            num_pages=4))
+        prompts = [rng.randint(0, cfg.vocab_size, (7,)).astype(np.int32)
+                   for _ in range(2)]
+        for p in prompts:
+            eng.submit(p, max_new=12)
+        done = {r.id: r for r in eng.drain()}
+        for i, p in enumerate(prompts):
+            ref = model.apply(v, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, 12))
+            np.testing.assert_array_equal(done[i].output,
+                                          np.asarray(ref)[0])
+        assert len(eng._free_pages) == 4
+
+
+class TestServeExport:
+    def test_export_decode_round_trips(self, rng, tmp_path):
+        """The exported serve step (save_train_program state-feedback
+        contract) must load back via load_program and reproduce the
+        engine's greedy next-token choice on live pool state."""
+        from paddle_tpu.io.inference import load_program
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=24, prefill_len=8))
+        eng.submit(rng.randint(0, cfg.vocab_size, (5,))
+                   .astype(np.int32), max_new=6)
+        eng.step()                      # live pools + one running slot
+        path = eng.export_decode(str(tmp_path / "serve"))
+        prog = load_program(path)
+        state_flat = jax.tree_util.tree_leaves(
+            (eng._params, eng._caches))
+        out = prog(*state_flat, eng._last_tokens.copy(),
+                   eng._page_table.copy(), eng._lengths.copy(),
+                   eng._active.copy())
+        toks = np.asarray(out[0])
+        assert toks.shape == (2,) and toks.dtype == np.int32
+        # parity: the engine's own next step must pick the same token
+        # for the running slot
+        slot = next(iter(eng._running))
+        req = eng._running[slot]
+        eng.step()
+        assert req.tokens[-1] == int(toks[slot])
+
+
+class TestAdmissionStaging:
+    def test_prompts_staged_at_submit_not_in_step(self, rng, monkeypatch):
+        """Admission must never pay the host->device prompt transfer
+        inside step(): staging runs (async) at submit() through the
+        DataLoader placement path, and no block_until_ready-style sync
+        happens while submitting (the PR-4 no-sync discipline)."""
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        model, v, cfg = _tiny_decoder()
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=24, prefill_len=8))
+        phase = {"cur": "submit"}
+        calls = []
+        orig = eng._stager.place
+
+        def spy(batch):
+            calls.append(phase["cur"])
+            return orig(batch)
+
+        monkeypatch.setattr(eng._stager, "place", spy)
+
+        orig_burt = jax.block_until_ready
+
+        def no_sync(*a, **k):
+            raise AssertionError("block_until_ready during submit "
+                                 "(prompt staging must be async)")
+
+        monkeypatch.setattr(jax, "block_until_ready", no_sync)
+        for L in (3, 6, 5, 4):
+            eng.submit(rng.randint(0, cfg.vocab_size, (L,))
+                       .astype(np.int32), max_new=4)
+        # prompts are device-committed jax arrays before any step runs
+        assert all(isinstance(r.device_prompt, jax.Array)
+                   for r in eng._queue)
+        monkeypatch.setattr(jax, "block_until_ready", orig_burt)
+        phase["cur"] = "step"
+        eng.drain()
+        assert calls == ["submit"] * 4
+
+
+class TestGenerateSampling:
+    """GPTDecoder.generate sampling coverage (satellite): temperature
+    path determinism/shape, bf16-vs-f32 greedy cache parity."""
+
+    def test_temperature_sampling_shape_and_determinism(self, rng):
+        model, v, cfg = _tiny_decoder()
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 5),
+                                         dtype=np.int32))
+
+        def gen(key):
+            return np.asarray(model.apply(
+                v, prompt, method=lambda pr: model.generate(
+                    pr, 7, temperature=0.8, key=key)))
+
+        a = gen(jax.random.key(3))
+        b = gen(jax.random.key(3))
+        assert a.shape == (2, 12)
+        np.testing.assert_array_equal(a, b)      # fixed key -> fixed draw
+        np.testing.assert_array_equal(a[:, :5], np.asarray(prompt))
+        assert a.max() < cfg.vocab_size and a.min() >= 0
+
+    def test_temperature_requires_key(self, rng):
+        from paddle_tpu.core.enforce import EnforceError
+        model, v, cfg = _tiny_decoder()
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 4),
+                                         dtype=np.int32))
+        with pytest.raises(EnforceError):
+            model.apply(v, prompt,
+                        method=lambda pr: model.generate(
+                            pr, 3, temperature=1.0))
+
+    def test_bf16_cache_greedy_parity(self, rng):
+        """bf16 KV storage must agree with f32 on greedy argmax tokens
+        for a short horizon (the serving default's quality contract)."""
+        model, v, cfg = _tiny_decoder(seed=4)
+        prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 6),
+                                         dtype=np.int32))
+        o32 = np.asarray(model.apply(
+            v, prompt, method=lambda pr: model.generate(
+                pr, 8, cache_dtype=jnp.float32)))
+        o16 = np.asarray(model.apply(
+            v, prompt, method=lambda pr: model.generate(
+                pr, 8, cache_dtype=jnp.bfloat16)))
+        assert o16.shape == o32.shape == (2, 14)
+        # identical prompts; generated tokens nearly always agree on a
+        # tiny model — require the first step exact and >=90% overall
+        np.testing.assert_array_equal(o16[:, 6], o32[:, 6])
+        assert float(np.mean(o16 == o32)) >= 0.9

@@ -162,6 +162,101 @@ def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
     return out
 
 
+def dense_score_temporaries(hlo_text, tmax, min_rows):
+    """f32/bf16 shapes carrying the slot-capacity dim Tmax next to
+    >= min_rows row elements — i.e. a gathered-dense K/V or attention
+    score temporary spanning the PADDED sequence, which the paged Pallas
+    decode path must never materialize."""
+    hits = set()
+    for shp in _hlo_shapes(hlo_text):
+        for d in shp:
+            if d == tmax and math.prod(shp) // d >= min_rows:
+                hits.add(shp)
+    return sorted(hits)
+
+
+# serve-probe shapes: every dim distinct from TMAX=48 (vocab 512, hidden
+# 64, ffn 128, heads 4, hd 16, page 8, pages 13, slots 2, prefill 16) so
+# the detector can key on the padded slot capacity alone. min_rows=8
+# catches even the [S, H, 1, Tmax] score row of the dense fallback.
+_SERVE_TMAX = 48
+_SERVE_MIN_ROWS = 8
+
+
+def _serve_engine(num_pages=13, **cfg_kw):
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.serving import ServeConfig, ServingEngine
+    cfg = GPTConfig.tiny()
+    cfg.dropout = 0.0
+    cfg.use_flash = False
+    model = GPTDecoder(cfg)
+    variables = model.init(jax.random.key(0))
+    sc = ServeConfig(num_slots=2, page_size=8, max_len=_SERVE_TMAX,
+                     prefill_len=16, num_pages=num_pages, **cfg_kw)
+    return model, variables, ServingEngine(model, variables, sc)
+
+
+def serve_smoke(positive_control=True):
+    """Tier-1 contract for the serving fast path, in-process on CPU:
+
+    1. Trace-count probe: mixed-length admission waves through a
+       2-slot engine must leave the jitted serve step traced exactly
+       ONCE (continuous batching never retraces — the shapes are
+       slot-fixed, only values change).
+    2. HLO contract: with paging on and the Pallas decode kernel
+       engaged (interpret mode off-TPU), the compiled serve step holds
+       no [rows, Tmax]-dense gathered-K/V or score temporary; the XLA
+       gather-and-mask fallback (use_pallas_decode=0) must TRIP the
+       detector (positive control — proves the grep sees dense decode
+       attention).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if REPO not in sys.path:       # CLI use; in-suite runs already see it
+        sys.path.insert(0, REPO)
+    import numpy as np
+    from paddle_tpu.core.flags import all_flags, set_flags
+
+    out = {}
+    saved = all_flags()
+    try:
+        set_flags({"pallas_interpret": True, "use_pallas_decode": True})
+        _, _, engine = _serve_engine()
+        rng = np.random.RandomState(0)
+        # three admission waves of ragged prompts through 2 slots: every
+        # admission lands in a freed slot mid-run
+        for plen, mn in [(3, 7), (9, 5), (16, 6), (5, 9), (12, 4),
+                         (2, 8)]:
+            engine.submit(rng.randint(0, 512, (plen,), dtype=np.int32),
+                          max_new=mn)
+        done = engine.drain()
+        out["finished"] = len(done)
+        out["decode_traces"] = engine.decode_traces
+        out["prefill_traces"] = engine.prefill_traces
+        out["traced_once"] = (engine.decode_traces == 1
+                              and engine.prefill_traces == 1)
+
+        hlo = engine.compiled_decode().as_text()
+        temps = dense_score_temporaries(hlo, _SERVE_TMAX,
+                                        _SERVE_MIN_ROWS)
+        out["dense_temporaries"] = temps
+        out["clean"] = not temps
+        if positive_control:
+            set_flags({"use_pallas_decode": False})
+            _, _, ref_engine = _serve_engine()
+            ref_hlo = ref_engine.compiled_decode().as_text()
+            ref_temps = dense_score_temporaries(ref_hlo, _SERVE_TMAX,
+                                                _SERVE_MIN_ROWS)
+            out["positive_control_trips"] = bool(ref_temps)
+    finally:
+        set_flags(saved)
+    out["ok"] = bool(out.get("traced_once") and out.get("clean")
+                     and out.get("positive_control_trips",
+                                 not positive_control))
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt")
@@ -174,7 +269,18 @@ def main():
                     help="with --mesh: enforce the sharded-HLO contract "
                          "(no [rows, V] temporary, no vocab-weight "
                          "all-gather) with a positive control")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving fast-path probe: the jitted serve step "
+                         "compiles once across admissions and its paged "
+                         "HLO holds no [rows, Tmax]-dense attention "
+                         "temporary (positive control included)")
     args = ap.parse_args()
+    if args.serve:
+        out = serve_smoke()
+        print(json.dumps(out))
+        if not out["ok"]:
+            raise SystemExit("serve-step contract violated")
+        return
     if args.hlo_check:
         if not args.mesh:
             raise SystemExit("--hlo-check needs --mesh")

@@ -507,10 +507,15 @@ def bench_gpt_serve(steps, batch, seq):
     cache_dtype = (jnp.float32
                    if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
                    else jnp.bfloat16)
+    # SLO targets for the goodput column (generous CPU-safe defaults;
+    # tighten on silicon): BENCH_*.json tracks the serving SLO trajectory
+    slo_ttft = float(os.environ.get("PT_BENCH_SLO_TTFT", "2.0"))
+    slo_tok = float(os.environ.get("PT_BENCH_SLO_TOKEN", "0.5"))
     sc = ServeConfig(num_slots=batch, page_size=page,
                      max_len=prefill_len + max_new,
                      prefill_len=prefill_len, cache_dtype=cache_dtype,
-                     run_log=RUN_LOG)
+                     run_log=RUN_LOG, slo_ttft_s=slo_ttft,
+                     slo_token_latency_s=slo_tok)
     engine = ServingEngine(model, variables, sc)
 
     if COMPILE_ONLY:
@@ -529,14 +534,11 @@ def bench_gpt_serve(steps, batch, seq):
                                       dtype=np.int32), max_new=max_new)
 
     # warmup: compile prefill + decode and fill the latency histograms'
-    # cold-start tail outside the timed window
+    # cold-start tail outside the timed window; reset_stats also zeroes
+    # the SLO tallies so compile-time TTFTs don't poison goodput
     mixed_requests(batch)
     engine.drain()
-    from paddle_tpu.observability import metrics as _metrics
-    for h in ("serve.token_latency_s", "serve.ttft_s"):
-        hist = _metrics.registry().get(h)
-        if hist is not None:
-            hist.reset()
+    engine.reset_stats()
     n_req = max(4 * batch, steps)
     mixed_requests(n_req)
     t0 = time.perf_counter()
@@ -544,6 +546,7 @@ def bench_gpt_serve(steps, batch, seq):
     dt = max(time.perf_counter() - t0, 1e-9)
     total_tokens = sum(len(r.tokens) for r in done)
     stats = engine.latency_stats()
+    slo = engine.slo_stats()
     return {
         "metric": "gpt_serve_tokens_per_sec_per_chip",
         "value": round(total_tokens / dt, 1),
@@ -555,6 +558,10 @@ def bench_gpt_serve(steps, batch, seq):
         "max_new": max_new,
         "token_ms": stats.get("token_ms"),
         "ttft_ms": stats.get("ttft_ms"),
+        "goodput": slo["goodput"],
+        "slo_ttft_s": slo_ttft,
+        "slo_token_latency_s": slo_tok,
+        "slo_violations": slo["violations"],
         "decode_traces": engine.decode_traces,
         "note": "continuous batching over the paged KV cache; mixed "
                 "prompt lengths, admissions between decode steps",

@@ -165,6 +165,36 @@ define_flag("telemetry_run_log", "",
             "records in memory only).")
 define_flag("telemetry_every_n", 1,
             "Emit a step telemetry record every N steps.")
+# live observability plane (observability/exporter.py + watchdog.py):
+# a stdlib HTTP server scraping the whole metrics registry in Prometheus
+# text exposition, plus serving SLO targets and the anomaly watchdog
+define_flag("metrics_port", 0,
+            "Serve /metrics (Prometheus text exposition of the metrics "
+            "registry) and /healthz on this port while a Trainer or "
+            "ServingEngine runs; 0 disables the exporter.")
+define_flag("slo_ttft_s", 0.0,
+            "Serving SLO: max time-to-first-token in seconds; retired "
+            "requests above it count serve.slo_violations{kind=ttft} and "
+            "lower serve.goodput. 0 = unbounded.")
+define_flag("slo_token_latency_s", 0.0,
+            "Serving SLO: max mean per-token decode latency in seconds; "
+            "violations count serve.slo_violations{kind=token_latency}. "
+            "0 = unbounded.")
+define_flag("watchdog", False,
+            "Default-enable the runtime anomaly watchdog (slow-step, "
+            "ingest-stall, steady-state-retrace, goodput-collapse "
+            "detection) in the Trainer and serving loops.")
+define_flag("watchdog_window", 64,
+            "Rolling window (steps) for the watchdog's step-time median.")
+define_flag("watchdog_slow_factor", 3.0,
+            "A step slower than slow_factor x the rolling median latches "
+            "a slow_step anomaly.")
+define_flag("watchdog_stall_s", 1.0,
+            "Per-step ingest-channel wait above this latches an "
+            "ingest_stall anomaly.")
+define_flag("watchdog_goodput_min", 0.5,
+            "serve.goodput below this (after enough retired requests) "
+            "latches a goodput_collapse anomaly.")
 # fault tolerance — checkpoint mirroring (io/checkpoint.py): False = a
 # mirror push that still fails after retries is logged and queued for the
 # next save (training continues on the durable local copy); True = raise

@@ -26,6 +26,16 @@ Reference-framework ancestry (what each piece re-architects):
                 (wall time, tokens/s, MFU, trailing-fetch loss, HBM
                 peaks) emitted from static/trainer.py with no device
                 sync on the hot path.
+  catalog.py    the one table of every metric name/type/labels/help;
+                exporter HELP lines come from it and a tier-1 lint
+                fails on call sites naming uncataloged metrics.
+  exporter.py   Prometheus text exposition of the whole registry +
+                a stdlib /metrics + /healthz HTTP server (flag
+                metrics_port; start_metrics_server()).
+  watchdog.py   rolling-window anomaly monitor (slow-step, ingest
+                stall, steady-state retrace, goodput collapse) latching
+                watchdog.anomalies{kind} + RunLog events; fed by the
+                Trainer loop and the serving engine.
 
 tools/run_report.py joins a RunLog with an optional XPlane trace dir
 into the human-readable run report (the EnableProfiler/DisableProfiler
@@ -49,10 +59,15 @@ _LAZY = {
     "span": "spans", "annotate_span": "spans", "span_summary": "spans",
     "span_report": "spans", "reset_spans": "spans", "recorder": "spans",
     "spans": None, "telemetry": None, "perf": None,
+    "catalog": None, "exporter": None, "watchdog": None,
     "TelemetryConfig": "telemetry", "StepTelemetry": "telemetry",
     "default_tokens": "telemetry",
     "peak_flops": "perf", "cost_flops": "perf", "mfu": "perf",
     "device_memory_stats": "perf",
+    "MetricsServer": "exporter", "render_prometheus": "exporter",
+    "start_metrics_server": "exporter",
+    "Watchdog": "watchdog", "WatchdogConfig": "watchdog",
+    "maybe_watchdog": "watchdog",
 }
 
 

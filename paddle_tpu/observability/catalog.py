@@ -1,0 +1,143 @@
+"""Metric catalog — the one table of every metric this framework emits.
+
+Every `counter("...")` / `gauge("...")` / `histogram("...")` call site in
+the tree must name a metric registered here (a tier-1 test greps the
+source and fails on drift), so the exporter's HELP lines, dashboards,
+and alert rules never chase renamed or ad-hoc metrics. Names ending in
+'.' are prefixes for dynamically-composed families (span.<path>).
+
+Stdlib-only, like metrics.py: early importers (core/retry.py) may pull
+it in transitively through the exporter.
+"""
+
+import collections
+
+MetricSpec = collections.namedtuple("MetricSpec", ["kind", "labels", "help"])
+
+# name -> (kind, label names, help). Keep alphabetized within each group.
+CATALOG = {
+    # bench.py
+    "bench.step_time_s": MetricSpec(
+        "histogram", (), "Per-step wall time of a timed bench window."),
+    # io/checkpoint.py
+    "checkpoint.mirror_degraded": MetricSpec(
+        "counter", (),
+        "Checkpoint mirror pushes that failed after retries and degraded "
+        "to queue-and-continue."),
+    "checkpoint.restores": MetricSpec(
+        "counter", (), "Checkpoint restores served."),
+    "checkpoint.saves": MetricSpec(
+        "counter", (), "Checkpoint saves committed."),
+    "checkpoint.torn_skips": MetricSpec(
+        "counter", (),
+        "Uncommitted (torn) checkpoint steps skipped at discovery."),
+    # observability/exporter.py
+    "exporter.scrapes": MetricSpec(
+        "counter", ("path",),
+        "HTTP requests served by the /metrics exporter."),
+    # parallel/heartbeat.py
+    "heartbeat.barrier_wait_s": MetricSpec(
+        "counter", ("barrier",),
+        "Wall seconds spent waiting in heartbeat barriers."),
+    "heartbeat.missed": MetricSpec(
+        "counter", ("worker",),
+        "Peers declared stalled by a heartbeat monitor (latched once per "
+        "stall)."),
+    # jit trace accounting (serving/engine.py + observability/watchdog.py)
+    "jit.retraces": MetricSpec(
+        "counter", ("fn",),
+        "Traces beyond the first of a function the runtime asserts is "
+        "traced once (serve decode/prefill, the Trainer step)."),
+    # ops/pallas
+    "pallas.fallback": MetricSpec(
+        "counter", ("kernel",),
+        "Pallas kernel refusals that fell back to the XLA formulation."),
+    # core/retry.py
+    "retry.attempts": MetricSpec(
+        "counter", ("op",), "Retried attempts of remote I/O operations."),
+    "retry.giveups": MetricSpec(
+        "counter", ("op",),
+        "Remote I/O operations that exhausted their retry budget."),
+    # serving/engine.py
+    "serve.active_slots": MetricSpec(
+        "gauge", (), "Decode slots holding a live request."),
+    "serve.goodput": MetricSpec(
+        "gauge", (),
+        "Fraction of retired requests that met every configured SLO "
+        "(slo_ttft_s / slo_token_latency_s)."),
+    "serve.page_stalls": MetricSpec(
+        "counter", ("where",),
+        "Admissions or decode growths that waited on a free KV page."),
+    "serve.preemptions": MetricSpec(
+        "counter", (),
+        "Requests preempted (pages freed, requeued) on pool deadlock."),
+    "serve.queue_depth": MetricSpec(
+        "gauge", (), "Requests waiting for a decode slot."),
+    "serve.requests": MetricSpec(
+        "counter", ("status",),
+        "Request lifecycle tallies (submitted / completed)."),
+    "serve.slo_violations": MetricSpec(
+        "counter", ("kind",),
+        "Retired requests that missed an SLO (kind: ttft | "
+        "token_latency)."),
+    "serve.token_latency_s": MetricSpec(
+        "histogram", (), "Per-token decode-step latency."),
+    "serve.tokens": MetricSpec(
+        "counter", (), "Tokens emitted by the serving engine."),
+    "serve.ttft_s": MetricSpec(
+        "histogram", (), "Time from submit() to a request's first token."),
+    # observability/spans.py (dynamic family: span.<path>)
+    "span.": MetricSpec(
+        "histogram", (), "Host-side span timings (spans.span scopes)."),
+    # static/trainer.py + observability/telemetry.py
+    "trainer.channel_depth": MetricSpec(
+        "gauge", (), "Ingest channel occupancy sampled at each dequeue."),
+    "trainer.ingest_stall_s": MetricSpec(
+        "counter", (),
+        "Wall time the device loop spent blocked on the ingest channel."),
+    "trainer.preempted": MetricSpec(
+        "counter", (), "Preemption signals honored at a step boundary."),
+    "trainer.step_s": MetricSpec(
+        "histogram", (), "Per-step wall time seen by the Trainer."),
+    # observability/watchdog.py
+    "watchdog.anomalies": MetricSpec(
+        "counter", ("kind",),
+        "Anomalies latched by the runtime watchdog (kind: slow_step | "
+        "ingest_stall | retrace | goodput_collapse)."),
+}
+
+
+def lookup(name):
+    """The MetricSpec for a metric name — exact match first, then the
+    longest registered prefix (names registered with a trailing '.').
+    None when uncataloged."""
+    spec = CATALOG.get(name)
+    if spec is not None:
+        return spec
+    best = None
+    for key, s in CATALOG.items():
+        if key.endswith(".") and name.startswith(key):
+            if best is None or len(key) > len(best[0]):
+                best = (key, s)
+    return best[1] if best else None
+
+
+def help_for(name):
+    """HELP text for the exporter: cataloged help, or ''."""
+    spec = lookup(name)
+    return spec.help if spec else ""
+
+
+def preregister(names, registry=None):
+    """Instantiate cataloged metrics ahead of first use so /metrics
+    advertises them (HELP/TYPE) before any traffic — the serving engine
+    does this for the serve.* family at construction."""
+    from paddle_tpu.observability import metrics as _metrics
+    reg = registry if registry is not None else _metrics.registry()
+    out = []
+    for name in names:
+        spec = lookup(name)
+        if spec is None:
+            raise KeyError(f"metric {name!r} is not in the catalog")
+        out.append(getattr(reg, spec.kind)(name, help=spec.help))
+    return out

@@ -1,0 +1,196 @@
+"""Live /metrics exporter — Prometheus text exposition of the whole
+metrics registry over a stdlib HTTP server.
+
+Ref: the reference framework's monitoring was pull-at-exit only
+(profiler tables printed on DisableProfiler); a production trainer or
+server is operated from a scrape endpoint instead. This module renders
+every Counter/Gauge/Histogram in a MetricsRegistry as Prometheus text
+exposition (format 0.0.4) and serves it on `/metrics` (plus a trivial
+`/healthz`) from a daemon ThreadingHTTPServer, so Prometheus / curl can
+watch a live run:
+
+    srv = start_metrics_server()          # honors the metrics_port flag
+    ...
+    srv.stop()
+
+Rendering rules:
+  * metric names sanitize to the Prometheus charset ('.' -> '_'):
+    serve.goodput is exported as serve_goodput; the HELP line carries
+    the registry name so the mapping stays greppable.
+  * counters/gauges export as-is per label set; histograms export as
+    summaries: {quantile="0.5|0.9|0.99"} series over the reservoir plus
+    _count and _sum.
+  * label values escape backslash, double-quote, and newline per the
+    exposition spec.
+  * registered-but-unobserved metrics still emit HELP/TYPE (no samples),
+    so dashboards can discover the full surface before traffic.
+
+Stdlib-only (no jax): the server thread must never contend with the
+device loop, and early importers can pull it in without cycles.
+"""
+
+import http.server
+import re
+import threading
+
+from paddle_tpu.observability import catalog as _catalog
+from paddle_tpu.observability import metrics as _metrics
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_QUANTILES = (("0.5", 0.50), ("0.9", 0.90), ("0.99", 0.99))
+
+
+def prom_name(name):
+    """Registry name -> Prometheus metric name ([a-zA-Z_:][a-zA-Z0-9_:]*)."""
+    out = _NAME_BAD.sub("_", str(name))
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value):
+    """Escape a label value per the text exposition format."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v):
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels, extra=()):
+    parts = [f'{prom_name(k)}="{escape_label_value(v)}"'
+             for k, v in list(extra) + sorted(labels.items())]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(registry=None):
+    """The whole registry as Prometheus text exposition (str)."""
+    reg = registry if registry is not None else _metrics.registry()
+    lines = []
+    for name in reg.names():
+        m = reg.get(name)
+        if m is None:
+            continue                      # raced a concurrent reset
+        pname = prom_name(name)
+        help_txt = _catalog.help_for(name) or m.help or ""
+        lines.append(f"# HELP {pname} {_escape_help(f'{name} {help_txt}'.strip())}")
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "summary"}[m.kind]
+        lines.append(f"# TYPE {pname} {ptype}")
+        snap = m.snapshot()
+        for key in sorted(snap):
+            labels = _metrics.parse_label_key(key)
+            if m.kind in ("counter", "gauge"):
+                lines.append(
+                    f"{pname}{_label_str(labels)} {_fmt_value(snap[key])}")
+            else:
+                st = snap[key]
+                for qname, q in _QUANTILES:
+                    v = m.percentile(q, **labels)
+                    if v is None:
+                        continue
+                    lines.append(
+                        f"{pname}{_label_str(labels, [('quantile', qname)])}"
+                        f" {_fmt_value(v)}")
+                lines.append(f"{pname}_count{_label_str(labels)} "
+                             f"{_fmt_value(st['count'])}")
+                lines.append(f"{pname}_sum{_label_str(labels)} "
+                             f"{_fmt_value(st['sum'])}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = render_prometheus(self.server.registry).encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain; charset=utf-8"
+        else:
+            self.send_error(404)
+            return
+        self.server.registry.counter(
+            "exporter.scrapes",
+            _catalog.help_for("exporter.scrapes")).inc(path=path)
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):    # scrapes must not spam stdout
+        pass
+
+
+class MetricsServer:
+    """A /metrics + /healthz endpoint over one MetricsRegistry.
+
+    `port` here is the literal bind port (0 = OS-assigned ephemeral —
+    what tests use; read `.port` after start() for the real one). The
+    flag-level convention that metrics_port=0 means "exporter off" is
+    enforced by `start_metrics_server`, not by this class.
+    """
+
+    def __init__(self, port=0, registry=None, host="0.0.0.0"):
+        self._bind = (host, int(port))
+        self.registry = (registry if registry is not None
+                         else _metrics.registry())
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        httpd = http.server.ThreadingHTTPServer(self._bind, _Handler)
+        httpd.daemon_threads = True
+        httpd.registry = self.registry
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, name="metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def start_metrics_server(port=None, registry=None):
+    """Start the exporter with flag-resolvable gating: `port=None` reads
+    the `metrics_port` flag, and a resolved port of 0 means DISABLED
+    (returns None). TelemetryConfig / ServeConfig route through here, so
+    PT_FLAGS_metrics_port=9090 live-instruments any run."""
+    if port is None:
+        from paddle_tpu.core.flags import get_flag
+        port = get_flag("metrics_port")
+    port = int(port)
+    if port == 0:
+        return None
+    return MetricsServer(port=port, registry=registry).start()

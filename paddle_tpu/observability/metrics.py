@@ -24,9 +24,11 @@ the train loop all write concurrently.
     metrics.reset_all()            # zero values, keep registrations
 """
 
-import collections
 import math
+import random
+import re
 import threading
+import zlib
 
 
 def _label_key(labels):
@@ -34,6 +36,18 @@ def _label_key(labels):
     if not labels:
         return ""
     return ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+
+
+# a ',' only separates pairs when what follows looks like a new 'key='
+# (label values may themselves contain commas — the exporter renders them)
+_PAIR_SEP = re.compile(r",(?=[A-Za-z_][A-Za-z0-9_]*=)")
+
+
+def parse_label_key(key):
+    """Inverse of _label_key: 'k1=v1,k2=v2' -> {'k1': 'v1', ...}."""
+    if not key:
+        return {}
+    return dict(p.split("=", 1) for p in _PAIR_SEP.split(key))
 
 
 def _percentile(sorted_vals, q):
@@ -112,10 +126,15 @@ class Gauge:
 
 class Histogram:
     """Distribution metric: exact count/sum/min/max plus percentiles over
-    a bounded window of the most recent `max_samples` observations (the
-    window keeps memory flat over million-step runs; step-time
-    percentiles over the recent window are what regressions show up in
-    anyway)."""
+    a bounded UNIFORM reservoir of at most `max_samples` observations
+    (Vitter's algorithm R). The reservoir keeps memory flat over
+    million-step runs while every observation stays equally likely to be
+    retained — the old keep-the-most-recent window silently biased
+    percentiles toward the tail of the run. Observations that fell out
+    of (or never entered) the reservoir are reported as `dropped` in
+    stats()/snapshot(), so a consumer can tell sampled percentiles from
+    exact ones. The reservoir RNG is seeded from the (name, label) pair:
+    identical observation sequences give identical percentiles."""
 
     kind = "histogram"
 
@@ -124,14 +143,16 @@ class Histogram:
         self.help = help
         self.max_samples = max_samples
         self._lock = threading.Lock()
-        self._series = {}   # label key -> dict(count, sum, min, max, window)
+        self._series = {}  # label key -> dict(count, sum, min, max,
+        #                                      reservoir, rng)
 
     def _slot(self, k):
         s = self._series.get(k)
         if s is None:
+            seed = zlib.crc32(f"{self.name}|{k}".encode())
             s = self._series[k] = {
                 "count": 0, "sum": 0.0, "min": None, "max": None,
-                "window": collections.deque(maxlen=self.max_samples)}
+                "reservoir": [], "rng": random.Random(seed)}
         return s
 
     def observe(self, value, **labels):
@@ -142,7 +163,15 @@ class Histogram:
             s["sum"] += v
             s["min"] = v if s["min"] is None else min(s["min"], v)
             s["max"] = v if s["max"] is None else max(s["max"], v)
-            s["window"].append(v)
+            res = s["reservoir"]
+            if len(res) < self.max_samples:
+                res.append(v)
+            else:
+                # algorithm R: observation i (0-based: count-1) replaces a
+                # reservoir entry with probability max_samples / count
+                j = s["rng"].randrange(s["count"])
+                if j < self.max_samples:
+                    res[j] = v
 
     def count(self, **labels):
         with self._lock:
@@ -150,22 +179,25 @@ class Histogram:
             return s["count"] if s else 0
 
     def percentile(self, q, **labels):
-        """q in [0, 1], over the retained window."""
+        """q in [0, 1], over the retained reservoir."""
         with self._lock:
             s = self._series.get(_label_key(labels))
-            vals = sorted(s["window"]) if s else []
+            vals = sorted(s["reservoir"]) if s else []
         return _percentile(vals, q)
 
     def stats(self, **labels):
-        """{"count", "sum", "mean", "min", "max", "p50", "p95"} or None."""
+        """{"count", "sum", "mean", "min", "max", "dropped", "p50",
+        "p95"} or None. `dropped` = observations not retained in the
+        reservoir (0 means the percentiles are exact)."""
         with self._lock:
             s = self._series.get(_label_key(labels))
             if s is None or s["count"] == 0:
                 return None
-            vals = sorted(s["window"])
+            vals = sorted(s["reservoir"])
             out = {"count": s["count"], "sum": s["sum"],
                    "mean": s["sum"] / s["count"],
-                   "min": s["min"], "max": s["max"]}
+                   "min": s["min"], "max": s["max"],
+                   "dropped": s["count"] - len(vals)}
         out["p50"] = _percentile(vals, 0.50)
         out["p95"] = _percentile(vals, 0.95)
         return out
@@ -175,8 +207,7 @@ class Histogram:
             keys = list(self._series)
         out = {}
         for k in keys:
-            labels = dict(p.split("=", 1) for p in k.split(",")) if k else {}
-            st = self.stats(**labels)
+            st = self.stats(**parse_label_key(k))
             if st is not None:
                 out[k] = st
         return out

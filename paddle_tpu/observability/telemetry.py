@@ -46,6 +46,8 @@ class TelemetryConfig:
     estimate_flops: bool = True   # cost-analysis estimate when unknown
     tokens_fn: object = None      # batch -> tokens/step (None = infer)
     grad_norm_fn: object = None   # state -> device scalar (optional)
+    metrics_port: int = None      # /metrics exporter; None -> flag
+    #                               "metrics_port", 0 = off
 
     def resolve(self):
         """A copy with every None filled from the current flags."""
@@ -57,6 +59,8 @@ class TelemetryConfig:
         if c.every_n_steps is None:
             c.every_n_steps = int(F.get_flag("telemetry_every_n"))
         c.every_n_steps = max(1, int(c.every_n_steps))
+        if c.metrics_port is None:
+            c.metrics_port = int(F.get_flag("metrics_port"))
         return c
 
 
@@ -100,6 +104,12 @@ class StepTelemetry:
         self._hist = _metrics.histogram(
             "trainer.step_s", "Per-step wall time seen by the Trainer.")
         self._finished = False
+        self._metrics_server = None
+        if self.enabled and self.cfg.metrics_port:
+            from paddle_tpu.observability.exporter import \
+                start_metrics_server
+            self._metrics_server = start_metrics_server(
+                self.cfg.metrics_port)
 
     # -- setup ------------------------------------------------------------
     def maybe_estimate_flops(self, step_fn, *args):
@@ -187,6 +197,9 @@ class StepTelemetry:
         self._write(rec)
         if self._log is not None:
             self._log.close()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
 
     def close(self):
         self.finish()

@@ -1,0 +1,188 @@
+"""Runtime anomaly watchdog — rolling-window detection of the four ways
+a healthy run goes quietly bad.
+
+Ref: the reference framework noticed nothing at runtime — a wedged
+reader, a recompiling graph, or a collapsed server showed up only in
+post-hoc log archaeology. The watchdog consumes the timings the Trainer
+and ServingEngine already produce (no device sync, no new hot-path
+work beyond a deque append and a few comparisons) and LATCHES structured
+anomaly events into the metrics registry (`watchdog.anomalies{kind}`)
+and the RunLog:
+
+  slow_step         step wall time > slow_factor x rolling-window median
+  ingest_stall      one step waited > stall_s on the ingest channel
+  retrace           `jit.retraces` grew past the warmup steps — a
+                    traced-once function recompiled in steady state
+                    (shape drift, weak-type flip, donation miss)
+  goodput_collapse  serve.goodput < goodput_min once enough requests
+                    retired
+
+Latch semantics: a level-triggered kind (slow_step, ingest_stall,
+goodput_collapse) fires ONCE when the condition appears and re-arms when
+it clears, so a 500-step stall is one event, not 500. retrace is
+edge-triggered per observed recompile.
+
+`jit.retraces{fn}` itself is fed two ways: the serving engine counts
+trace-time entries of its decode/prefill closures directly, and
+`Watchdog.watch_jit` polls `_cache_size()` of any jitted callable (the
+Trainer step) from the host loop.
+
+Stdlib-only: consumers on the hot path import nothing heavy.
+"""
+
+import collections
+import dataclasses
+import time
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.catalog import help_for as _help
+
+KINDS = ("slow_step", "ingest_stall", "retrace", "goodput_collapse")
+
+
+@dataclasses.dataclass
+class WatchdogConfig:
+    """None fields resolve from the watchdog_* flags, so a run can tune
+    detection with env vars alone (PT_FLAGS_watchdog=1
+    PT_FLAGS_watchdog_slow_factor=5)."""
+
+    window: int = None          # None -> flag watchdog_window
+    slow_factor: float = None   # None -> flag watchdog_slow_factor
+    stall_s: float = None       # None -> flag watchdog_stall_s
+    goodput_min: float = None   # None -> flag watchdog_goodput_min
+    min_samples: int = 8        # median needs this many steps first
+    warmup_steps: int = 2       # retraces at/below this step are compile,
+    #                             not anomaly
+    min_retired: int = 8        # goodput needs this many retirements
+
+    def resolve(self):
+        from paddle_tpu.core import flags as F
+        c = dataclasses.replace(self)
+        if c.window is None:
+            c.window = int(F.get_flag("watchdog_window"))
+        if c.slow_factor is None:
+            c.slow_factor = float(F.get_flag("watchdog_slow_factor"))
+        if c.stall_s is None:
+            c.stall_s = float(F.get_flag("watchdog_stall_s"))
+        if c.goodput_min is None:
+            c.goodput_min = float(F.get_flag("watchdog_goodput_min"))
+        c.window = max(2, c.window)
+        return c
+
+
+class Watchdog:
+    """One instance per run loop (Trainer or ServingEngine). Feed it
+    with `tick()` once per step; read `anomalies` (structured dicts) or
+    the `watchdog.anomalies{kind}` counter."""
+
+    def __init__(self, config=None, run_log=None, registry=None,
+                 clock=time.time):
+        self.cfg = (config or WatchdogConfig()).resolve()
+        self._reg = (registry if registry is not None
+                     else _metrics.registry())
+        self._run_log = run_log
+        self._clock = clock
+        self._steps = collections.deque(maxlen=self.cfg.window)
+        self._latched = set()
+        self._watched = {}          # fn name -> (callable, last cache size)
+        self._retraces_seen = 0     # last-seen jit.retraces total
+        self.anomalies = []
+
+    # -- wiring ------------------------------------------------------------
+    def watch_jit(self, name, fn):
+        """Poll `fn`'s jit cache size each tick; growth past 1 entry
+        counts jit.retraces{fn=name}. Callables without a _cache_size
+        probe (non-jit wrappers) are ignored."""
+        probe = getattr(fn, "_cache_size", None)
+        if callable(probe):
+            self._watched[str(name)] = [probe, None]
+        return self
+
+    # -- per-step ----------------------------------------------------------
+    def tick(self, step, wall_s=None, stall_s=None, goodput=None,
+             retired=0):
+        """One scheduling round: check every detector this loop feeds.
+        Any argument left None skips its detector."""
+        cfg = self.cfg
+        if wall_s is not None:
+            median = self._median()
+            if (median is not None
+                    and wall_s > cfg.slow_factor * median):
+                self._fire("slow_step", step, wall_s=wall_s,
+                           median_s=median)
+            else:
+                self._clear("slow_step")
+            self._steps.append(float(wall_s))
+        if stall_s is not None:
+            if stall_s > cfg.stall_s:
+                self._fire("ingest_stall", step, stall_s=stall_s)
+            else:
+                self._clear("ingest_stall")
+        self._poll_jit()
+        self._check_retraces(step)
+        if goodput is not None and retired >= cfg.min_retired:
+            if goodput < cfg.goodput_min:
+                self._fire("goodput_collapse", step, goodput=goodput,
+                           retired=retired)
+            else:
+                self._clear("goodput_collapse")
+
+    # -- detectors ---------------------------------------------------------
+    def _median(self):
+        if len(self._steps) < self.cfg.min_samples:
+            return None
+        vals = sorted(self._steps)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    def _poll_jit(self):
+        ctr = self._reg.counter("jit.retraces", _help("jit.retraces"))
+        for name, slot in self._watched.items():
+            probe, last = slot
+            try:
+                size = int(probe())
+            except Exception:
+                continue
+            if last is not None and size > max(last, 1):
+                ctr.inc(size - max(last, 1), fn=name)
+            slot[1] = size
+
+    def _check_retraces(self, step):
+        ctr = self._reg.get("jit.retraces")
+        total = ctr.total() if ctr is not None else 0
+        grew = total - self._retraces_seen
+        self._retraces_seen = total
+        if grew > 0 and step > self.cfg.warmup_steps:
+            # edge-triggered: every steady-state recompile is an event
+            self._fire("retrace", step, new_retraces=grew, latch=False)
+
+    # -- latch + emit ------------------------------------------------------
+    def _fire(self, kind, step, latch=True, **detail):
+        if latch:
+            if kind in self._latched:
+                return
+            self._latched.add(kind)
+        event = {"anomaly": kind, "step": int(step),
+                 "time": self._clock(), **detail}
+        self.anomalies.append(event)
+        self._reg.counter("watchdog.anomalies",
+                          _help("watchdog.anomalies")).inc(kind=kind)
+        if self._run_log is not None:
+            self._run_log.write(event)
+
+    def _clear(self, kind):
+        self._latched.discard(kind)
+
+
+def maybe_watchdog(setting, run_log=None, registry=None):
+    """Resolve a Trainer/ServeConfig `watchdog` field into a Watchdog or
+    None: a WatchdogConfig is used as-is, True builds defaults, None
+    honors the global `watchdog` flag, False disables."""
+    if setting is None:
+        from paddle_tpu.core.flags import get_flag
+        setting = bool(get_flag("watchdog"))
+    if not setting:
+        return None
+    cfg = setting if isinstance(setting, WatchdogConfig) else None
+    return Watchdog(cfg, run_log=run_log, registry=registry)

@@ -22,6 +22,27 @@ Telemetry (PR-4 registry): serve.queue_depth / serve.active_slots
 gauges, serve.ttft_s + serve.token_latency_s histograms, serve.tokens +
 serve.requests{status} + serve.page_stalls counters; optional per-step
 RunLog records (`ServeConfig.run_log`) that tools/run_report.py renders.
+
+Live observability plane (this layer's serving half):
+
+  * per-request lifecycle traces — every request carries a trace id and
+    emits timestamped RunLog events (`submitted`, `admitted`,
+    `prefill_done`, `first_token`, `preempted`, `resumed`,
+    `retired{reason}`). Pure host work (a clock read + a JSONL append at
+    request-rate, not token-rate): no device sync is added to the decode
+    hot path, asserted by a flush-spy test. `tools/run_report.py
+    --serve` reconstructs per-slot timelines from these events.
+  * SLO/goodput accounting — `ServeConfig.slo_ttft_s` /
+    `slo_token_latency_s` (flag-resolvable) classify every retirement;
+    `serve.goodput` (gauge: fraction of retired requests inside every
+    SLO) and `serve.slo_violations{kind}` are the objective function the
+    ROADMAP's SLO-aware scheduler optimizes.
+  * `jit.retraces{fn=serve.decode|serve.prefill}` — the traced-once
+    invariant as a counter: any steady-state recompile is visible to
+    the watchdog and /metrics, not just to compile-smoke tests.
+  * `ServeConfig.metrics_port` starts the /metrics exporter
+    (observability/exporter.py) for the run; `ServeConfig.watchdog`
+    attaches the anomaly watchdog (observability/watchdog.py).
 """
 
 import collections
@@ -29,6 +50,7 @@ import dataclasses
 import itertools
 import time
 import typing
+import uuid
 
 import jax
 import jax.numpy as jnp
@@ -53,12 +75,20 @@ class ServeConfig:
     default_max_new: int = 32
     run_log: str = None          # per-step RunLog JSONL path
     prefetch: int = None         # host->device staging depth (None->flag)
+    slo_ttft_s: float = None     # None -> flag; 0 = unbounded
+    slo_token_latency_s: float = None   # None -> flag; 0 = unbounded
+    metrics_port: int = None     # None -> flag metrics_port; 0 = off
+    watchdog: object = None      # None -> flag; True or WatchdogConfig
 
     def resolve(self):
         if self.num_slots is None:
             self.num_slots = get_flag("serve_slots")
         if self.page_size is None:
             self.page_size = get_flag("serve_page_size")
+        if self.slo_ttft_s is None:
+            self.slo_ttft_s = get_flag("slo_ttft_s")
+        if self.slo_token_latency_s is None:
+            self.slo_token_latency_s = get_flag("slo_token_latency_s")
         pages_per_slot = -(-self.max_len // self.page_size)
         if self.num_pages is None:
             self.num_pages = self.num_slots * pages_per_slot
@@ -86,6 +116,11 @@ class Request:
     first_token_t: float = None
     done_t: float = None
     device_prompt: typing.Any = None   # staged padded [1, Lp] (async put)
+    trace_id: str = None          # engine-run-scoped lifecycle trace id
+    trace: list = dataclasses.field(default_factory=list)  # (event, t)
+    preemptions: int = 0
+    retire_reason: str = None     # "eos" | "length"
+    slo_ok: bool = None           # every configured SLO met at retire
 
     @property
     def output(self):
@@ -138,6 +173,27 @@ class ServingEngine:
             else:                      # an already-open RunLog (bench.py)
                 self._run_log = cfg.run_log
 
+        # live observability plane: preregister the serve metric family
+        # (so /metrics advertises HELP/TYPE before any traffic), SLO
+        # tallies, the optional exporter, and the anomaly watchdog
+        from paddle_tpu.observability import catalog as _catalog
+        _catalog.preregister([
+            "serve.queue_depth", "serve.active_slots", "serve.ttft_s",
+            "serve.token_latency_s", "serve.tokens", "serve.requests",
+            "serve.page_stalls", "serve.preemptions", "serve.goodput",
+            "serve.slo_violations", "jit.retraces"])
+        self._retired = 0
+        self._retired_ok = 0
+        self._viol_base = dict(
+            _metrics.counter("serve.slo_violations").snapshot())
+        self._trace_run = uuid.uuid4().hex[:8]
+        self._aot_trace = False
+        from paddle_tpu.observability.exporter import start_metrics_server
+        self._metrics_server = start_metrics_server(cfg.metrics_port)
+        from paddle_tpu.observability.watchdog import maybe_watchdog
+        self._watchdog = maybe_watchdog(cfg.watchdog,
+                                        run_log=self._run_log)
+
         temp = float(cfg.temperature)
 
         def _sample(logits, key):
@@ -151,6 +207,10 @@ class ServingEngine:
         def decode(params, caches, tokens, page_table, lengths, active,
                    key):
             self.decode_traces += 1   # trace-time only: counts compiles
+            if self.decode_traces > 1 and not self._aot_trace:
+                # traced-once invariant broken in live serving — visible
+                # to /metrics and the watchdog, not just compile smokes
+                _metrics.counter("jit.retraces").inc(fn="serve.decode")
 
             def run(tok):
                 logits, new_caches = model.paged_decode_step(
@@ -162,6 +222,8 @@ class ServingEngine:
 
         def prefill(params, caches, prompt, lengths, page_rows, key):
             self.prefill_traces += 1
+            if self.prefill_traces > 1 and not self._aot_trace:
+                _metrics.counter("jit.retraces").inc(fn="serve.prefill")
 
             def run(pr):
                 logits, new_caches = model.paged_prefill(
@@ -190,8 +252,11 @@ class ServingEngine:
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"max_len {cfg.max_len}")
         req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
-                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
-                      submit_t=self._clock())
+                      eos_id=eos_id if eos_id is not None else cfg.eos_id)
+        req.trace_id = f"{self._trace_run}/{req.id}"
+        req.submit_t = self._trace_event(req, "submitted",
+                                         prompt_len=int(prompt.size),
+                                         max_new=int(max_new))
         padded = np.zeros((1, cfg.prefill_len), np.int32)
         padded[0, :prompt.size] = prompt
         req.device_prompt = self._stager.place(padded)
@@ -237,17 +302,24 @@ class ServingEngine:
                 self._last_tokens[slot] = tok
                 lat.observe(dt)
                 new_tokens += 1
-                if self._done(req, tok):
-                    self._release(req, finished)
+                reason = self._done_reason(req, tok)
+                if reason:
+                    self._release(req, finished, reason)
         _metrics.counter("serve.tokens").inc(new_tokens)
         _metrics.gauge("serve.active_slots").set(len(self._running))
         _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        wall_s = self._clock() - t0
         if self._run_log is not None:
             self._run_log.write({
                 "phase": "serve", "step": self._step_no,
-                "wall_s": self._clock() - t0, "new_tokens": new_tokens,
+                "wall_s": wall_s, "new_tokens": new_tokens,
                 "active": len(self._running),
-                "queue_depth": len(self._queue)})
+                "queue_depth": len(self._queue),
+                "goodput": round(self.goodput(), 4)})
+        if self._watchdog is not None:
+            self._watchdog.tick(self._step_no, wall_s=wall_s,
+                                goodput=self.goodput(),
+                                retired=self._retired)
         self._step_no += 1
         return finished
 
@@ -266,10 +338,15 @@ class ServingEngine:
         if self._run_log is not None:
             snap = _metrics.snapshot()
             self._run_log.write({"final": True, "phase": "serve",
-                                 "counters": snap.get("counters", {})})
+                                 "counters": snap.get("counters", {}),
+                                 "gauges": snap.get("gauges", {}),
+                                 "slo": self.slo_stats()})
         return out
 
     def close(self):
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         if self._run_log is not None and self._own_run_log:
             self._run_log.close()
         self._run_log = None
@@ -280,12 +357,15 @@ class ServingEngine:
         with it."""
         cfg = self.cfg
         key = jax.random.fold_in(self._base_key, 0)
-        return self._decode_jit.lower(
-            self._params, self._caches,
-            np.zeros(cfg.num_slots, np.int32), self._page_table,
-            np.zeros(cfg.num_slots, np.int32), np.zeros(cfg.num_slots,
-                                                        bool),
-            key).compile()
+        self._aot_trace = True    # a deliberate extra trace, not a retrace
+        try:
+            return self._decode_jit.lower(
+                self._params, self._caches,
+                np.zeros(cfg.num_slots, np.int32), self._page_table,
+                np.zeros(cfg.num_slots, np.int32),
+                np.zeros(cfg.num_slots, bool), key).compile()
+        finally:
+            self._aot_trace = False
 
     def export_decode(self, path):
         """Export ONE greedy serve step as a StableHLO / jax.export
@@ -319,6 +399,40 @@ class ServingEngine:
         return save_train_program(path, step,
                                   (self._params, self._caches), example)
 
+    def goodput(self):
+        """Fraction of retired requests that met every configured SLO
+        (1.0 before the first retirement) — the SLO scheduler's
+        objective."""
+        return self._retired_ok / self._retired if self._retired else 1.0
+
+    def slo_stats(self):
+        """SLO accounting for bench rows / reports: goodput, targets,
+        and violation counts since construction (or reset_stats)."""
+        viol = _metrics.counter("serve.slo_violations").snapshot()
+        delta = {k.split("=", 1)[1]: v - self._viol_base.get(k, 0)
+                 for k, v in viol.items()}
+        return {"goodput": round(self.goodput(), 4),
+                "retired": self._retired,
+                "slo_ttft_s": self.cfg.slo_ttft_s or None,
+                "slo_token_latency_s":
+                    self.cfg.slo_token_latency_s or None,
+                "violations": {"ttft": delta.get("ttft", 0),
+                               "token_latency":
+                                   delta.get("token_latency", 0)}}
+
+    def reset_stats(self):
+        """Zero the serve latency histograms and this engine's SLO
+        tallies (bench warmup isolation: compile-time TTFTs must not
+        poison the timed window's goodput)."""
+        for name in ("serve.ttft_s", "serve.token_latency_s"):
+            h = _metrics.registry().get(name)
+            if h is not None:
+                h.reset()
+        self._retired = self._retired_ok = 0
+        self._viol_base = dict(
+            _metrics.counter("serve.slo_violations").snapshot())
+        _metrics.gauge("serve.goodput").set(1.0)
+
     def latency_stats(self):
         """{"ttft_ms": {p50,p95,n}, "token_ms": {...}} from the registry
         histograms (the bench row's telemetry-backed percentiles)."""
@@ -335,6 +449,21 @@ class ServingEngine:
 
     # --- scheduling internals ---
 
+    def _trace_event(self, req, event, **extra):
+        """One lifecycle trace point: a host clock read, a list append,
+        and (when a RunLog is configured) a JSONL write — never a device
+        sync (the flush-spy test's contract). Returns the timestamp."""
+        t = self._clock()
+        req.trace.append((event, t))
+        if self._run_log is not None:
+            rec = {"event": event, "req": req.id, "trace": req.trace_id,
+                   "t": t, "at_step": self._step_no}
+            if req.slot is not None:
+                rec["slot"] = req.slot
+            rec.update(extra)
+            self._run_log.write(rec)
+        return t
+
     def _admit(self, finished):
         cfg = self.cfg
         ttft = _metrics.histogram("serve.ttft_s")
@@ -347,6 +476,8 @@ class ServingEngine:
             self._queue.popleft()
             slot = self._free_slots.pop()
             req.slot = slot
+            self._trace_event(
+                req, "resumed" if req.preemptions else "admitted")
             req.pages = [self._free_pages.popleft() for _ in range(need)]
             row = np.zeros(self._pages_per_slot, np.int32)
             row[:need] = req.pages
@@ -359,17 +490,18 @@ class ServingEngine:
                 self._params, self._caches, req.device_prompt, lens,
                 self._page_table[slot][None, :], key)
             tok = int(np.asarray(tok_dev)[0])
-            now = self._clock()
-            req.first_token_t = now
-            ttft.observe(now - req.submit_t)
+            self._trace_event(req, "prefill_done")
+            req.first_token_t = self._trace_event(req, "first_token")
+            ttft.observe(req.first_token_t - req.submit_t)
             req.tokens.append(tok)
             req.status = "running"
             self._running[slot] = req
             self._last_tokens[slot] = tok
             self._active[slot] = True
             _metrics.counter("serve.tokens").inc()
-            if self._done(req, tok):
-                self._release(req, finished)
+            reason = self._done_reason(req, tok)
+            if reason:
+                self._release(req, finished, reason)
 
     def _grow_pages(self):
         """Allocate the page each slot's next token write needs where
@@ -399,6 +531,8 @@ class ServingEngine:
         requeue it at the FRONT of the queue (its staged prompt is still
         device-resident, so re-admission pays only the prefill)."""
         slot = req.slot
+        self._trace_event(req, "preempted",
+                          tokens_dropped=len(req.tokens))
         self._free_pages.extend(req.pages)
         req.pages = []
         self._page_table[slot] = 0
@@ -410,14 +544,41 @@ class ServingEngine:
         req.slot = None
         req.tokens = []
         req.status = "queued"
+        req.preemptions += 1
         self._queue.appendleft(req)
         _metrics.counter("serve.preemptions").inc()
 
-    def _done(self, req, tok):
-        return (req.eos_id is not None and tok == req.eos_id) \
-            or len(req.tokens) >= req.max_new
+    def _done_reason(self, req, tok):
+        """Retirement reason for the token just emitted, or None."""
+        if req.eos_id is not None and tok == req.eos_id:
+            return "eos"
+        if len(req.tokens) >= req.max_new:
+            return "length"
+        return None
 
-    def _release(self, req, finished):
+    def _account_slo(self, req):
+        """Classify one retirement against the configured SLOs and
+        refresh serve.goodput (SLO targets of 0 are unbounded)."""
+        cfg = self.cfg
+        ok = True
+        if req.first_token_t is not None:
+            ttft = req.first_token_t - req.submit_t
+            if cfg.slo_ttft_s and ttft > cfg.slo_ttft_s:
+                _metrics.counter("serve.slo_violations").inc(kind="ttft")
+                ok = False
+            if cfg.slo_token_latency_s and len(req.tokens) > 1:
+                per_tok = ((req.done_t - req.first_token_t)
+                           / (len(req.tokens) - 1))
+                if per_tok > cfg.slo_token_latency_s:
+                    _metrics.counter("serve.slo_violations").inc(
+                        kind="token_latency")
+                    ok = False
+        req.slo_ok = ok
+        self._retired += 1
+        self._retired_ok += int(ok)
+        _metrics.gauge("serve.goodput").set(self.goodput())
+
+    def _release(self, req, finished, reason="length"):
         slot = req.slot
         self._free_pages.extend(req.pages)
         req.pages = []
@@ -428,7 +589,12 @@ class ServingEngine:
         self._running.pop(slot, None)
         self._free_slots.append(slot)
         req.status = "done"
+        req.retire_reason = reason
         req.done_t = self._clock()
         req.device_prompt = None
+        self._account_slo(req)
+        self._trace_event(req, "retired", reason=reason,
+                          tokens=len(req.tokens), slo_ok=req.slo_ok,
+                          preemptions=req.preemptions)
         finished.append(req)
         _metrics.counter("serve.requests").inc(status="completed")

@@ -92,6 +92,13 @@ class TrainerConfig:
     # to the configured RunLog every N steps with no device sync added
     # to the hot path.
     telemetry: object = None
+    # runtime anomaly watchdog (observability/watchdog.py): True or a
+    # WatchdogConfig; None honors the global `watchdog` flag. Latches
+    # slow-step / ingest-stall / steady-state-retrace anomalies into
+    # watchdog.anomalies{kind} + the telemetry RunLog; the Trainer step
+    # function's jit cache is polled for retraces (jit.retraces{fn=
+    # trainer.step}) — all host-side, nothing added to the device path.
+    watchdog: object = None
 
 
 class _EndOfData:
@@ -119,6 +126,7 @@ class Trainer:
         self.sparse_tables = sparse_tables or []
         self.history = []
         self.telemetry = None    # StepTelemetry after train() when enabled
+        self.watchdog = None     # Watchdog after train() when enabled
 
     # -- DataFeed channel (ref data_feed.cc multi-threaded file->channel) --
     def _start_ingest(self, readers):
@@ -311,6 +319,18 @@ class Trainer:
         self.telemetry = tele
         return tele if tele.enabled else None
 
+    def _start_watchdog(self, tele):
+        """Watchdog when TrainerConfig.watchdog (or the global flag) is
+        set; anomaly events ride the telemetry RunLog when one exists.
+        The jitted step function is polled for steady-state retraces."""
+        from paddle_tpu.observability.watchdog import maybe_watchdog
+        wd = maybe_watchdog(self.cfg.watchdog,
+                            run_log=getattr(tele, "_log", None))
+        if wd is not None:
+            wd.watch_jit("trainer.step", self.step_fn)
+        self.watchdog = wd
+        return wd
+
     def train(self, state, dataset, batch_size=None, num_workers=None,
               worker_id=None):
         """Drain the dataset (or max_steps); returns (state, stats).
@@ -344,6 +364,7 @@ class Trainer:
             self._split_readers(dataset))
         hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
         tele = self._start_telemetry()
+        wd = self._start_watchdog(tele)
         t0 = time.perf_counter()
         loss = None
         stall_ctr = _metrics.counter(
@@ -353,6 +374,7 @@ class Trainer:
         depth_gauge = _metrics.gauge(
             "trainer.channel_depth",
             "Ingest channel occupancy sampled at each dequeue.")
+        stall_acc = {"t": 0.0}   # per-step ingest wait for the watchdog
 
         def stage(batch):
             # host->device transfer starts now, overlapping the running step
@@ -361,7 +383,9 @@ class Trainer:
         def get_item():
             tw0 = time.perf_counter()
             item = chan.get()
-            stall_ctr.inc(time.perf_counter() - tw0)
+            dt = time.perf_counter() - tw0
+            stall_ctr.inc(dt)
+            stall_acc["t"] += dt
             depth_gauge.set(chan.qsize())
             return item
 
@@ -399,6 +423,7 @@ class Trainer:
                 first = False
 
                 with span("step"):
+                    fault_point("trainer.step")
                     if self.sparse_tables:
                         state, loss = self._sparse_step(state, staged)
                     else:
@@ -411,6 +436,10 @@ class Trainer:
                     # syncing on the step just dispatched
                     tele.on_step(step, staged, loss, state,
                                  wall_s=now - it_t)
+                if wd is not None:
+                    wd.tick(step, wall_s=now - it_t,
+                            stall_s=stall_acc["t"])
+                    stall_acc["t"] = 0.0
                 it_t = now
                 hb_ping()
                 if preempt["signum"] is not None:

@@ -1,0 +1,161 @@
+"""observability/exporter.py — Prometheus text exposition + the
+/metrics HTTP server.
+
+The exposition contract: every non-comment line must parse as
+`name{labels} value` with the Prometheus name charset, label values
+escaped (backslash, quote, newline), histograms rendered as summaries
+(quantile series + _count/_sum), and registered-but-empty metrics still
+advertising HELP/TYPE. The server must stay valid under concurrent
+writers (the satellite test) and keep /healthz trivially alive."""
+
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import exporter as E
+from paddle_tpu.observability import metrics as M
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+_SAMPLE = re.compile(rf"^({_NAME})(\{{.*\}})? (\S+)$")
+
+
+def assert_valid_exposition(text):
+    """Parse every line; return {metric name: sample count}."""
+    seen = {}
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            assert re.match(rf"^# (HELP|TYPE) {_NAME}", line), line
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, value = m.groups()
+        float(value)                       # must be a number
+        if labels:
+            body = labels[1:-1]
+            # the label pairs must tile the whole {...} body exactly
+            rebuilt = ",".join(f'{k}="{v}"'
+                               for k, v in _LABEL.findall(body))
+            assert rebuilt == body, f"malformed labels: {line!r}"
+        seen[name] = seen.get(name, 0) + 1
+    return seen
+
+
+class TestRendering:
+    def _registry(self):
+        r = M.MetricsRegistry()
+        r.counter("retry.attempts", "retries").inc(3, op="copy")
+        r.gauge("serve.goodput").set(0.875)
+        h = r.histogram("serve.ttft_s")
+        for i in range(50):
+            h.observe(0.01 * i)
+        return r
+
+    def test_names_sanitized_and_types(self):
+        text = E.render_prometheus(self._registry())
+        seen = assert_valid_exposition(text)
+        assert "retry_attempts" in seen          # '.' -> '_'
+        assert 'retry_attempts{op="copy"} 3' in text
+        assert "serve_goodput 0.875" in text
+        assert "# TYPE serve_ttft_s summary" in text
+        # HELP carries the registry name, so the mapping stays greppable
+        assert "# HELP serve_ttft_s serve.ttft_s" in text
+
+    def test_histogram_renders_quantiles_count_sum(self):
+        text = E.render_prometheus(self._registry())
+        for q in ("0.5", "0.9", "0.99"):
+            assert f'serve_ttft_s{{quantile="{q}"}}' in text
+        assert "serve_ttft_s_count 50" in text
+        assert re.search(r"serve_ttft_s_sum 12\.2\d*", text)
+
+    def test_label_escaping(self):
+        r = M.MetricsRegistry()
+        r.counter("weird").inc(path='a"b', op="c\\d,e\nf")
+        text = E.render_prometheus(r)
+        assert_valid_exposition(text)
+        assert r'path="a\"b"' in text
+        assert r'op="c\\d,e\nf"' in text         # literal \n, not newline
+        assert "\nf" not in text.replace("\\nf", "")
+
+    def test_registered_empty_metric_advertises_help(self):
+        r = M.MetricsRegistry()
+        r.counter("jit.retraces")
+        text = E.render_prometheus(r)
+        assert "# HELP jit_retraces jit.retraces" in text
+        assert "# TYPE jit_retraces counter" in text
+        assert "\njit_retraces " not in text     # no samples yet
+        # catalog help text rides along even when the call site gave none
+        assert "traced once" in text
+
+    def test_flag_gating(self):
+        from paddle_tpu.core.flags import all_flags, set_flags
+        saved = all_flags()
+        try:
+            set_flags({"metrics_port": 0})
+            assert E.start_metrics_server() is None   # 0 = disabled
+        finally:
+            set_flags(saved)
+
+
+class TestMetricsServer:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_serves_metrics_and_healthz(self):
+        r = M.MetricsRegistry()
+        r.counter("serve.tokens").inc(7)
+        with E.MetricsServer(port=0, registry=r) as srv:
+            status, body = self._get(srv.port, "/metrics")
+            assert status == 200
+            assert "serve_tokens 7" in body
+            status, body = self._get(srv.port, "/healthz")
+            assert status == 200 and body == "ok\n"
+            with pytest.raises(urllib.error.HTTPError):
+                self._get(srv.port, "/nope")
+            # scrapes self-count into the served registry
+            assert r.counter("exporter.scrapes").value(
+                path="/metrics") == 1
+
+    def test_concurrent_writers_scrape_stays_valid(self):
+        """Satellite: scrape /metrics while writer threads hammer
+        labeled counters (including escape-worthy label values) — every
+        scrape parses as valid exposition and /healthz stays stable."""
+        r = M.MetricsRegistry()
+        stop = threading.Event()
+        nasty = ['plain', 'qu"ote', 'back\\slash', 'new\nline']
+
+        def writer(i):
+            n = 0
+            while not stop.is_set():
+                r.counter("serve.requests").inc(
+                    status=nasty[n % len(nasty)])
+                r.gauge("serve.queue_depth").set(n, writer=i)
+                r.histogram("serve.ttft_s").observe(0.001 * (n % 7))
+                n += 1
+
+        threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+                   for i in range(4)]
+        with E.MetricsServer(port=0, registry=r) as srv:
+            for t in threads:
+                t.start()
+            try:
+                for _ in range(20):
+                    status, body = self._get(srv.port, "/metrics")
+                    assert status == 200
+                    seen = assert_valid_exposition(body)
+                    status, hz = self._get(srv.port, "/healthz")
+                    assert status == 200 and hz == "ok\n"
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=5)
+        # the writers' label sets all made it out intact at least once
+        assert any(n.startswith("serve_requests") for n in seen)
+        final = E.render_prometheus(r)
+        for v in ('status="qu\\"ote"', 'status="back\\\\slash"',
+                  'status="new\\nline"'):
+            assert v in final

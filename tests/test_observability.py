@@ -41,14 +41,40 @@ class TestMetrics:
         assert st["p95"] == pytest.approx(95.05)
         assert h.percentile(0.0) == 1
 
-    def test_histogram_window_bounds_memory(self):
-        h = M.Histogram("t.hw", max_samples=10)
-        for i in range(1000):
+    def test_histogram_reservoir_bounds_memory_unbiased(self):
+        """Satellite (PR 6): retention past max_samples is a UNIFORM
+        reservoir, not keep-the-most-recent — percentiles of a ramp stay
+        near the middle instead of collapsing onto the tail, and the
+        observations not retained are reported as `dropped`."""
+        h = M.Histogram("t.hw", max_samples=64)
+        for i in range(10_000):
             h.observe(i)
         st = h.stats()
-        assert st["count"] == 1000      # exact totals survive the window
-        assert st["min"] == 0 and st["max"] == 999
-        assert st["p50"] >= 990         # percentiles: recent window
+        assert st["count"] == 10_000    # exact totals survive sampling
+        assert st["min"] == 0 and st["max"] == 9999
+        assert st["dropped"] == 10_000 - 64
+        assert len(h._series[""]["reservoir"]) == 64    # memory flat
+        # uniform sample of 0..9999: p50 nowhere near the 99xx tail the
+        # old recency window pinned it to
+        assert 2000 < st["p50"] < 8000
+
+    def test_histogram_reservoir_deterministic_and_exact_below_cap(self):
+        """Identical observation sequences -> identical percentiles (the
+        reservoir RNG is seeded from name+labels); under max_samples
+        nothing drops and percentiles are exact."""
+        a, b = (M.Histogram("t.det", max_samples=32) for _ in range(2))
+        for i in range(500):
+            a.observe(i)
+            b.observe(i)
+        assert a.stats() == b.stats()
+        # different label set -> different seed -> (almost surely) a
+        # different reservoir, but identical exact aggregates
+        a.observe(0, op="x")
+        small = M.Histogram("t.small", max_samples=32)
+        for i in range(10):
+            small.observe(i)
+        st = small.stats()
+        assert st["dropped"] == 0 and st["p50"] == 4.5
 
     def test_registry_snapshot_flattens_unlabeled(self):
         r = M.MetricsRegistry()

@@ -80,6 +80,100 @@ def test_cli_counter_deltas_across_snapshots(tmp_path):
     assert "(+3)" in proc.stdout        # saves went 2 -> 5
 
 
+def _serve_records():
+    """Synthetic serve RunLog: req 0 sails through; req 1 is preempted
+    off slot 0 and resumes on slot 1; per-step records ride along."""
+    def ev(event, req, t, slot=None, **extra):
+        rec = {"event": event, "req": req, "trace": f"abc123/{req}",
+               "t": t, "at_step": 0}
+        if slot is not None:
+            rec["slot"] = slot
+        rec.update(extra)
+        return rec
+
+    events = [
+        ev("submitted", 0, 100.00, prompt_len=5, max_new=8),
+        ev("submitted", 1, 100.01, prompt_len=7, max_new=10),
+        ev("admitted", 0, 100.02, slot=0),
+        ev("prefill_done", 0, 100.05, slot=0),
+        ev("first_token", 0, 100.05, slot=0),
+        ev("admitted", 1, 100.06, slot=1),
+        ev("prefill_done", 1, 100.09, slot=1),
+        ev("first_token", 1, 100.09, slot=1),
+        ev("retired", 0, 100.30, slot=0, reason="eos", tokens=6,
+           slo_ok=True, preemptions=0),
+        ev("preempted", 1, 100.35, slot=1, tokens_dropped=4),
+        ev("resumed", 1, 100.45, slot=0),
+        ev("prefill_done", 1, 100.47, slot=0),
+        ev("first_token", 1, 100.47, slot=0),
+        ev("retired", 1, 100.80, slot=0, reason="length", tokens=10,
+           slo_ok=False, preemptions=1),
+    ]
+    steps = [{"phase": "serve", "step": s, "wall_s": 0.02,
+              "new_tokens": 2, "active": 2, "queue_depth": 0,
+              "goodput": 1.0} for s in range(10)]
+    final = {"final": True, "phase": "serve",
+             "counters": {"serve.tokens": 16},
+             "slo": {"goodput": 0.5, "retired": 2, "slo_ttft_s": 0.5,
+                     "slo_token_latency_s": None,
+                     "violations": {"ttft": 1, "token_latency": 0}}}
+    return events + steps + [final]
+
+
+def _import_run_report():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import run_report
+    finally:
+        sys.path.pop(0)
+    return run_report
+
+
+class TestServeReport:
+    def test_sections_and_accounting(self):
+        rep = _import_run_report().render_serve_report(_serve_records())
+        assert "SERVE REPORT" in rep
+        assert "requests: 2 submitted, 2 retired (eos 1, length 1), " \
+            "1 preempted" in rep
+        # TTFT: req0 50ms, req1 460ms (last first_token after resume)
+        assert "TTFT:" in rep and "p50=255.0ms" in rep
+        assert "goodput:        0.5000 over 2 retired" in rep
+        assert "slo_ttft_s=0.5" in rep and "ttft=1" in rep
+        assert "serve steps:    10 (20 tokens)" in rep
+
+    def test_gantt_and_preemption_attribution(self):
+        rep = _import_run_report().render_serve_report(_serve_records())
+        lines = rep.splitlines()
+        g0 = [ln for ln in lines if ln.startswith("  slot  0")][0]
+        g1 = [ln for ln in lines if ln.startswith("  slot  1")][0]
+        assert "0" in g0 and "1" in g0      # req1 resumed onto slot 0
+        assert "!" in g1                    # preemption marker on slot 1
+        assert "req 1: preempted at slot 1 (4 tokens dropped, " \
+            "resumed +0.100s later)" in rep
+        vic = [ln for ln in lines if ln.strip().startswith("req 1 [")][0]
+        assert "SLO MISS" in vic
+        for evname in ("submitted", "admitted", "preempted", "resumed",
+                       "retired"):
+            assert evname in vic
+
+    def test_cli_serve_flag(self, tmp_path):
+        p = tmp_path / "serve.jsonl"
+        with open(p, "w") as f:
+            for r in _serve_records():
+                f.write(json.dumps(r) + "\n")
+        proc = subprocess.run(
+            [sys.executable, RUN_REPORT, str(p), "--serve"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "SERVE REPORT" in proc.stdout
+        assert "slot timeline" in proc.stdout
+
+    def test_no_events_degrades_gracefully(self):
+        rep = _import_run_report().render_serve_report(_records())
+        assert "no serve trace events" in rep
+
+
 @pytest.mark.perf
 def test_run_report_selftest_smoke():
     """Tier-1: tiny GPT through the Trainer with telemetry on (CPU),

@@ -137,6 +137,33 @@ class TestTrainerTelemetry:
         assert [r["step"] for r in recs
                 if "step" in r and not r.get("final")] == [1, 2, 3]
 
+    def test_metrics_port_starts_and_stops_exporter(self):
+        """TelemetryConfig.metrics_port serves /metrics for the run and
+        finish() tears it down (PR-6 live observability plane)."""
+        import socket
+        import urllib.error
+        import urllib.request
+        from paddle_tpu.observability.telemetry import (StepTelemetry,
+                                                        TelemetryConfig)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        tele = StepTelemetry(TelemetryConfig(enabled=True,
+                                             metrics_port=port))
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                assert r.read() == b"ok\n"
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert b"trainer_step_s" in r.read()
+        finally:
+            tele.finish()
+        with pytest.raises((urllib.error.URLError, ConnectionError)):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2)
+
     def test_disabled_telemetry_is_free(self):
         step, state = _linreg_step()
         tr = Trainer(step, TrainerConfig(num_ingest_threads=1))

@@ -1,0 +1,220 @@
+"""observability/watchdog.py — rolling-window anomaly detection.
+
+Unit tests drive the four detectors through a private registry with a
+synthetic clock; the chaos test (satellite) injects a slow step
+(FaultPlan latency on the trainer.step fault point) and a forced retrace
+(batch shape change) into a REAL Trainer run and asserts the anomalies
+and the jit.retraces counter land in both the registry and the RunLog."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.observability import metrics as M
+from paddle_tpu.observability.watchdog import Watchdog, WatchdogConfig
+
+
+def _wd(reg, **kw):
+    defaults = dict(window=16, slow_factor=3.0, stall_s=0.1,
+                    goodput_min=0.5, min_samples=4, warmup_steps=0,
+                    min_retired=4)
+    defaults.update(kw)
+    return Watchdog(WatchdogConfig(**defaults), registry=reg,
+                    clock=lambda: 0.0)
+
+
+class TestDetectors:
+    def test_slow_step_latches_and_rearms(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg)
+        for s in range(6):
+            wd.tick(s, wall_s=0.01)
+        assert wd.anomalies == []
+        wd.tick(6, wall_s=0.1)               # 10x the median
+        wd.tick(7, wall_s=0.1)               # still slow: latched
+        assert [a["anomaly"] for a in wd.anomalies] == ["slow_step"]
+        assert reg.counter("watchdog.anomalies").value(
+            kind="slow_step") == 1
+        wd.tick(8, wall_s=0.01)              # recovers -> re-arms
+        wd.tick(9, wall_s=0.2)
+        assert [a["anomaly"] for a in wd.anomalies] == \
+            ["slow_step", "slow_step"]
+        a = wd.anomalies[0]
+        assert a["step"] == 6 and a["wall_s"] == 0.1
+        assert a["median_s"] == pytest.approx(0.01)
+
+    def test_no_slow_step_before_min_samples(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg, min_samples=8)
+        for s in range(5):
+            wd.tick(s, wall_s=0.01 if s else 10.0)  # huge first step
+        assert wd.anomalies == []            # warmup: median not trusted
+
+    def test_ingest_stall(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg)
+        wd.tick(1, stall_s=0.01)
+        wd.tick(2, stall_s=0.5)
+        wd.tick(3, stall_s=0.5)              # latched
+        wd.tick(4, stall_s=0.0)
+        wd.tick(5, stall_s=0.9)              # re-armed -> second event
+        kinds = [a["anomaly"] for a in wd.anomalies]
+        assert kinds == ["ingest_stall", "ingest_stall"]
+        assert wd.anomalies[0]["stall_s"] == 0.5
+
+    def test_goodput_collapse_needs_sample_size(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg, min_retired=8)
+        wd.tick(1, goodput=0.1, retired=3)   # too few retirements
+        assert wd.anomalies == []
+        wd.tick(2, goodput=0.1, retired=9)
+        assert [a["anomaly"] for a in wd.anomalies] == \
+            ["goodput_collapse"]
+        wd.tick(3, goodput=0.9, retired=12)  # recovered -> re-armed
+        wd.tick(4, goodput=0.2, retired=15)
+        assert len(wd.anomalies) == 2
+
+    def test_watch_jit_counts_retraces_and_fires(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg)
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        f(jnp.ones((3,)))
+        wd.watch_jit("unit.step", f)
+        wd.tick(1)                           # baseline: 1 cache entry
+        assert reg.counter("jit.retraces").total() == 0
+        f(jnp.ones((5,)))                    # shape change -> retrace
+        wd.tick(2)
+        assert reg.counter("jit.retraces").value(fn="unit.step") == 1
+        assert [a["anomaly"] for a in wd.anomalies] == ["retrace"]
+        assert wd.anomalies[0]["new_retraces"] == 1
+        wd.tick(3)                           # no growth -> no new event
+        assert len(wd.anomalies) == 1
+
+    def test_retrace_inside_warmup_counts_but_does_not_fire(self):
+        reg = M.MetricsRegistry()
+        wd = _wd(reg, warmup_steps=10)
+
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        f(jnp.ones((2,)))
+        wd.watch_jit("unit.step", f)
+        wd.tick(1)
+        f(jnp.ones((4,)))
+        wd.tick(2)                           # step 2 <= warmup 10
+        assert reg.counter("jit.retraces").value(fn="unit.step") == 1
+        assert wd.anomalies == []
+
+    def test_anomalies_reach_run_log(self, tmp_path):
+        from paddle_tpu.observability.runlog import RunLog, read_records
+        reg = M.MetricsRegistry()
+        p = tmp_path / "wd.jsonl"
+        with RunLog(p) as log:
+            wd = Watchdog(WatchdogConfig(min_samples=2, warmup_steps=0,
+                                         slow_factor=2.0),
+                          registry=reg, run_log=log, clock=lambda: 7.0)
+            wd.tick(1, wall_s=0.01)
+            wd.tick(2, wall_s=0.01)
+            wd.tick(3, wall_s=1.0)
+        recs = read_records(p)
+        assert len(recs) == 1
+        assert recs[0]["anomaly"] == "slow_step"
+        assert recs[0]["step"] == 3 and recs[0]["time"] == 7.0
+
+
+class TestMaybeWatchdog:
+    def test_flag_and_explicit_resolution(self):
+        from paddle_tpu.core.flags import all_flags, set_flags
+        from paddle_tpu.observability.watchdog import maybe_watchdog
+        saved = all_flags()
+        try:
+            set_flags({"watchdog": False})
+            assert maybe_watchdog(None) is None
+            assert maybe_watchdog(False) is None
+            assert isinstance(maybe_watchdog(True), Watchdog)
+            set_flags({"watchdog": True, "watchdog_window": 7})
+            wd = maybe_watchdog(None)
+            assert isinstance(wd, Watchdog) and wd.cfg.window == 7
+            cfg = WatchdogConfig(window=5)
+            assert maybe_watchdog(cfg).cfg.window == 5
+        finally:
+            set_flags(saved)
+
+
+@pytest.mark.chaos
+class TestChaosWatchdog:
+    """Satellite: chaos-injected slow step + forced retrace through a
+    real Trainer run land the anomalies in registry + RunLog."""
+
+    def test_trainer_slow_step_and_retrace_detected(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu.observability import TelemetryConfig
+        from paddle_tpu.observability.runlog import read_records
+        from paddle_tpu.static import Trainer, TrainerConfig
+        from paddle_tpu.testing import chaos
+
+        opt = pt.optimizer.SGD(0.1)
+        params = {"w": jnp.zeros((4, 1))}
+        state = {"params": params, "opt": opt.init(params)}
+
+        @jax.jit
+        def step(st, x, y):
+            def loss_fn(p):
+                return jnp.mean(jnp.square(x @ p["w"] - y))
+            loss, grads = jax.value_and_grad(loss_fn)(st["params"])
+            p, o = opt.apply_gradients(st["params"], grads, st["opt"])
+            return loss, {"params": p, "opt": o}
+
+        rng = np.random.RandomState(0)
+        # 8 batches of [8, 4], then 2 of [12, 4]: the leading-dim change
+        # forces the jitted step to retrace in steady state
+        batches = [(rng.rand(8, 4).astype(np.float32),
+                    rng.rand(8, 1).astype(np.float32)) for _ in range(8)]
+        batches += [(rng.rand(12, 4).astype(np.float32),
+                     rng.rand(12, 1).astype(np.float32))
+                    for _ in range(2)]
+        ds = pt.data.InMemoryDataset(batches)
+
+        # latency on the trainer.step fault point: nth counts ALL
+        # fault_point events (ingest ones included — 10 of them), so 14
+        # guarantees >= 2 clean steps establish the median first and the
+        # injection lands before the dataset drains
+        plan = chaos.FaultPlan(seed=3).fail(
+            "fault_point", path=r"trainer\.step", nth=14, times=1,
+            latency_s=0.5)
+
+        run_log = str(tmp_path / "run.jsonl")
+        retr0 = M.counter("jit.retraces").value(fn="trainer.step")
+        anom0 = M.counter("watchdog.anomalies").snapshot()
+        cfg = TrainerConfig(
+            num_ingest_threads=1,
+            telemetry=TelemetryConfig(enabled=True, run_log=run_log,
+                                      every_n_steps=1),
+            watchdog=WatchdogConfig(min_samples=2, warmup_steps=1,
+                                    slow_factor=5.0, stall_s=1e9))
+        tr = Trainer(step, cfg)
+        with chaos.active(plan):
+            _, stats = tr.train(state, ds)
+        assert stats["steps"] == 10
+        assert plan.fired("fault_point") == 1      # the latency landed
+
+        kinds = {a["anomaly"] for a in tr.watchdog.anomalies}
+        assert {"slow_step", "retrace"} <= kinds, tr.watchdog.anomalies
+        assert M.counter("jit.retraces").value(
+            fn="trainer.step") == retr0 + 1
+        anom = M.counter("watchdog.anomalies").snapshot()
+        assert anom.get("kind=slow_step", 0) > \
+            anom0.get("kind=slow_step", 0)
+        assert anom.get("kind=retrace", 0) > anom0.get("kind=retrace", 0)
+        # anomaly events rode the telemetry RunLog next to step records
+        recs = read_records(run_log)
+        logged = {r["anomaly"] for r in recs if "anomaly" in r}
+        assert {"slow_step", "retrace"} <= logged
+        assert any("step" in r and not r.get("final") for r in recs)

@@ -14,9 +14,16 @@ command turns a training run's artifacts into the human-readable story —
   * top-K device ops when given a jax.profiler trace dir
     (profiler.trace_op_table).
 
+`--serve` renders the serving view instead: per-request lifecycles
+reconstructed from the engine's trace events (submitted/admitted/
+prefill_done/first_token/preempted/resumed/retired), an ASCII per-slot
+Gantt of slot occupancy, TTFT + token-latency percentiles, goodput
+against the configured SLOs, and preemption attribution.
+
 Usage:
   python tools/run_report.py /runs/exp1/run.jsonl
   python tools/run_report.py run.jsonl --trace /tmp/prof --top 20
+  python tools/run_report.py serve.jsonl --serve
   python tools/run_report.py --selftest      # tier-1 smoke: tiny GPT
                                              # through the Trainer with
                                              # telemetry on, then render
@@ -186,6 +193,164 @@ def render_report(records, trace_dir=None, top=20, device_filter="TPU"):
     return "\n".join(lines)
 
 
+# -- serving view ---------------------------------------------------------
+
+_GANTT_CHARS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def _pctl_line(label, vals_s):
+    vals = sorted(vals_s)
+    if not vals:
+        return f"{label} (no data)"
+    return (label + "  ".join(
+        f"p{int(q * 100)}={_percentile(vals, q) * 1e3:.1f}ms"
+        for q in (0.50, 0.90, 0.99)) + f"  n={len(vals)}")
+
+
+def _slot_gantt(events, width=64):
+    """ASCII per-slot occupancy: each request renders as its id's base-36
+    digit from admission (or resume) to preemption/retirement."""
+    slotted = [e for e in events
+               if "slot" in e and e["event"] in
+               ("admitted", "resumed", "preempted", "retired")]
+    if not slotted:
+        return ["(no slot events)"]
+    t0 = min(e["t"] for e in slotted)
+    t1 = max(e["t"] for e in slotted)
+    span = max(t1 - t0, 1e-9)
+
+    def col(t):
+        return min(int((t - t0) / span * (width - 1)), width - 1)
+
+    slots = sorted({e["slot"] for e in slotted})
+    rows = {s: [" "] * width for s in slots}
+    open_at = {}                      # slot -> (req, start col)
+    for e in sorted(slotted, key=lambda e: e["t"]):
+        s = e["slot"]
+        if e["event"] in ("admitted", "resumed"):
+            open_at[s] = (e["req"], col(e["t"]))
+        else:
+            req, c0 = open_at.pop(s, (e["req"], col(e["t"])))
+            c1 = col(e["t"])
+            ch = _GANTT_CHARS[req % len(_GANTT_CHARS)]
+            for c in range(c0, c1 + 1):
+                rows[s][c] = ch
+            if e["event"] == "preempted":
+                rows[s][c1] = "!"
+    for s, (req, c0) in open_at.items():    # still running at log end
+        ch = _GANTT_CHARS[req % len(_GANTT_CHARS)]
+        for c in range(c0, width):
+            rows[s][c] = ch
+    out = [f"slot timeline (t0=+0.000s, span={span:.3f}s, one request "
+           f"= its id base-36; '!' = preemption):"]
+    for s in slots:
+        out.append(f"  slot {s:>2} |{''.join(rows[s])}|")
+    return out
+
+
+def render_serve_report(records, top=20, width=64):
+    """The serving story from engine trace events + per-step records."""
+    events = [r for r in records if "event" in r and "req" in r]
+    steps = [r for r in records
+             if r.get("phase") == "serve" and "step" in r
+             and not r.get("final")]
+    finals = [r for r in records if r.get("final")]
+    lines = ["=" * 72, "SERVE REPORT", "=" * 72]
+    if not events:
+        lines.append("\n(no serve trace events in this RunLog — run the "
+                     "engine with ServeConfig(run_log=...))")
+        return "\n".join(lines + ["=" * 72])
+
+    byreq = {}
+    for e in sorted(events, key=lambda e: e["t"]):
+        byreq.setdefault(e["req"], []).append(e)
+
+    def last(req_events, name):
+        hits = [e for e in req_events if e["event"] == name]
+        return hits[-1] if hits else None
+
+    retired = {r: ev for r, ev in byreq.items() if last(ev, "retired")}
+    reasons = {}
+    ttfts, tok_lats, slo_flags = [], [], []
+    for r, ev in retired.items():
+        ret = last(ev, "retired")
+        reasons[ret.get("reason", "?")] = \
+            reasons.get(ret.get("reason", "?"), 0) + 1
+        sub, ft = last(ev, "submitted"), last(ev, "first_token")
+        if sub and ft:
+            ttfts.append(ft["t"] - sub["t"])
+        ntok = ret.get("tokens", 0)
+        if ft and ntok > 1:
+            tok_lats.append((ret["t"] - ft["t"]) / (ntok - 1))
+        if ret.get("slo_ok") is not None:
+            slo_flags.append(bool(ret["slo_ok"]))
+    preempted = {r: ev for r, ev in byreq.items()
+                 if last(ev, "preempted")}
+
+    lines.append(
+        f"\nrequests: {len(byreq)} submitted, {len(retired)} retired "
+        f"({', '.join(f'{k} {v}' for k, v in sorted(reasons.items()))})"
+        + (f", {len(preempted)} preempted" if preempted else ""))
+    lines.append(_pctl_line("TTFT:          ", ttfts))
+    lines.append(_pctl_line("token latency: ", tok_lats))
+    if slo_flags:
+        good = sum(slo_flags) / len(slo_flags)
+        slo = (finals[-1].get("slo") if finals else None) or {}
+        viol = slo.get("violations") or {}
+        tgt = ", ".join(f"{k}={slo[k]}" for k in
+                        ("slo_ttft_s", "slo_token_latency_s")
+                        if slo.get(k))
+        lines.append(
+            f"goodput:        {good:.4f} over {len(slo_flags)} retired"
+            + (f"  (targets: {tgt})" if tgt else "  (no SLO configured)")
+            + (f"  violations: "
+               + ", ".join(f"{k}={v}" for k, v in sorted(viol.items()))
+               if viol else ""))
+    if steps:
+        walls = [r["wall_s"] for r in steps
+                 if isinstance(r.get("wall_s"), (int, float))]
+        toks = sum(r.get("new_tokens") or 0 for r in steps)
+        lines.append(_pctl_line(
+            f"serve steps:    {len(steps)} ({toks} tokens)  step ",
+            walls))
+    lines.append("")
+    lines.extend(_slot_gantt(events, width=width))
+
+    if preempted:
+        lines.append("\npreemption attribution:")
+        for r in sorted(preempted):
+            ev = byreq[r]
+            for p in (e for e in ev if e["event"] == "preempted"):
+                res = [e for e in ev if e["event"] == "resumed"
+                       and e["t"] > p["t"]]
+                lines.append(
+                    f"  req {r}: preempted at slot {p.get('slot')} "
+                    f"({p.get('tokens_dropped', 0)} tokens dropped, "
+                    + (f"resumed +{res[0]['t'] - p['t']:.3f}s later)"
+                       if res else "never resumed)"))
+
+    lines.append(f"\nrequest lifecycles (top {top} by span):")
+    t_base = min(e["t"] for ev in byreq.values() for e in ev)
+
+    def req_span(ev):
+        return ev[-1]["t"] - ev[0]["t"]
+
+    for r, ev in sorted(byreq.items(), key=lambda kv: -req_span(kv[1]))[
+            :top]:
+        trace = ev[0].get("trace", "")
+        parts = []
+        for e in ev:
+            tag = e["event"]
+            if tag == "retired":
+                tag += (f"[{e.get('reason')}, {e.get('tokens')} tok"
+                        + (", slo_ok" if e.get("slo_ok")
+                           else ", SLO MISS") + "]")
+            parts.append(f"{tag} +{e['t'] - t_base:.3f}")
+        lines.append(f"  req {r} [{trace}]: " + " -> ".join(parts))
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
 def _selftest():
     """Tier-1 smoke (CPU-only): a tiny GPT trained through the Trainer
     with telemetry on must produce a RunLog whose records carry wall
@@ -272,6 +437,11 @@ def main():
     ap.add_argument("--device-filter", default="TPU",
                     help="trace lane substring ('TPU', 'CPU'; falls back "
                          "automatically when empty)")
+    ap.add_argument("--serve", action="store_true",
+                    help="render the serving view: per-request "
+                         "lifecycles, per-slot Gantt, TTFT/token-"
+                         "latency percentiles, goodput, preemption "
+                         "attribution")
     ap.add_argument("--selftest", action="store_true",
                     help="train a tiny GPT with telemetry on (CPU) and "
                          "render its report — the tier-1 smoke")
@@ -285,6 +455,9 @@ def main():
     records = read_records(args.runlog)
     if not records:
         raise SystemExit(f"no records in {args.runlog}")
+    if args.serve:
+        print(render_serve_report(records, top=args.top))
+        return
     print(render_report(records, trace_dir=args.trace, top=args.top,
                         device_filter=args.device_filter))
 

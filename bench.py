@@ -563,6 +563,14 @@ def bench_gpt_serve(steps, batch, seq):
         "slo_token_latency_s": slo_tok,
         "slo_violations": slo["violations"],
         "decode_traces": engine.decode_traces,
+        # resilience trajectory: non-completion terminals + step crashes
+        # recovered (all 0 in a healthy bench; a regression here means
+        # the bench itself hit the resilience path)
+        "rejected": sum(1 for r in engine.requests.values()
+                        if r.status == "rejected"),
+        "shed": sum(1 for r in engine.requests.values()
+                    if r.status == "shed"),
+        "recovered": engine.recoveries,
         "note": "continuous batching over the paged KV cache; mixed "
                 "prompt lengths, admissions between decode steps",
     }

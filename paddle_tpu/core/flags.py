@@ -129,6 +129,25 @@ define_flag("serve_page_size", 16,
 define_flag("serve_slots", 4,
             "Concurrent decode slots in the serving engine (the fixed "
             "batch dimension of the jitted serve step).")
+# serving resilience (serving/engine.py): bounded admission, chunked
+# prefill, and crash-isolated step recovery — degraded conditions produce
+# degraded service (rejected/shed/recovered requests), never lost ones
+define_flag("serve_queue_limit", 0,
+            "Max queued (not yet admitted) requests in the serving "
+            "engine; submissions beyond it are REJECTED with a terminal "
+            "status and a retriable hint. 0 = unbounded.")
+define_flag("serve_default_deadline_s", 0.0,
+            "Default per-request deadline (seconds from submit) applied "
+            "when submit() passes none; queued requests past their "
+            "deadline are shed. 0 = no default deadline.")
+define_flag("serve_step_retries", 3,
+            "Consecutive failed serve steps (prefill or decode) the "
+            "engine recovers from — quarantine pools, re-admit in-flight "
+            "requests recompute-style — before giving up and re-raising.")
+define_flag("serve_chunked_prefill", True,
+            "Admit prompts longer than prefill_len in fixed-shape "
+            "prefill_len chunks (one prefill trace, page tables grown "
+            "per chunk); False restores the long-prompt rejection.")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

@@ -125,6 +125,34 @@ class RetryPolicy:
         return wrapped
 
 
+class RetryBudget:
+    """Consecutive-failure budget over a RetryPolicy, for loop-shaped
+    consumers (the serving engine's step recovery) where one logical
+    operation spans many calls: `failure()` counts a failure, sleeps the
+    policy's backoff, and re-raises once the policy's attempt budget is
+    spent; `success()` resets the streak. Shares the policy's
+    `retry.attempts`/`retry.giveups` metric accounting."""
+
+    def __init__(self, policy, op):
+        self.policy = policy
+        self.op = op
+        self.failures = 0
+
+    def success(self):
+        self.failures = 0
+
+    def failure(self, exc):
+        """Record one failure: sleep the backoff and return the streak
+        length, or re-raise `exc` once max_attempts is reached."""
+        self.failures += 1
+        _metrics.counter("retry.attempts").inc(op=self.op)
+        if self.failures >= self.policy.max_attempts:
+            _metrics.counter("retry.giveups").inc(op=self.op)
+            raise exc
+        self.policy._sleep(self.policy.backoff_s(self.failures))
+        return self.failures
+
+
 def default_policy(**overrides):
     """A policy from the current ``retry_*`` flags (fresh each call so
     `set_flags` between operations takes effect)."""

@@ -119,6 +119,17 @@ class GPTBlock(nn.Module):
         x = x + self._ffn(self.ln2(x))
         return x, pool
 
+    def paged_prefill_chunk(self, x, pool, page_ids, offsets, page_rows,
+                            q_pos, chunked):
+        """Chunked prompt fill (continuation chunks attend the slot's
+        whole cached prefix — see MultiHeadAttention.paged_prefill_chunk)."""
+        h, pool = self.attn.paged_prefill_chunk(
+            self.ln1(x), pool, page_ids, offsets, page_rows, q_pos,
+            chunked)
+        x = x + h
+        x = x + self._ffn(self.ln2(x))
+        return x, pool
+
 
 class GPT(nn.Module):
     """Causal LM: returns next-token logits [B, T, V] (weight-tied head)."""
@@ -290,24 +301,47 @@ class GPTDecoder(GPT):
         int32 (padded; Lp fixed so admission never retraces); lengths:
         [B] true prompt lengths; page_rows: [B, Pmax] int32. Pad
         positions route to the out-of-range drop page. Returns (logits
-        of each request's LAST real token [B, V], new_caches)."""
+        of each request's LAST real token [B, V], new_caches).
+
+        The single-chunk (starts = 0) case of paged_prefill_chunk, kept
+        as the stable entry point — per-request jnp.where selection makes
+        a first chunk numerically identical to the pre-chunking path."""
+        b = prompt.shape[0]
+        return self.paged_prefill_chunk(
+            prompt, jnp.zeros((b,), jnp.int32), lengths, caches,
+            page_rows)
+
+    def paged_prefill_chunk(self, prompt, starts, chunk_lengths, caches,
+                            page_rows):
+        """Chunked admission prefill: the fixed [B, Lp] window holds
+        tokens at ABSOLUTE positions starts[b] .. starts[b] +
+        chunk_lengths[b] - 1 of each request, so a prompt longer than Lp
+        is admitted as ceil(len / Lp) calls of one trace. First chunks
+        (starts == 0) take the in-chunk causal path bit-exactly;
+        continuation chunks re-attend the slot's whole cached prefix
+        through its page table. Returns (logits of each request's LAST
+        chunk token [B, V], new_caches)."""
         b, lp = prompt.shape
         num_pages, _, page_size, _ = caches[0]["k"].shape
-        pos = jnp.arange(lp)
-        page_ids = jnp.take_along_axis(page_rows,
-                                       (pos[None, :] // page_size),
-                                       axis=1)                  # [B, Lp]
-        page_ids = jnp.where(pos[None, :] < lengths[:, None], page_ids,
-                             num_pages)
-        offsets = jnp.broadcast_to(pos % page_size, (b, lp))
-        x = self.tok_emb(prompt) + self.pos_emb(pos[None, :])
+        p_max = page_rows.shape[1]
+        rel = jnp.arange(lp)
+        pos = starts[:, None] + rel[None, :]                    # [B, Lp]
+        in_chunk = rel[None, :] < chunk_lengths[:, None]
+        page_ids = jnp.take_along_axis(
+            page_rows, jnp.minimum(pos // page_size, p_max - 1), axis=1)
+        page_ids = jnp.where(in_chunk, page_ids, num_pages)
+        offsets = pos % page_size
+        emb_pos = jnp.minimum(pos, self.cfg.max_position - 1)
+        x = self.tok_emb(prompt) + self.pos_emb(emb_pos)
+        chunked = starts > 0
         new_caches = []
         for blk, pool in zip(self.blocks, caches):
-            x, pool = blk.paged_prefill(x, pool, page_ids, offsets)
+            x, pool = blk.paged_prefill_chunk(x, pool, page_ids, offsets,
+                                              page_rows, pos, chunked)
             new_caches.append(pool)
         x = self.ln_f(x)
         last = jnp.take_along_axis(
-            x, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)
+            x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)
         return nn.tied_vocab_head(self.tok_emb, last)[:, 0], new_caches
 
     def generate(self, prompt, max_new, temperature=0.0, key=None,

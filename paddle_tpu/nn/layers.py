@@ -670,6 +670,63 @@ class MultiHeadAttention(Module):
         out = ctx.transpose(0, 2, 1, 3).reshape(b, t, e)
         return self._project(out, "o"), pool
 
+    def paged_prefill_chunk(self, x, pool, page_ids, offsets, page_rows,
+                            q_pos, chunked):
+        """Chunked-admission twin of paged_prefill: K/V of this chunk is
+        written exactly as there, but a continuation chunk (chunked[b] =
+        True, absolute start > 0) must attend over EVERY token its slot
+        has cached so far — so its context is recomputed by gathering the
+        slot's whole page table (page_rows: [B, Pmax]) and masking keys
+        by absolute position (q_pos: [B, T]). First chunks keep the
+        in-chunk causal path, selected per request by jnp.where, so
+        single-chunk admissions stay bit-exact with paged_prefill.
+        Prefill is admission-rate work; the dense [T, Pmax*ps] score
+        temporary never appears on the decode hot path."""
+        from paddle_tpu.ops.attention import NEG_INF, paged_write
+        b, t, e = x.shape
+        hd = e // self.num_heads
+
+        def heads(y):
+            return y.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
+
+        q = heads(self._project(x, "q"))
+        k = heads(self._project(x, "k"))
+        v = heads(self._project(x, "v"))
+        pool = paged_write(
+            pool,
+            k.transpose(0, 2, 1, 3).reshape(b * t, self.num_heads, hd),
+            v.transpose(0, 2, 1, 3).reshape(b * t, self.num_heads, hd),
+            page_ids.reshape(b * t), offsets.reshape(b * t))
+        if self.use_flash:
+            from paddle_tpu.ops.pallas.flash_attention import \
+                flash_attention
+            ctx = flash_attention(q, k, v, causal=True)
+        else:
+            from paddle_tpu.ops.attention import \
+                scaled_dot_product_attention
+            ctx = scaled_dot_product_attention(q, k, v, causal=True)
+        # full-history path: pool pages were just updated with this
+        # chunk, so the gather sees prefix + chunk at absolute positions
+        tk = page_rows.shape[1] * pool["k"].shape[2]
+        kf = jnp.moveaxis(pool["k"][page_rows], 2, 1).reshape(
+            b, self.num_heads, tk, hd)
+        vf = jnp.moveaxis(pool["v"][page_rows], 2, 1).reshape(
+            b, self.num_heads, tk, hd)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kf.astype(jnp.float32)) / (hd ** 0.5)
+        keep = (jnp.arange(tk)[None, None, None, :]
+                <= q_pos[:, None, :, None])
+        scores = jnp.where(keep, scores, NEG_INF)
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.where(keep, jnp.exp(scores - m), 0.0)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        full = jnp.einsum("bhqk,bhkd->bhqd", p, vf.astype(jnp.float32))
+        full = jnp.where(l > 0, full / jnp.maximum(l, 1e-30), 0.0)
+        ctx = jnp.where(chunked[:, None, None, None],
+                        full.astype(ctx.dtype), ctx)
+        out = ctx.transpose(0, 2, 1, 3).reshape(b, t, e)
+        return self._project(out, "o"), pool
+
 
 class FC(Linear):
     """ref: dygraph/nn.py FC — Linear with num_flatten_dims semantics."""

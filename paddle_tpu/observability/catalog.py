@@ -73,9 +73,20 @@ CATALOG = {
         "Requests preempted (pages freed, requeued) on pool deadlock."),
     "serve.queue_depth": MetricSpec(
         "gauge", (), "Requests waiting for a decode slot."),
+    "serve.recoveries": MetricSpec(
+        "counter", ("where",),
+        "Serve-step failures recovered by quarantining device state and "
+        "re-admitting in-flight requests (where: serve.prefill | "
+        "serve.step)."),
     "serve.requests": MetricSpec(
         "counter", ("status",),
-        "Request lifecycle tallies (submitted / completed)."),
+        "Request lifecycle tallies (status: submitted | completed | "
+        "rejected | shed | cancelled | failed)."),
+    "serve.shed": MetricSpec(
+        "counter", ("cause",),
+        "Queued requests shed by deadline expiry or watchdog-driven "
+        "load shedding (cause: deadline | goodput_collapse | "
+        "ingest_stall)."),
     "serve.slo_violations": MetricSpec(
         "counter", ("kind",),
         "Retired requests that missed an SLO (kind: ttft | "

@@ -76,12 +76,13 @@ class Watchdog:
     the `watchdog.anomalies{kind}` counter."""
 
     def __init__(self, config=None, run_log=None, registry=None,
-                 clock=time.time):
+                 clock=time.time, action=None):
         self.cfg = (config or WatchdogConfig()).resolve()
         self._reg = (registry if registry is not None
                      else _metrics.registry())
         self._run_log = run_log
         self._clock = clock
+        self._action = action       # mitigation callback: (event) -> None
         self._steps = collections.deque(maxlen=self.cfg.window)
         self._latched = set()
         self._watched = {}          # fn name -> (callable, last cache size)
@@ -170,19 +171,29 @@ class Watchdog:
                           _help("watchdog.anomalies")).inc(kind=kind)
         if self._run_log is not None:
             self._run_log.write(event)
+        if self._action is not None:
+            # mitigation must never take down the loop it protects
+            try:
+                self._action(event)
+            except Exception as e:
+                if self._run_log is not None:
+                    self._run_log.write({"anomaly_action_error":
+                                         f"{type(e).__name__}: {e}"[:200]})
 
     def _clear(self, kind):
         self._latched.discard(kind)
 
 
-def maybe_watchdog(setting, run_log=None, registry=None):
+def maybe_watchdog(setting, run_log=None, registry=None, action=None):
     """Resolve a Trainer/ServeConfig `watchdog` field into a Watchdog or
     None: a WatchdogConfig is used as-is, True builds defaults, None
-    honors the global `watchdog` flag, False disables."""
+    honors the global `watchdog` flag, False disables. `action` is an
+    optional mitigation callback invoked with each fired anomaly event
+    (the serving engine passes its load-shedding handler)."""
     if setting is None:
         from paddle_tpu.core.flags import get_flag
         setting = bool(get_flag("watchdog"))
     if not setting:
         return None
     cfg = setting if isinstance(setting, WatchdogConfig) else None
-    return Watchdog(cfg, run_log=run_log, registry=registry)
+    return Watchdog(cfg, run_log=run_log, registry=registry, action=action)

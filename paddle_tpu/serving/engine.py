@@ -43,6 +43,37 @@ Live observability plane (this layer's serving half):
   * `ServeConfig.metrics_port` starts the /metrics exporter
     (observability/exporter.py) for the run; `ServeConfig.watchdog`
     attaches the anomaly watchdog (observability/watchdog.py).
+
+Resilience layer (degraded conditions produce degraded service, never
+lost requests — terminal statuses: done | rejected | shed | cancelled |
+failed):
+
+  * chunked prefill — prompts up to max_len are admitted as
+    ceil(len / prefill_len) calls of the ONE prefill trace
+    (GPTDecoder.paged_prefill_chunk), page tables grown per chunk; the
+    long-prompt rejection class is gone (`serve_chunked_prefill` flag).
+  * bounded admission — submit() takes optional deadline_s / priority;
+    the `serve_queue_limit` flag bounds the queue, and over-limit or
+    infeasible-deadline submissions get a terminal `rejected` status
+    with `req.retriable = True` (back off and resubmit). Admission picks
+    highest-priority / earliest-deadline first; the pool-deadlock
+    preemption victim becomes lowest-priority / latest-deadline (the
+    old youngest-first order is the all-defaults special case).
+  * crash-isolated step recovery — `fault_point("serve.prefill")` /
+    `fault_point("serve.step")` hooks plus an exception barrier around
+    both jitted calls: on failure the engine quarantines device state
+    (page pools are donated, hence poisoned), rebuilds them, and
+    re-admits every in-flight request recompute-style — the host-side
+    prompt + generated tokens are the durable state, so a recovered
+    greedy request finishes token-exact. Bounded by a RetryPolicy
+    budget (`serve_step_retries` consecutive failures, then the
+    engine fails every request and re-raises). A runtime Pallas decode
+    failure additionally latches a permanent per-process XLA fallback
+    through the shared pallas.fallback wiring.
+  * watchdog mitigation — goodput_collapse / ingest_stall anomalies
+    invoke the engine's load-shedding action: expired-deadline queued
+    requests are shed first, else the single lowest-priority one
+    (terminal `shed` status, serve.shed{cause}).
 """
 
 import collections
@@ -57,8 +88,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
-from paddle_tpu.core.flags import get_flag
+from paddle_tpu.core.flags import get_flag, set_flags
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.testing.chaos import fault_point
 
 
 @dataclasses.dataclass
@@ -79,6 +111,10 @@ class ServeConfig:
     slo_token_latency_s: float = None   # None -> flag; 0 = unbounded
     metrics_port: int = None     # None -> flag metrics_port; 0 = off
     watchdog: object = None      # None -> flag; True or WatchdogConfig
+    queue_limit: int = None      # None -> flag serve_queue_limit; 0 = off
+    default_deadline_s: float = None   # None -> flag; 0 = none
+    step_retries: int = None     # None -> flag serve_step_retries
+    chunked_prefill: bool = None  # None -> flag serve_chunked_prefill
 
     def resolve(self):
         if self.num_slots is None:
@@ -89,6 +125,15 @@ class ServeConfig:
             self.slo_ttft_s = get_flag("slo_ttft_s")
         if self.slo_token_latency_s is None:
             self.slo_token_latency_s = get_flag("slo_token_latency_s")
+        if self.queue_limit is None:
+            self.queue_limit = int(get_flag("serve_queue_limit"))
+        if self.default_deadline_s is None:
+            self.default_deadline_s = float(
+                get_flag("serve_default_deadline_s"))
+        if self.step_retries is None:
+            self.step_retries = int(get_flag("serve_step_retries"))
+        if self.chunked_prefill is None:
+            self.chunked_prefill = bool(get_flag("serve_chunked_prefill"))
         pages_per_slot = -(-self.max_len // self.page_size)
         if self.num_pages is None:
             self.num_pages = self.num_slots * pages_per_slot
@@ -109,18 +154,23 @@ class Request:
     max_new: int
     eos_id: int = None
     tokens: list = dataclasses.field(default_factory=list)
-    status: str = "queued"        # queued -> running -> done
+    status: str = "queued"        # queued -> running -> terminal (done |
+    #                               rejected | shed | cancelled | failed)
     slot: int = None
     pages: list = dataclasses.field(default_factory=list)
     submit_t: float = None
     first_token_t: float = None
     done_t: float = None
-    device_prompt: typing.Any = None   # staged padded [1, Lp] (async put)
+    device_prompt: typing.Any = None   # staged [1, Lp] chunks (async put)
     trace_id: str = None          # engine-run-scoped lifecycle trace id
     trace: list = dataclasses.field(default_factory=list)  # (event, t)
     preemptions: int = 0
-    retire_reason: str = None     # "eos" | "length"
+    retire_reason: str = None     # "eos"|"length" or the terminal cause
     slo_ok: bool = None           # every configured SLO met at retire
+    priority: int = 0             # higher admits first, evicts last
+    deadline_t: float = None      # absolute clock() deadline, or None
+    retriable: bool = False       # rejected-but-worth-resubmitting hint
+    recoveries: int = 0           # times re-admitted after a step crash
 
     @property
     def output(self):
@@ -151,11 +201,18 @@ class ServingEngine:
         self._free_pages = collections.deque(range(cfg.num_pages))
         self._queue = collections.deque()
         self._running = {}
+        self.requests = {}            # id -> Request (cancel / post-mortem)
         self._ids = itertools.count()
         self._step_no = 0
         self._base_key = jax.random.key(cfg.seed)
         self.decode_traces = 0
         self.prefill_traces = 0
+        self.recoveries = 0           # step crashes recovered (engine-wide)
+        self._trace_credit = 0        # legitimate re-traces (jit rebuild
+        #                               after a latched Pallas fallback)
+        from paddle_tpu.core.retry import RetryBudget, RetryPolicy
+        self._retry_budget = RetryBudget(
+            RetryPolicy(max_attempts=cfg.step_retries + 1), "serve.step")
 
         # host->device prompt staging reuses the DataLoader placement path
         # (async device_put; depth knob = the reader_queue_size flag), so
@@ -181,7 +238,8 @@ class ServingEngine:
             "serve.queue_depth", "serve.active_slots", "serve.ttft_s",
             "serve.token_latency_s", "serve.tokens", "serve.requests",
             "serve.page_stalls", "serve.preemptions", "serve.goodput",
-            "serve.slo_violations", "jit.retraces"])
+            "serve.slo_violations", "serve.recoveries", "serve.shed",
+            "jit.retraces"])
         self._retired = 0
         self._retired_ok = 0
         self._viol_base = dict(
@@ -192,7 +250,8 @@ class ServingEngine:
         self._metrics_server = start_metrics_server(cfg.metrics_port)
         from paddle_tpu.observability.watchdog import maybe_watchdog
         self._watchdog = maybe_watchdog(cfg.watchdog,
-                                        run_log=self._run_log)
+                                        run_log=self._run_log,
+                                        action=self._on_anomaly)
 
         temp = float(cfg.temperature)
 
@@ -203,14 +262,33 @@ class ServingEngine:
             return jnp.argmax(logits, -1).astype(jnp.int32)
 
         self._sample = _sample
+        self._build_jits()
+
+    def _build_jits(self):
+        """(Re)create the two jitted closures. Called once at
+        construction and again when a recovery latches the Pallas->XLA
+        decode fallback (the flag is read at trace time, so a fresh jit
+        cache is the only way to honor the flip); `_trace_credit`
+        absorbs those deliberate re-traces so they don't count as
+        `jit.retraces`."""
+        model = self._model
+        _sample = self._sample
+
+        def _count_trace(attr, fn):
+            n = getattr(self, attr) + 1
+            setattr(self, attr, n)
+            if n > 1 and not self._aot_trace:
+                if self._trace_credit > 0:
+                    self._trace_credit -= 1
+                else:
+                    # traced-once invariant broken in live serving —
+                    # visible to /metrics and the watchdog, not just
+                    # compile smokes
+                    _metrics.counter("jit.retraces").inc(fn=fn)
 
         def decode(params, caches, tokens, page_table, lengths, active,
                    key):
-            self.decode_traces += 1   # trace-time only: counts compiles
-            if self.decode_traces > 1 and not self._aot_trace:
-                # traced-once invariant broken in live serving — visible
-                # to /metrics and the watchdog, not just compile smokes
-                _metrics.counter("jit.retraces").inc(fn="serve.decode")
+            _count_trace("decode_traces", "serve.decode")
 
             def run(tok):
                 logits, new_caches = model.paged_decode_step(
@@ -220,14 +298,13 @@ class ServingEngine:
             return model.apply({"params": params, "state": {}}, tokens,
                                method=run)
 
-        def prefill(params, caches, prompt, lengths, page_rows, key):
-            self.prefill_traces += 1
-            if self.prefill_traces > 1 and not self._aot_trace:
-                _metrics.counter("jit.retraces").inc(fn="serve.prefill")
+        def prefill(params, caches, prompt, starts, lengths, page_rows,
+                    key):
+            _count_trace("prefill_traces", "serve.prefill")
 
             def run(pr):
-                logits, new_caches = model.paged_prefill(
-                    pr, lengths, caches, page_rows)
+                logits, new_caches = model.paged_prefill_chunk(
+                    pr, starts, lengths, caches, page_rows)
                 return _sample(logits, key), new_caches
 
             return model.apply({"params": params, "state": {}}, prompt,
@@ -238,32 +315,85 @@ class ServingEngine:
 
     # --- public API ---
 
-    def submit(self, prompt, max_new=None, eos_id=None):
+    def submit(self, prompt, max_new=None, eos_id=None, deadline_s=None,
+               priority=0):
         """Queue a prompt; returns the request id. The padded prompt is
         staged host->device immediately (async), so admission inside a
-        later step() issues no host transfer."""
+        later step() issues no host transfer. Prompts longer than
+        prefill_len stage as multiple fixed-shape chunks (chunked
+        prefill).
+
+        Bounded admission: `deadline_s` (None resolves the
+        serve_default_deadline_s flag; 0 there means none) sets an
+        absolute deadline — a queued request past it is shed, and a
+        non-positive explicit value is rejected up front as infeasible.
+        `priority` (higher first) orders admission and inverts the
+        preemption victim choice. When the serve_queue_limit flag bounds
+        the queue, over-limit submissions get a terminal `rejected`
+        status with `req.retriable = True` instead of queueing — check
+        `engine.requests[rid].status` after submit."""
         cfg = self.cfg
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = max_new if max_new is not None else cfg.default_max_new
-        enforce(1 <= prompt.size <= cfg.prefill_len,
-                f"prompt length {prompt.size} not in [1, "
-                f"{cfg.prefill_len}] (prefill_len)")
+        cap = cfg.max_len if cfg.chunked_prefill else cfg.prefill_len
+        enforce(1 <= prompt.size <= cap,
+                f"prompt length {prompt.size} not in [1, {cap}] "
+                + ("(max_len)" if cfg.chunked_prefill
+                   else "(prefill_len; serve_chunked_prefill is off)"))
         enforce(prompt.size + max_new <= cfg.max_len,
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"max_len {cfg.max_len}")
         req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
-                      eos_id=eos_id if eos_id is not None else cfg.eos_id)
+                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
+                      priority=int(priority))
         req.trace_id = f"{self._trace_run}/{req.id}"
+        self.requests[req.id] = req
+        extra = {}
+        if priority:
+            extra["priority"] = int(priority)
+        if deadline_s is not None:
+            extra["deadline_s"] = float(deadline_s)
         req.submit_t = self._trace_event(req, "submitted",
                                          prompt_len=int(prompt.size),
-                                         max_new=int(max_new))
-        padded = np.zeros((1, cfg.prefill_len), np.int32)
-        padded[0, :prompt.size] = prompt
-        req.device_prompt = self._stager.place(padded)
+                                         max_new=int(max_new), **extra)
+        _metrics.counter("serve.requests").inc(status="submitted")
+        if deadline_s is None and cfg.default_deadline_s > 0:
+            deadline_s = cfg.default_deadline_s
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                self._reject(req, "infeasible_deadline")
+                return req.id
+            req.deadline_t = req.submit_t + float(deadline_s)
+        if cfg.queue_limit and len(self._queue) >= cfg.queue_limit:
+            self._reject(req, "queue_full")
+            return req.id
+        req.device_prompt = self._stage_chunks(prompt)
         self._queue.append(req)
         _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        _metrics.counter("serve.requests").inc(status="submitted")
         return req.id
+
+    def cancel(self, request_id):
+        """Client-initiated cancellation: a first-class terminal status.
+        A queued request leaves the queue; a running one frees its slot
+        and pages immediately. Returns True if cancelled, False when the
+        id is unknown or already terminal. Cancelled requests do not
+        count against goodput (the client walked away; the engine did
+        not fail them)."""
+        req = self.requests.get(request_id)
+        if req is None or req.status not in ("queued", "running"):
+            return False
+        if req.status == "queued":
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+        else:
+            self._free_slot_state(req)
+        self._retire_terminal(req, "cancelled", "cancelled",
+                              account=False)
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        _metrics.gauge("serve.active_slots").set(len(self._running))
+        return True
 
     def step(self):
         """One scheduling round: free finished slots happened last round;
@@ -273,25 +403,36 @@ class ServingEngine:
         their token budget. Returns the requests finished this round."""
         t0 = self._clock()
         finished = []
+        self._shed_expired(finished)
         self._admit(finished)
         stalled = self._grow_pages()
         while stalled and not self._active.any():
             # pool deadlock: every live slot needs a fresh page and none
-            # is free. Preempt the YOUNGEST stalled request (free its
-            # pages, requeue it for re-prefill) so the oldest always
-            # makes progress — greedy decoding regenerates the dropped
-            # tokens exactly; sampled runs re-draw (recompute preemption)
-            victim = max(stalled, key=lambda s: self._running[s].id)
-            self._preempt(self._running[victim])
+            # is free. Preempt the lowest-priority / latest-deadline
+            # stalled request (free its pages, requeue it for
+            # re-prefill) so higher-value work always makes progress —
+            # with all-default requests this reduces to the youngest.
+            # Greedy decoding regenerates the dropped tokens exactly;
+            # sampled runs re-draw (recompute preemption).
+            victim = min((self._running[s] for s in stalled),
+                         key=self._victim_key)
+            self._preempt(victim)
             stalled = self._grow_pages()
         new_tokens = 0
+        toks = None
         if self._active.any():
             key = jax.random.fold_in(self._base_key, self._step_no)
-            toks_dev, self._caches = self._decode_jit(
-                self._params, self._caches, self._last_tokens,
-                self._page_table, self._lengths, self._active, key)
-            toks = np.asarray(toks_dev)        # host sync: the scheduler
-            dt = self._clock() - t0            # needs the tokens
+            try:
+                fault_point("serve.step")
+                toks_dev, self._caches = self._decode_jit(
+                    self._params, self._caches, self._last_tokens,
+                    self._page_table, self._lengths, self._active, key)
+                toks = np.asarray(toks_dev)    # host sync: the scheduler
+            except Exception as e:             # needs the tokens
+                self._recover("serve.step", e)
+        if toks is not None:
+            self._retry_budget.success()       # consecutive-failure reset
+            dt = self._clock() - t0
             lat = _metrics.histogram("serve.token_latency_s")
             for slot, req in list(self._running.items()):
                 if not self._active[slot]:
@@ -464,44 +605,123 @@ class ServingEngine:
             self._run_log.write(rec)
         return t
 
+    def _stage_chunks(self, seq):
+        """Stage `seq` host->device (async) as ceil(len / prefill_len)
+        padded [1, prefill_len] chunk arrays — one for an ordinary
+        prompt, more under chunked prefill or a recovery replay. Staging
+        MORE than currently needed is harmless: each prefill call masks
+        by its chunk length, so a preempted request (tokens dropped)
+        reuses the same chunk list without restaging."""
+        lp = self.cfg.prefill_len
+        seq = np.asarray(seq, np.int32).reshape(-1)
+        n = max(1, -(-seq.size // lp))
+        padded = np.zeros((n * lp,), np.int32)
+        padded[:seq.size] = seq
+        return [self._stager.place(padded[i * lp:(i + 1) * lp][None, :])
+                for i in range(n)]
+
+    def _admission_key(self, req):
+        """Admission order: highest priority, then earliest deadline
+        (None last), then FIFO — all-default traffic stays pure FIFO."""
+        dl = req.deadline_t if req.deadline_t is not None else float("inf")
+        return (-req.priority, dl, req.id)
+
+    def _victim_key(self, req):
+        """Preemption/shed victim order: LOWEST priority, then latest
+        deadline (None counts as latest), then youngest — the exact
+        inverse of admission, so the all-defaults case reduces to the
+        old youngest-first rule."""
+        dl = req.deadline_t if req.deadline_t is not None else float("inf")
+        return (req.priority, -dl, -req.id)
+
     def _admit(self, finished):
         cfg = self.cfg
-        ttft = _metrics.histogram("serve.ttft_s")
         while self._queue and self._free_slots:
-            req = self._queue[0]
-            need = -(-req.prompt.size // cfg.page_size)
-            if need > len(self._free_pages):
+            req = min(self._queue, key=self._admission_key)
+            total = req.prompt.size + len(req.tokens)  # recovery replays
+            first = min(cfg.prefill_len, total)        # prompt + tokens
+            if -(-first // cfg.page_size) > len(self._free_pages):
                 _metrics.counter("serve.page_stalls").inc(where="admit")
                 break                      # head-of-line waits for pages
-            self._queue.popleft()
-            slot = self._free_slots.pop()
-            req.slot = slot
-            self._trace_event(
-                req, "resumed" if req.preemptions else "admitted")
-            req.pages = [self._free_pages.popleft() for _ in range(need)]
-            row = np.zeros(self._pages_per_slot, np.int32)
-            row[:need] = req.pages
-            self._page_table[slot] = row
-            self._lengths[slot] = req.prompt.size
-            lens = np.asarray([req.prompt.size], np.int32)
-            key = jax.random.fold_in(self._base_key,
-                                     1_000_000 + req.id)
-            tok_dev, self._caches = self._prefill_jit(
-                self._params, self._caches, req.device_prompt, lens,
-                self._page_table[slot][None, :], key)
-            tok = int(np.asarray(tok_dev)[0])
-            self._trace_event(req, "prefill_done")
-            req.first_token_t = self._trace_event(req, "first_token")
-            ttft.observe(req.first_token_t - req.submit_t)
-            req.tokens.append(tok)
-            req.status = "running"
-            self._running[slot] = req
-            self._last_tokens[slot] = tok
-            self._active[slot] = True
-            _metrics.counter("serve.tokens").inc()
-            reason = self._done_reason(req, tok)
-            if reason:
-                self._release(req, finished, reason)
+            self._queue.remove(req)
+            if not self._prefill_request(req, total, finished):
+                break          # mid-admission page stall or a recovery
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+
+    def _prefill_request(self, req, total, finished):
+        """Admit one request: take a slot, then for each prefill_len
+        chunk of its replay sequence grow the page table and run the ONE
+        prefill trace; only the final chunk's sampled token is consumed.
+        Returns False when admission must back off (pages ran out
+        between chunks, or a prefill failure triggered recovery)."""
+        cfg = self.cfg
+        slot = self._free_slots.pop()
+        req.slot = slot
+        self._trace_event(
+            req, "resumed" if (req.preemptions or req.recoveries)
+            else "admitted")
+        self._page_table[slot] = 0
+        req.pages = []
+        tok = None
+        for ci in range(-(-total // cfg.prefill_len)):
+            start = ci * cfg.prefill_len
+            clen = min(cfg.prefill_len, total - start)
+            need = -(-(start + clen) // cfg.page_size)
+            while len(req.pages) < need:
+                if not self._free_pages:
+                    # pool drained between chunks: undo this admission
+                    # (pages already written are masked by length and
+                    # will be overwritten on retry) and wait
+                    _metrics.counter("serve.page_stalls").inc(
+                        where="admit")
+                    self._abort_admission(req)
+                    return False
+                page = self._free_pages.popleft()
+                self._page_table[slot, len(req.pages)] = page
+                req.pages.append(page)
+            key = jax.random.fold_in(self._base_key, 1_000_000 + req.id)
+            starts = np.asarray([start], np.int32)
+            lens = np.asarray([clen], np.int32)
+            try:
+                fault_point("serve.prefill")
+                tok_dev, self._caches = self._prefill_jit(
+                    self._params, self._caches, req.device_prompt[ci],
+                    starts, lens, self._page_table[slot][None, :], key)
+                tok = int(np.asarray(tok_dev)[0])
+            except Exception as e:
+                self._recover("serve.prefill", e, pending=req)
+                return False
+        self._lengths[slot] = total
+        self._trace_event(req, "prefill_done")
+        t = self._trace_event(req, "first_token")
+        if req.first_token_t is None:     # recovery replay keeps the 1st
+            req.first_token_t = t
+            _metrics.histogram("serve.ttft_s").observe(t - req.submit_t)
+        req.tokens.append(tok)
+        req.status = "running"
+        self._running[slot] = req
+        self._last_tokens[slot] = tok
+        self._active[slot] = True
+        _metrics.counter("serve.tokens").inc()
+        reason = self._done_reason(req, tok)
+        if reason:
+            self._release(req, finished, reason)
+        return True
+
+    def _abort_admission(self, req):
+        """Undo a half-done admission (mid-chunk page famine): free the
+        slot and pages, requeue at the front."""
+        slot = req.slot
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        self._page_table[slot] = 0
+        self._lengths[slot] = 0
+        self._active[slot] = False
+        self._running.pop(slot, None)
+        self._free_slots.append(slot)
+        req.slot = None
+        req.status = "queued"
+        self._queue.appendleft(req)
 
     def _grow_pages(self):
         """Allocate the page each slot's next token write needs where
@@ -526,13 +746,11 @@ class ServingEngine:
                 stalled.append(slot)
         return stalled
 
-    def _preempt(self, req):
-        """Recompute preemption: drop the request's device state and
-        requeue it at the FRONT of the queue (its staged prompt is still
-        device-resident, so re-admission pays only the prefill)."""
+    def _free_slot_state(self, req):
+        """Return a request's slot and pages to the free lists and zero
+        the slot's scheduler rows. Leaves req.slot set (terminal trace
+        events carry it); requeue paths null it themselves."""
         slot = req.slot
-        self._trace_event(req, "preempted",
-                          tokens_dropped=len(req.tokens))
         self._free_pages.extend(req.pages)
         req.pages = []
         self._page_table[slot] = 0
@@ -541,12 +759,174 @@ class ServingEngine:
         self._last_tokens[slot] = 0
         self._running.pop(slot, None)
         self._free_slots.append(slot)
+
+    def _preempt(self, req):
+        """Recompute preemption: drop the request's device state and
+        requeue it at the FRONT of the queue (its staged prompt is still
+        device-resident, so re-admission pays only the prefill)."""
+        self._trace_event(req, "preempted",
+                          tokens_dropped=len(req.tokens))
+        self._free_slot_state(req)
         req.slot = None
         req.tokens = []
         req.status = "queued"
         req.preemptions += 1
         self._queue.appendleft(req)
         _metrics.counter("serve.preemptions").inc()
+
+    def _recover(self, where, exc, pending=None):
+        """Crash-isolated step recovery. The decode/prefill jits donate
+        the page pools, so after ANY failure inside them the device
+        state is suspect — quarantine it: rebuild the pools, zero the
+        scheduler arrays, and re-admit every in-flight request
+        recompute-style (host-side prompt + generated tokens are the
+        durable state; a greedy request finishes token-exact). A runtime
+        Pallas decode failure additionally latches the permanent
+        per-process XLA fallback. Bounded: `serve_step_retries`
+        consecutive failures, then every request is failed and `exc`
+        re-raised."""
+        cfg = self.cfg
+        self.recoveries += 1
+        _metrics.counter("serve.recoveries").inc(where=where)
+        victims = sorted(self._running.values(), key=lambda r: r.id)
+        if pending is not None:
+            victims.append(pending)
+        if self._run_log is not None:
+            self._run_log.write({
+                "phase": "serve", "recovery": where,
+                "step": self._step_no, "in_flight": len(victims),
+                "error": f"{type(exc).__name__}: {exc}"[:200]})
+        msg = f"{type(exc).__name__}: {exc}".lower()
+        if get_flag("use_pallas_decode") and any(
+                s in msg for s in ("pallas", "mosaic", "custom_call",
+                                   "custom call")):
+            # runtime kernel failure: latch the per-process XLA fallback
+            # (flag read at trace time -> fresh jit caches required; the
+            # trace credit keeps the deliberate re-traces out of
+            # jit.retraces)
+            from paddle_tpu.ops.pallas import log_fallback
+            set_flags({"use_pallas_decode": False})
+            log_fallback("decode_attention",
+                         f"runtime decode failure ({type(exc).__name__})"
+                         " — latched permanent per-process XLA fallback")
+            self._trace_credit += 2
+            self._build_jits()
+        # quarantine: drop the (donated, possibly poisoned) pools
+        self._caches = self._model.init_paged_caches(
+            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype)
+        self._page_table[:] = 0
+        self._lengths[:] = 0
+        self._active[:] = False
+        self._last_tokens[:] = 0
+        self._free_slots = list(range(cfg.num_slots))
+        self._free_pages = collections.deque(range(cfg.num_pages))
+        self._running = {}
+        for req in reversed(victims):      # appendleft keeps id order
+            req.slot = None
+            req.pages = []
+            req.status = "queued"
+            req.recoveries += 1
+            if req.tokens or req.device_prompt is None:
+                # the staged chunks hold only the prompt — restage the
+                # full replay sequence (prompt + generated tokens, the
+                # durable host-side state)
+                req.device_prompt = self._stage_chunks(req.output)
+            self._trace_event(req, "requeued", cause=where,
+                              tokens_kept=len(req.tokens))
+            self._queue.appendleft(req)
+        _metrics.gauge("serve.active_slots").set(0)
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        try:
+            self._retry_budget.failure(exc)   # backoff sleep, or raise
+        except Exception:
+            self._fail_all(exc)
+            raise
+
+    def _fail_all(self, exc):
+        """Recovery budget spent: retire every queued + running request
+        with terminal status `failed` before the engine re-raises, so no
+        caller is left waiting on a request that can never finish."""
+        doomed = list(self._queue) + list(self._running.values())
+        self._queue.clear()
+        for req in doomed:
+            if req.slot is not None:
+                self._free_slot_state(req)
+            self._retire_terminal(req, "failed", "engine_error")
+        _metrics.gauge("serve.queue_depth").set(0)
+        _metrics.gauge("serve.active_slots").set(0)
+
+    # --- terminal statuses beyond completion -----------------------------
+
+    def _retire_terminal(self, req, status, why, finished=None,
+                         account=True):
+        """Retire a request on a non-completion terminal path (rejected |
+        shed | cancelled | failed). `account=True` counts it as an
+        SLO-failed retirement (lowering goodput — the engine failed the
+        client); cancel passes False."""
+        req.status = status
+        req.retire_reason = why
+        req.done_t = self._clock()
+        req.device_prompt = None
+        if account:
+            req.slo_ok = False
+            self._retired += 1
+            _metrics.gauge("serve.goodput").set(self.goodput())
+        self._trace_event(req, "retired", reason=status, why=why,
+                          tokens=len(req.tokens),
+                          slo_ok=bool(req.slo_ok),
+                          preemptions=req.preemptions)
+        _metrics.counter("serve.requests").inc(status=status)
+        if finished is not None:
+            finished.append(req)
+
+    def _reject(self, req, why):
+        """Terminal `rejected` at submit time — with the retriable hint:
+        the request was never started, so resubmitting (after backoff,
+        or with a feasible deadline) is the right client move."""
+        req.retriable = True
+        self._retire_terminal(req, "rejected", why)
+
+    def _shed_expired(self, finished):
+        """Drop every queued request whose deadline has passed (terminal
+        `shed`) — serving a request that can no longer meet its deadline
+        wastes pages the live ones need."""
+        if not self._queue:
+            return 0
+        now = self._clock()
+        expired = [r for r in self._queue
+                   if r.deadline_t is not None and now > r.deadline_t]
+        for req in expired:
+            self._queue.remove(req)
+            _metrics.counter("serve.shed").inc(cause="deadline")
+            self._retire_terminal(req, "shed", "deadline_expired",
+                                  finished)
+        return len(expired)
+
+    def shed_queued(self, cause="overload"):
+        """Load shedding (the watchdog's mitigation action): shed every
+        expired queued request; when none is expired, shed the single
+        lowest-priority / latest-deadline one. Returns the shed ids."""
+        shed = []
+        now = self._clock()
+        for req in [r for r in self._queue
+                    if r.deadline_t is not None and now > r.deadline_t]:
+            self._queue.remove(req)
+            shed.append((req, "deadline_expired"))
+        if not shed and self._queue:
+            victim = min(self._queue, key=self._victim_key)
+            self._queue.remove(victim)
+            shed.append((victim, cause))
+        for req, why in shed:
+            _metrics.counter("serve.shed").inc(cause=cause)
+            self._retire_terminal(req, "shed", why)
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        return [req.id for req, _ in shed]
+
+    def _on_anomaly(self, event):
+        """Watchdog mitigation hook: a goodput collapse or ingest stall
+        sheds queued load instead of only latching a counter."""
+        if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
+            self.shed_queued(cause=event["anomaly"])
 
     def _done_reason(self, req, tok):
         """Retirement reason for the token just emitted, or None."""

@@ -232,6 +232,20 @@ class DirFS:
 
 
 # -- fault points: named hooks on framework paths ------------------------
+# Registry of every fault_point() name compiled into the framework, so
+# drills and plans can't silently drift from the call sites. Linted by a
+# tier-1 test (tests/test_chaos.py) that greps paddle_tpu/ both ways:
+# every call site must be registered here, and every registered name must
+# still have a call site.
+FAULT_POINTS = {
+    "checkpoint.fetch": "restore-side remote read of a checkpoint step",
+    "checkpoint.mirror": "remote mirror push of a committed checkpoint",
+    "serve.prefill": "serving admission prefill (per chunk) device call",
+    "serve.step": "the jitted continuous-batching decode step",
+    "trainer.ingest": "ingest-channel dequeue feeding the train step",
+    "trainer.step": "the jitted train step dispatch",
+}
+
 _ACTIVE = None
 
 

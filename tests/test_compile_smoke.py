@@ -52,13 +52,14 @@ def test_serve_step_traced_once_and_paged_hlo_contract():
     paged + Pallas(interpret) decode HLO must hold no [rows, Tmax]-dense
     gathered-K/V or score temporary — the XLA gather-and-mask fallback
     (use_pallas_decode=0) is the positive control that proves the
-    detector sees dense decode attention."""
+    detector sees dense decode attention. The wave includes a
+    40-token prompt admitted through prefill_len=16 chunked prefill."""
     import tools.compile_smoke as cs
     out = cs.serve_smoke()
     assert out["decode_traces"] == 1 and out["prefill_traces"] == 1, out
     assert out["clean"], out["dense_temporaries"]
     assert out["positive_control_trips"]
-    assert out["finished"] == 6
+    assert out["finished"] == 7
 
 
 @pytest.mark.perf

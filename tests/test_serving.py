@@ -350,9 +350,10 @@ class TestAdmissionStaging:
         for L in (3, 6, 5, 4):
             eng.submit(rng.randint(0, cfg.vocab_size, (L,))
                        .astype(np.int32), max_new=4)
-        # prompts are device-committed jax arrays before any step runs
-        assert all(isinstance(r.device_prompt, jax.Array)
-                   for r in eng._queue)
+        # prompts are device-committed jax arrays (one per prefill
+        # chunk) before any step runs
+        assert all(isinstance(c, jax.Array)
+                   for r in eng._queue for c in r.device_prompt)
         monkeypatch.setattr(jax, "block_until_ready", orig_burt)
         phase["cur"] = "step"
         eng.drain()

@@ -1,4 +1,6 @@
-"""End-to-end fault drill: train -> flaky mirror (degrade) -> SIGTERM
+"""End-to-end fault drills for the recovery paths.
+
+Train drill (default): train -> flaky mirror (degrade) -> SIGTERM
 preemption (checkpoint + exit 75) -> hard crash -> resume -> verify.
 
 One ElasticRunner-supervised worker trains against a remote checkpoint
@@ -17,10 +19,22 @@ The drill verifies: exactly 1 preemption + 1 crash restart, every
 remotely-visible step carries a COMMIT marker, retention pruned to the
 keep window, and the final committed step equals the step count.
 
+Serve drill (--serve): in-process serving resilience — mixed-length
+traffic (including prompts > prefill_len, admitted via chunked
+prefill), injected `serve.prefill`/`serve.step` faults mid-stream,
+queue overload past `serve_queue_limit`, an infeasible deadline, an
+expiring deadline, and a client cancellation. Verifies that 100% of
+submitted requests reach a terminal status (done / rejected / shed /
+cancelled), that every COMPLETED greedy request is token-exact vs a
+per-request generate() reference despite the recoveries, and that each
+injected fault produced exactly one engine recovery.
+
 Usage:
     python tools/chaos_drill.py [--steps 8] [--workdir DIR]
+    python tools/chaos_drill.py --serve
 
-Also exercised as a slow-marked test (tests/test_chaos.py).
+Also exercised as tests (tests/test_chaos.py slow-marked train drill;
+tests/test_serve_resilience.py serve drill).
 """
 
 import argparse
@@ -124,13 +138,126 @@ def run_drill(workdir, steps=8, timeout=600):
     return summary
 
 
+def run_serve_drill(seed=0):
+    """In-process serving resilience drill; returns a summary dict
+    (raises on any verification failure). Deterministic: greedy
+    decoding + a seeded FaultPlan, so completed outputs are checked
+    token-exact against per-request generate() references."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core import flags as F
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.serving import ServeConfig, ServingEngine
+    from paddle_tpu.testing import chaos
+
+    saved = F.all_flags()
+    try:
+        F.set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        cfg.use_flash = False
+        model = GPTDecoder(cfg)
+        variables = model.init(jax.random.key(0))
+        engine = ServingEngine(model, variables, ServeConfig(
+            num_slots=2, page_size=8, max_len=64, prefill_len=16,
+            queue_limit=6, step_retries=4))
+        rng = np.random.RandomState(seed)
+
+        # traffic: short prompts and two chunked ones (30, 45 > 16)
+        specs = [(5, 6), (30, 8), (9, 5), (45, 10), (3, 7)]
+        prompts = [rng.randint(0, cfg.vocab_size, (L,), dtype=np.int32)
+                   for L, _ in specs]
+        accepted = [engine.submit(p, max_new=mn)
+                    for p, (_, mn) in zip(prompts, specs)]
+        # 6th queued request carries a deadline that expires before the
+        # first step runs -> shed
+        expiring = engine.submit(
+            rng.randint(0, cfg.vocab_size, (8,), dtype=np.int32),
+            max_new=4, deadline_s=0.004)
+        # queue is now at serve_queue_limit=6: overload is rejected
+        overload = [engine.submit(
+            rng.randint(0, cfg.vocab_size, (4,), dtype=np.int32),
+            max_new=4) for _ in range(3)]
+        infeasible = engine.submit(
+            rng.randint(0, cfg.vocab_size, (4,), dtype=np.int32),
+            max_new=4, deadline_s=0.0)
+        cancelled = accepted.pop()          # cancel the last queued one
+        assert engine.cancel(cancelled)
+        _time.sleep(0.02)                   # let the 0.004s deadline pass
+
+        # three injected faults: two mid-stream decode steps, one
+        # admission prefill (lands mid-chunk of a long prompt)
+        plan = chaos.FaultPlan(seed=seed)
+        plan.fail("fault_point", path=r"^serve\.step$", nth=3, times=1)
+        plan.fail("fault_point", path=r"^serve\.step$", nth=8, times=1)
+        plan.fail("fault_point", path=r"^serve\.prefill$", nth=4,
+                  times=1)
+        with chaos.active(plan):
+            engine.drain()
+
+        # -- verify ------------------------------------------------------
+        statuses = {rid: r.status for rid, r in engine.requests.items()}
+        terminal = {"done", "rejected", "shed", "cancelled", "failed"}
+        stuck = {rid: s for rid, s in statuses.items()
+                 if s not in terminal}
+        assert not stuck, f"non-terminal requests after drain: {stuck}"
+        assert all(statuses[rid] == "done" for rid in accepted), statuses
+        assert all(statuses[rid] == "rejected" for rid in overload)
+        assert all(engine.requests[rid].retriable for rid in overload)
+        assert statuses[infeasible] == "rejected"
+        assert statuses[expiring] == "shed"
+        assert statuses[cancelled] == "cancelled"
+        faults = plan.fired("fault_point")
+        assert faults == 3, f"expected 3 injected faults, got {faults}"
+        assert engine.recoveries == faults, (engine.recoveries, faults)
+        recovered = [r for r in engine.requests.values()
+                     if r.recoveries and r.status == "done"]
+        assert recovered, "no recovered request finished"
+        for rid, (p, (_, mn)) in zip(list(range(len(specs))),
+                                     zip(prompts, specs)):
+            if rid not in accepted:
+                continue
+            ref = model.apply(variables, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, mn))
+            got = engine.requests[rid].output
+            assert np.array_equal(got, np.asarray(ref)[0]), (
+                f"request {rid} not token-exact after recovery")
+        assert engine.decode_traces == 1 and engine.prefill_traces == 1
+        engine.close()
+        return dict(
+            submitted=len(statuses),
+            statuses={s: sum(1 for v in statuses.values() if v == s)
+                      for s in sorted(set(statuses.values()))},
+            injected_faults=faults, recoveries=engine.recoveries,
+            recovered_done=[r.id for r in recovered],
+            chunked_prompts=[rid for rid in accepted
+                             if engine.requests[rid].prompt.size > 16],
+            token_exact=len(accepted))
+    finally:
+        F.set_flags(saved)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--workdir", default=None,
                     help="scratch dir (default: fresh temp dir, removed "
                          "on success)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving resilience drill instead of "
+                         "the train drill")
     args = ap.parse_args()
+    if args.serve:
+        summary = run_serve_drill()
+        print("\n=== serve chaos drill PASSED ===")
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+        return
     workdir = args.workdir or tempfile.mkdtemp(prefix="pt_chaos_drill_")
     summary = run_drill(workdir, steps=args.steps)
     print("\n=== chaos drill PASSED ===")

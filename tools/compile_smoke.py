@@ -224,10 +224,13 @@ def serve_smoke(positive_control=True):
         set_flags({"pallas_interpret": True, "use_pallas_decode": True})
         _, _, engine = _serve_engine()
         rng = np.random.RandomState(0)
-        # three admission waves of ragged prompts through 2 slots: every
-        # admission lands in a freed slot mid-run
-        for plen, mn in [(3, 7), (9, 5), (16, 6), (5, 9), (12, 4),
-                         (2, 8)]:
+        # admission waves of ragged prompts through 2 slots: every
+        # admission lands in a freed slot mid-run. The 40-token prompt
+        # exceeds prefill_len=16 — chunked prefill admits it as three
+        # calls of the SAME prefill trace (the traced-once assertion
+        # below covers it)
+        for plen, mn in [(3, 7), (9, 5), (16, 6), (40, 6), (5, 9),
+                         (12, 4), (2, 8)]:
             engine.submit(rng.randint(0, 512, (plen,), dtype=np.int32),
                           max_new=mn)
         done = engine.drain()

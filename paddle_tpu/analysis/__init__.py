@@ -1,0 +1,24 @@
+"""graft-lint — the repo's static-analysis suite.
+
+Two layers (ROADMAP: every perf/robustness claim checked statically,
+once, for the whole tree):
+
+* ``lint`` + ``rules/``: visitor-based AST rules over the source tree —
+  hot-path sync hazards, tracer leaks, flag/metric/fault-point drift,
+  committed log artifacts — with per-line
+  ``# graft-lint: disable=<rule> (<reason>)`` suppressions.
+* ``contracts``: declarative compile-contract objects (NoTemporary,
+  NoOpMatching, TracedOnce, DonationRespected, NoHostCallback,
+  MaxDtypeWidth) evaluated against compiled HLO / jaxpr text, with the
+  per-model contract table ``CONTRACTS`` that tools/compile_smoke.py
+  enforces in tier-1.
+
+Everything here is stdlib-only so ``tools/graft_lint.py`` can run the
+rule layer without paying the jax import (the contract layer's
+*evaluation* compiles models and lives behind compile_smoke).
+"""
+
+from paddle_tpu.analysis import contracts, lint  # noqa: F401
+from paddle_tpu.analysis.lint import (  # noqa: F401
+    Finding, LintContext, Rule, make_rules, register, rule_names,
+    run_lint)

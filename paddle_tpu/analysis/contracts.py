@@ -1,0 +1,348 @@
+"""Declarative compile-contract engine.
+
+tools/compile_smoke.py used to hold one ad-hoc regex per model for its
+HLO assertions (``vocab_temporaries`` / ``weight_all_gathers`` /
+``dense_score_temporaries``). This module promotes those checks to
+first-class contract objects evaluated against a
+:class:`ContractContext` (compiled HLO text, jaxpr text, runtime trace
+counts), plus the single per-model table :data:`CONTRACTS` covering the
+fused+sharded train steps (gpt / bert / transformer_big) and the
+serving prefill/decode steps. compile_smoke stays the thing that
+*compiles*; this module is the thing that *judges* — and the planted-
+violation fixtures in tests/test_lint.py prove each judge actually
+fires.
+
+Stdlib-only: contracts see text, never jax objects, so the table is
+importable by the lint CLI without paying the jax import.
+"""
+
+import dataclasses
+import math
+import re
+
+# every HLO dtype token we may meet in shapes, with its bit width
+DTYPE_BITS = {
+    "pred": 1, "s2": 2, "s4": 4, "s8": 8, "s16": 16, "s32": 32,
+    "s64": 64, "u2": 2, "u4": 4, "u8": 8, "u16": 16, "u32": 32,
+    "u64": 64, "f8e4m3fn": 8, "f8e5m2": 8, "f8e4m3b11fnuz": 8,
+    "f8e4m3fnuz": 8, "f8e5m2fnuz": 8, "f16": 16, "bf16": 16, "f32": 32,
+    "f64": 64, "c64": 64, "c128": 128,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(sorted(DTYPE_BITS, key=len, reverse=True))
+    + r")\[([0-9,]*)\]")
+
+
+def hlo_shapes(text, dtypes=("f32", "bf16")):
+    """All (dtype, shape-tuple) pairs in an HLO module's text, filtered
+    to ``dtypes`` (None = all)."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        if dtypes is not None and m.group(1) not in dtypes:
+            continue
+        dims = m.group(2)
+        shp = tuple(int(d) for d in dims.split(",")) if dims else ()
+        out.append((m.group(1), shp))
+    return out
+
+
+@dataclasses.dataclass
+class Violation:
+    contract: str
+    message: str
+
+    def format(self):
+        return f"[{self.contract}] {self.message}"
+
+
+@dataclasses.dataclass
+class ContractContext:
+    """What a compile produced, as text: per-device compiled HLO
+    (``.compile().as_text()``), lowered/jaxpr text when the caller has
+    it, and runtime trace counts for the TracedOnce contract."""
+    hlo_text: str = None
+    jaxpr_text: str = None
+    trace_counts: dict = None
+
+
+class Contract:
+    """One statically-checkable compile invariant. ``check`` returns
+    violation messages (empty = the contract holds)."""
+
+    name = None
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+    def violations(self, ctx):
+        return [Violation(self.name, m) for m in self.check(ctx)]
+
+
+class NoTemporary(Contract):
+    """No f32/bf16 temporary carrying any dim in ``dims`` next to >=
+    ``min_rows`` row elements — i.e. no materialized [rows, dim]-scale
+    tensor in the per-device module. ``min_rows`` is chosen ABOVE the
+    model width so a [dim, hidden] weight shard (a legitimate resident
+    on that axis) never trips it."""
+
+    def __init__(self, dims, min_rows, dtypes=("f32", "bf16"),
+                 what="temporary"):
+        self.dims = frozenset(int(d) for d in dims)
+        self.min_rows = int(min_rows)
+        self.dtypes = tuple(dtypes)
+        self.what = what
+        self.name = f"no-temporary({sorted(self.dims)}, rows>={min_rows})"
+
+    def temporaries(self, hlo_text):
+        """The offending shapes, sorted — compile_smoke reports these."""
+        hits = set()
+        for _, shp in hlo_shapes(hlo_text, self.dtypes):
+            for d in shp:
+                if d in self.dims and d and math.prod(shp) // d >= self.min_rows:
+                    hits.add(shp)
+        return sorted(hits)
+
+    def check(self, ctx):
+        if ctx.hlo_text is None:
+            return []
+        return [f"{self.what} {shp} materialized in the compiled module"
+                for shp in self.temporaries(ctx.hlo_text)]
+
+
+class NoOpMatching(Contract):
+    """No HLO instruction line matching ``pattern`` — optionally only
+    lines where some bracketed shape satisfies ``shape_test`` (e.g.
+    all-gathers at vocab-weight scale, not the benign small ones)."""
+
+    _BRACKET_RE = re.compile(r"\[([0-9,]+)\]")
+
+    def __init__(self, pattern, shape_test=None, what=None):
+        self.pattern = re.compile(pattern)
+        self.shape_test = shape_test
+        self.what = what or f"op matching /{pattern}/"
+        self.name = f"no-op-matching({pattern})"
+
+    def matches(self, hlo_text):
+        hits = []
+        for line in hlo_text.splitlines():
+            if not self.pattern.search(line):
+                continue
+            if self.shape_test is not None:
+                ok = False
+                for m in self._BRACKET_RE.finditer(line):
+                    shp = tuple(int(d) for d in m.group(1).split(","))
+                    if self.shape_test(shp):
+                        ok = True
+                        break
+                if not ok:
+                    continue
+            hits.append(line.strip()[:160])
+        return hits
+
+    def check(self, ctx):
+        if ctx.hlo_text is None:
+            return []
+        return [f"{self.what}: {line}" for line in self.matches(ctx.hlo_text)]
+
+
+class TracedOnce(Contract):
+    """Every tracked function was traced exactly once across the run —
+    the continuous-batching shapes are slot-fixed; a retrace means a
+    shape or dtype leaked into the traced signature."""
+
+    name = "traced-once"
+
+    def __init__(self, fns=None):
+        self.fns = tuple(fns) if fns is not None else None
+
+    def check(self, ctx):
+        counts = ctx.trace_counts or {}
+        out = []
+        names = self.fns if self.fns is not None else sorted(counts)
+        for fn in names:
+            n = counts.get(fn)
+            if n is None:
+                out.append(f"{fn}: no trace count recorded")
+            elif n != 1:
+                out.append(f"{fn}: traced {n}x (expected exactly once)")
+        return out
+
+
+class DonationRespected(Contract):
+    """The compiled module aliases >= ``min_aliases`` inputs to outputs
+    (``input_output_alias={ {0}: (1, {}, may-alias) ... }`` in the
+    module header) — donated buffers (KV pools, optimizer state) really
+    were reused rather than silently copied."""
+
+    _ENTRY_RE = re.compile(r"\{[0-9,\s]*\}:\s*\(")
+
+    def __init__(self, min_aliases=1):
+        self.min_aliases = int(min_aliases)
+        self.name = f"donation-respected(>={min_aliases})"
+
+    def check(self, ctx):
+        if ctx.hlo_text is None:
+            return []
+        m = re.search(r"input_output_alias=\{(.*)", ctx.hlo_text)
+        n = len(self._ENTRY_RE.findall(m.group(1))) if m else 0
+        if n < self.min_aliases:
+            return [f"only {n} input->output aliases in the compiled "
+                    f"module (expected >= {self.min_aliases}) — a "
+                    "donated buffer is being copied"]
+        return []
+
+
+class NoHostCallback(Contract):
+    """No host round-trip inside the compiled step: no infeed/outfeed
+    and no callback custom-call in the HLO; no pure_callback /
+    io_callback / debug_callback primitive in the jaxpr (a stray
+    jax.debug.print in a hot kernel shows up here)."""
+
+    name = "no-host-callback"
+
+    _HLO_PATTERNS = (re.compile(r"\binfeed\b"), re.compile(r"\boutfeed\b"),
+                     re.compile(r"custom-call[^\n]*callback"))
+    _JAXPR_RE = re.compile(
+        r"\b(pure_callback|io_callback|debug_callback)\b")
+
+    def check(self, ctx):
+        out = []
+        if ctx.hlo_text is not None:
+            for pat in self._HLO_PATTERNS:
+                for line in ctx.hlo_text.splitlines():
+                    if pat.search(line):
+                        out.append(f"host callback in HLO: "
+                                   f"{line.strip()[:160]}")
+        if ctx.jaxpr_text is not None:
+            for m in self._JAXPR_RE.finditer(ctx.jaxpr_text):
+                out.append(f"{m.group(1)} primitive in the jaxpr — host "
+                           "round-trip inside the staged step")
+        return out
+
+
+class MaxDtypeWidth(Contract):
+    """No float/complex tensor wider than ``max_bits`` in the compiled
+    module (f64 creeping into a TPU step means an accidental float64
+    promotion — x64 math runs at a fraction of MXU rate). Integer types
+    are allowlisted by default: RNG and iota legitimately use u64/s64
+    counters."""
+
+    def __init__(self, max_bits=32, allow=("s64", "u64", "c64")):
+        self.max_bits = int(max_bits)
+        self.allow = frozenset(allow)
+        self.name = f"max-dtype-width({max_bits})"
+
+    def offending(self, text):
+        seen = {}
+        for dt, shp in hlo_shapes(text, dtypes=None):
+            if dt in self.allow or DTYPE_BITS[dt] <= self.max_bits:
+                continue
+            seen.setdefault(dt, shp)
+        return seen
+
+    def check(self, ctx):
+        out = []
+        for text in (ctx.hlo_text, ctx.jaxpr_text):
+            if text is None:
+                continue
+            for dt, shp in sorted(self.offending(text).items()):
+                out.append(f"{dt} tensor (e.g. {dt}{list(shp)}) exceeds "
+                           f"{self.max_bits}-bit width — accidental "
+                           "wide-precision promotion")
+        return out
+
+
+def evaluate(contracts, ctx):
+    """Run each contract; return the flat violation list (empty = every
+    contract holds)."""
+    out = []
+    for c in contracts:
+        out.extend(c.violations(ctx))
+    return out
+
+
+# --- the per-model contract table ------------------------------------
+#
+# Sharded train steps: tiny configs with batch/seq picked so no
+# legitimate dim collides with {V, V/tp} and the row threshold clears
+# the model width with >= 2x margin (xent_chunk=64 keeps even the fused
+# path's per-chunk logits tile far below it). The serve step keys on
+# the padded slot capacity Tmax=48, every other dim distinct.
+
+@dataclasses.dataclass(frozen=True)
+class ShardedCase:
+    """Compile shapes for one model's dp x tp contract run."""
+    batch: int
+    seq: int
+    vocab: int
+    hidden: int
+    loss_rows: staticmethod   # (batch, seq) -> rows entering the loss
+
+    def min_rows(self, dp=2):
+        return self.loss_rows(self.batch, self.seq) // dp // 2
+
+
+SHARDED_TRAIN_CASES = {
+    "gpt": ShardedCase(16, 128, 512, 64, lambda b, s: b * s),
+    # BERT's MLM head only scores the 15% masked positions
+    "bert": ShardedCase(32, 128, 1024, 64,
+                        lambda b, s: b * max(1, int(0.15 * s))),
+    # NMT transformer: every target position enters the loss
+    "transformer_big": ShardedCase(16, 128, 1000, 64, lambda b, s: b * s),
+}
+
+
+def sharded_train_contracts(model, dp=2, tp=2):
+    """The fused+sharded train-step contract for one model: no
+    [rows, vocab]-scale temporary, no vocab-weight all-gather, no f64,
+    no host callback."""
+    c = SHARDED_TRAIN_CASES[model]
+    vocab, hidden = c.vocab, c.hidden
+    return [
+        NoTemporary({vocab, vocab // tp}, c.min_rows(dp),
+                    what="[rows, vocab]-scale logits temporary"),
+        NoOpMatching(
+            "all-gather",
+            shape_test=lambda shp: (vocab in shp
+                                    and math.prod(shp) >= vocab * hidden),
+            what="vocab-weight-scale all-gather"),
+        MaxDtypeWidth(32),
+        NoHostCallback(),
+    ]
+
+
+SERVE_TMAX = 48
+SERVE_MIN_ROWS = 8
+
+
+def serve_decode_contracts(tmax=SERVE_TMAX, min_rows=SERVE_MIN_ROWS):
+    """The paged decode-step contract: no [rows, Tmax]-dense gathered
+    K/V or score temporary, the one trace, donated pools really
+    aliased, no host callback, no f64."""
+    return [
+        NoTemporary({tmax}, min_rows,
+                    what="[rows, Tmax]-dense attention temporary"),
+        TracedOnce(("serve.decode",)),
+        DonationRespected(min_aliases=1),
+        NoHostCallback(),
+        MaxDtypeWidth(32),
+    ]
+
+
+def serve_prefill_contracts():
+    return [TracedOnce(("serve.prefill",))]
+
+
+# name -> contract list; tools/compile_smoke.py compiles each target and
+# evaluates its row (tools/graft_lint.py --contracts is the CLI front
+# door). tests/test_lint.py proves every contract class fires on a
+# planted violation.
+CONTRACTS = {
+    "train.gpt@dp2,tp2": sharded_train_contracts("gpt"),
+    "train.bert@dp2,tp2": sharded_train_contracts("bert"),
+    "train.transformer_big@dp2,tp2":
+        sharded_train_contracts("transformer_big"),
+    "serve.decode": serve_decode_contracts(),
+    "serve.prefill": serve_prefill_contracts(),
+}

@@ -1,0 +1,266 @@
+"""graft-lint AST layer: rule framework, registry, suppressions.
+
+A rule is a class with a ``name``, a ``help`` line, and a
+``check(ctx) -> Iterable[Finding]`` method; ``@register`` puts it in the
+process-wide registry and ``make_rules()`` instantiates the default set
+(importing ``paddle_tpu.analysis.rules`` for its registration side
+effects). Rules are *tree*-scoped: they receive one :class:`LintContext`
+holding lazily-parsed :class:`SourceFile` objects for every ``*.py``
+under the root, so cross-file rules (call-graph reachability, drift
+between a registry and its call sites) are first-class rather than
+bolted on.
+
+Suppressions are per line::
+
+    toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the scheduler needs this step's tokens)
+
+The parenthesized reason is mandatory — a disable comment without one
+does not suppress and is itself reported as ``bad-suppression``, so
+every silenced finding carries its justification in the diff that
+silenced it. Several rules may be named, comma-separated.
+
+Stdlib-only: the CLI (tools/graft_lint.py) runs this layer without
+importing jax.
+"""
+
+import ast
+import dataclasses
+import fnmatch
+import os
+import re
+
+# rule names are kebab-case; the reason group is everything inside the
+# trailing parens (may mention rules/files — kept free-form)
+SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*disable=([A-Za-z0-9_,-]+)"
+    r"(?:\s*\(([^()]*(?:\([^()]*\)[^()]*)*)\))?")
+
+# paths never scanned: planted-violation fixtures ARE violations
+DEFAULT_EXCLUDES = ("tests/fixtures", "__pycache__", ".git",
+                    ".pytest_cache", "csrc/build")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint hit, anchored to a repo-relative path and 1-based line."""
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """Lazily-read, lazily-parsed source file. ``tree`` is None when the
+    file does not parse — the syntax error surfaces as its own finding
+    via :meth:`LintContext.parse_errors`, and AST rules simply skip the
+    file instead of each crashing on it."""
+
+    def __init__(self, root, relpath):
+        self.root = root
+        self.relpath = relpath.replace(os.sep, "/")
+        self.path = os.path.join(root, relpath)
+        self._text = None
+        self._lines = None
+        self._tree = None
+        self._parsed = False
+        self.syntax_error = None
+
+    @property
+    def text(self):
+        if self._text is None:
+            with open(self.path, encoding="utf-8") as fh:
+                self._text = fh.read()
+        return self._text
+
+    @property
+    def lines(self):
+        if self._lines is None:
+            self._lines = self.text.splitlines()
+        return self._lines
+
+    @property
+    def tree(self):
+        if not self._parsed:
+            self._parsed = True
+            try:
+                self._tree = ast.parse(self.text, filename=self.relpath)
+            except SyntaxError as e:
+                self.syntax_error = e
+        return self._tree
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class LintContext:
+    """The tree a lint run sees: every ``*.py`` under ``root`` (plus any
+    non-Python files a rule asks for via :meth:`file`), minus
+    ``excludes`` path fragments."""
+
+    def __init__(self, root, excludes=DEFAULT_EXCLUDES):
+        self.root = os.path.abspath(root)
+        self.excludes = tuple(excludes)
+        self._by_rel = {}
+        self.files = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            rel_dir = os.path.relpath(dirpath, self.root)
+            rel_dir = "" if rel_dir == "." else rel_dir.replace(os.sep, "/")
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not self._excluded(f"{rel_dir}/{d}" if rel_dir else d))
+            for f in sorted(filenames):
+                if not f.endswith(".py"):
+                    continue
+                rel = f"{rel_dir}/{f}" if rel_dir else f
+                if self._excluded(rel):
+                    continue
+                sf = SourceFile(self.root, rel)
+                self.files.append(sf)
+                self._by_rel[rel] = sf
+
+    def _excluded(self, rel):
+        return any(part in rel for part in self.excludes)
+
+    def file(self, relpath):
+        """The SourceFile at ``relpath`` (repo-relative, '/'-separated);
+        files outside the initial walk (README.md, a *.py under an
+        excluded dir a rule explicitly wants) are admitted on demand."""
+        rel = relpath.replace(os.sep, "/")
+        sf = self._by_rel.get(rel)
+        if sf is None and os.path.isfile(os.path.join(self.root, rel)):
+            sf = SourceFile(self.root, rel)
+            self._by_rel[rel] = sf
+        return sf
+
+    def glob(self, *patterns):
+        """Scanned python files whose relpath fnmatches any pattern."""
+        return [sf for sf in self.files
+                if any(fnmatch.fnmatch(sf.relpath, p) for p in patterns)]
+
+    def parse_errors(self):
+        for sf in self.files:
+            if sf.tree is None and sf.syntax_error is not None:
+                yield Finding(
+                    "parse-error", sf.relpath,
+                    sf.syntax_error.lineno or 1,
+                    f"file does not parse: {sf.syntax_error.msg}")
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``help`` and implement
+    ``check``. Constructor kwargs configure paths/roots so the same rule
+    instance can run against a planted-violation fixture tree."""
+
+    name = None
+    help = ""
+
+    def check(self, ctx):
+        raise NotImplementedError
+
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the default set."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def _load_default_rules():
+    # import for registration side effects; lazy so `import
+    # paddle_tpu.analysis.lint` alone stays dependency-free
+    from paddle_tpu.analysis import rules  # noqa: F401
+
+
+def rule_names():
+    _load_default_rules()
+    return sorted(_REGISTRY)
+
+
+def rule_help():
+    _load_default_rules()
+    return {n: _REGISTRY[n].help for n in sorted(_REGISTRY)}
+
+
+def make_rules(names=None):
+    """Instantiate the registered rules (all, or the named subset)."""
+    _load_default_rules()
+    if names is None:
+        names = sorted(_REGISTRY)
+    unknown = [n for n in names if n not in _REGISTRY]
+    if unknown:
+        raise KeyError(f"unknown rules {unknown}; known: {sorted(_REGISTRY)}")
+    return [_REGISTRY[n]() for n in names]
+
+
+def parse_suppressions(line_text):
+    """(rules, reason) for the first graft-lint disable comment on the
+    line, or None. ``reason`` is '' when the mandatory parenthesized
+    justification is missing."""
+    m = SUPPRESS_RE.search(line_text)
+    if not m:
+        return None
+    rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+    reason = (m.group(2) or "").strip()
+    return rules, reason
+
+
+def _suppression_findings(ctx):
+    """bad-suppression findings: disable comments missing their reason,
+    or naming a rule the registry has never heard of."""
+    _load_default_rules()
+    known = set(_REGISTRY) | {"parse-error", "bad-suppression"}
+    for sf in ctx.files:
+        for i, line in enumerate(sf.lines, 1):
+            sup = parse_suppressions(line)
+            if sup is None:
+                continue
+            rules, reason = sup
+            if not reason:
+                yield Finding(
+                    "bad-suppression", sf.relpath, i,
+                    "suppression without a reason — write "
+                    "`# graft-lint: disable=<rule> (<why>)`")
+            for r in rules:
+                if r not in known:
+                    yield Finding(
+                        "bad-suppression", sf.relpath, i,
+                        f"suppression names unknown rule {r!r} "
+                        f"(known: {', '.join(sorted(_REGISTRY))})")
+
+
+def run_lint(ctx, rules=None, paths=None):
+    """Run ``rules`` (default: the full registry) over ``ctx``; apply
+    per-line suppressions; return findings sorted by location. ``paths``
+    (a set of repo-relative paths) post-filters findings for
+    --changed-only runs — tree-wide drift rules still SEE the whole
+    tree, only the reporting narrows."""
+    if rules is None:
+        rules = make_rules()
+    findings = list(ctx.parse_errors())
+    findings.extend(_suppression_findings(ctx))
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    kept = []
+    for f in findings:
+        sf = ctx.file(f.path)
+        if sf is not None and f.rule != "bad-suppression":
+            sup = parse_suppressions(sf.line_text(f.line))
+            if sup is not None and f.rule in sup[0] and sup[1]:
+                continue
+        if paths is not None and f.path not in paths:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept

@@ -1,0 +1,9 @@
+"""graft-lint rule set — importing this package registers every rule.
+
+Each module holds one rule (plus its helpers); keep them independent so
+a fixture test can instantiate a single rule against a planted tree.
+"""
+
+from paddle_tpu.analysis.rules import (  # noqa: F401
+    catalog_drift, fault_point_drift, flag_drift, hot_path_sync,
+    no_committed_logs, tracer_leak)

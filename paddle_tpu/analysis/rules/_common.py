@@ -1,0 +1,58 @@
+"""Shared AST helpers for the rule modules (stdlib-only)."""
+
+import ast
+
+
+def dotted_name(node):
+    """'jax.lax.scan' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call):
+    """Dotted name of a Call's func, else None."""
+    return dotted_name(call.func)
+
+
+def str_arg(call, index=0):
+    """The literal str at positional ``index`` of a Call, else None."""
+    if len(call.args) > index:
+        a = call.args[index]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def walk_calls(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def assign_name_targets(node):
+    """Plain Name targets of an Assign/AnnAssign/For/withitem binding,
+    flattening tuple/list unpacks. Attribute/Subscript targets are
+    dropped (we only track local-name dataflow)."""
+    out = []
+
+    def _collect(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _collect(e)
+        elif isinstance(t, ast.Starred):
+            _collect(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            _collect(t)
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        _collect(node.target)
+    return out

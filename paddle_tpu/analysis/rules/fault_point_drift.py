@@ -1,0 +1,93 @@
+"""fault-point-drift: chaos.FAULT_POINTS and the literal
+``fault_point("...")`` call sites may never drift apart, either way.
+
+The AST port of tests/test_chaos.py's TestFaultPointRegistry greps: a
+chaos plan targeting a renamed hook would silently inject nothing
+(unregistered call site), and a registry entry with no call site is a
+drill that tests nothing. The registry is parsed statically from the
+FAULT_POINTS dict literal.
+"""
+
+import ast
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules._common import (call_name, str_arg,
+                                               walk_calls)
+
+
+def parse_fault_points(sf):
+    """{name: lineno} from a chaos module's FAULT_POINTS literal."""
+    if sf is None or sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+@register
+class FaultPointDrift(Rule):
+    name = "fault-point-drift"
+    help = ("literal fault_point(\"...\") call sites and "
+            "chaos.FAULT_POINTS must match in both directions")
+
+    DEFAULT_CHAOS_PATH = "paddle_tpu/testing/chaos.py"
+    DEFAULT_SCOPE = ("paddle_tpu/**/*.py", "paddle_tpu/*.py")
+    MIN_SITES = 6   # the wiring exists; below this the detection rotted
+
+    def __init__(self, chaos_path=None, scope=None, min_sites=None):
+        self.chaos_path = chaos_path or self.DEFAULT_CHAOS_PATH
+        self.scope = tuple(scope or self.DEFAULT_SCOPE)
+        self.min_sites = (self.MIN_SITES if min_sites is None
+                          else min_sites)
+
+    def sites(self, ctx):
+        """{fault point name: [(relpath, lineno), ...]}."""
+        out = {}
+        for sf in ctx.glob(*self.scope):
+            if sf.tree is None or sf.relpath == self.chaos_path:
+                continue
+            for call in walk_calls(sf.tree):
+                cn = call_name(call)
+                if cn is None or cn.split(".")[-1] != "fault_point":
+                    continue
+                name = str_arg(call)
+                if name is not None:
+                    out.setdefault(name, []).append(
+                        (sf.relpath, call.lineno))
+        return out
+
+    def check(self, ctx):
+        registered = parse_fault_points(ctx.file(self.chaos_path))
+        if registered is None:
+            yield Finding(self.name, self.chaos_path, 1,
+                          "FAULT_POINTS dict literal not found — the "
+                          "rule's anchor rotted")
+            return
+        sites = self.sites(ctx)
+        n_sites = sum(len(v) for v in sites.values())
+        if n_sites < self.min_sites:
+            yield Finding(
+                self.name, self.chaos_path, 1,
+                f"only {n_sites} fault_point call sites detected "
+                f"(expected >= {self.min_sites}) — the site detection "
+                "rotted")
+        for name, locs in sorted(sites.items()):
+            if name not in registered:
+                rel, lineno = locs[0]
+                yield Finding(
+                    self.name, rel, lineno,
+                    f"fault_point({name!r}) is not registered in "
+                    "chaos.FAULT_POINTS — a chaos plan targeting it "
+                    "would silently inject nothing")
+        for name, lineno in sorted(registered.items()):
+            if name not in sites:
+                yield Finding(
+                    self.name, self.chaos_path, lineno,
+                    f"chaos.FAULT_POINTS entry {name!r} has no "
+                    "fault_point call site — the drill tests nothing")

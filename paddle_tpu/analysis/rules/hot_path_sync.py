@@ -1,0 +1,281 @@
+"""hot-path-sync: no device synchronization reachable from the serving
+or training hot loops.
+
+The "no sync on the hot path" invariant was previously enforced only
+dynamically, by flush-spy tests covering two call sites. This rule makes
+it static and whole-tree: build the call graph over the hot-path module
+set, walk everything reachable from ``ServingEngine.submit/step/drain``
+and ``Trainer.train``, and flag the synchronizing primitives —
+``.block_until_ready()``, ``jax.device_get(...)``, ``.item()``, and
+``np.asarray``/``np.array`` applied to a *device* value (a result of a
+``jax.jit``-built callable, tracked by a light per-function taint pass;
+``np.asarray`` over host lists/prompts is staging, not syncing, and is
+deliberately not flagged).
+
+Deliberate syncs (the scheduler consuming this step's sampled tokens,
+telemetry's trailing loss fetch) stay in the tree under
+``# graft-lint: disable=hot-path-sync (<why>)`` — the rule's job is to
+make every *new* sync a reviewed decision, not to pretend zero exist.
+
+Call resolution, in order: ``self.m()`` to the same class; bare ``f()``
+to the module (or a ``from paddle_tpu.x import f`` target inside the
+module set); ``obj.m()`` to ``Cls.m`` when exactly one analyzed class
+defines ``m`` (ambiguous names are skipped, never guessed). Nested defs
+are analyzed as part of their enclosing function.
+"""
+
+import ast
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules._common import (assign_name_targets,
+                                               call_name)
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_NP_ROOTS = {"np", "numpy"}
+
+
+def _is_jit_call(call):
+    name = call_name(call)
+    if name in _JIT_NAMES:
+        return True
+    if name in _PARTIAL_NAMES and call.args:
+        inner = call.args[0]
+        return (isinstance(inner, (ast.Attribute, ast.Name))
+                and (ast.unparse(inner) if hasattr(ast, "unparse")
+                     else "") in _JIT_NAMES)
+    return False
+
+
+class _Module:
+    """Function/class/import index of one analyzed source file."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.relpath = sf.relpath
+        self.functions = {}     # qualname -> FunctionDef
+        self.classes = {}       # class name -> {method name: qualname}
+        self.jitted_attrs = {}  # class name -> {self attrs bound to jit}
+        self.imports = {}       # local name -> (module relpath, name)
+        tree = sf.tree
+        if tree is None:
+            return
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qn = f"{node.name}.{item.name}"
+                        self.functions[qn] = item
+                        methods[item.name] = qn
+                self.classes[node.name] = methods
+                self.jitted_attrs[node.name] = self._find_jitted_attrs(node)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                rel = node.module.replace(".", "/") + ".py"
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        rel, alias.name)
+        # function-local from-imports (the repo defers heavy imports)
+        for fn in list(self.functions.values()):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    rel = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        self.imports.setdefault(
+                            alias.asname or alias.name, (rel, alias.name))
+
+    @staticmethod
+    def _find_jitted_attrs(class_node):
+        """self attributes assigned a jax.jit/pjit result anywhere in
+        the class — calls through them produce device values."""
+        attrs = set()
+        for node in ast.walk(class_node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _is_jit_call(node.value)):
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attrs.add(t.attr)
+        return attrs
+
+
+@register
+class HotPathSync(Rule):
+    name = "hot-path-sync"
+    help = ("block_until_ready / jax.device_get / .item() / np.asarray-"
+            "on-device reachable from ServingEngine.submit/step or the "
+            "Trainer step loop")
+
+    DEFAULT_MODULES = (
+        "paddle_tpu/serving/engine.py",
+        "paddle_tpu/static/trainer.py",
+        "paddle_tpu/observability/telemetry.py",
+        "paddle_tpu/observability/watchdog.py",
+        "paddle_tpu/data/loader.py",
+    )
+    DEFAULT_ROOTS = (
+        ("paddle_tpu/serving/engine.py", "ServingEngine.submit"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.step"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.drain"),
+        ("paddle_tpu/static/trainer.py", "Trainer.train"),
+    )
+
+    def __init__(self, modules=None, roots=None):
+        self.module_paths = tuple(modules or self.DEFAULT_MODULES)
+        self.roots = tuple(roots or self.DEFAULT_ROOTS)
+
+    # --- call graph ---
+
+    def _index(self, ctx):
+        mods = {}
+        for rel in self.module_paths:
+            sf = ctx.file(rel)
+            if sf is not None and sf.tree is not None:
+                mods[rel] = _Module(sf)
+        method_owner = {}   # method name -> [(relpath, qualname)]
+        for rel, mod in mods.items():
+            for cls, methods in mod.classes.items():
+                for m, qn in methods.items():
+                    method_owner.setdefault(m, []).append((rel, qn))
+        return mods, method_owner
+
+    def _edges(self, mods, method_owner, rel, qualname):
+        """(relpath, qualname) call targets of one function body."""
+        mod = mods[rel]
+        fn = mod.functions.get(qualname)
+        if fn is None:
+            return
+        cls = qualname.split(".")[0] if "." in qualname else None
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in mod.functions:
+                    yield rel, f.id
+                elif f.id in mod.imports:
+                    tgt_rel, tgt_name = mod.imports[f.id]
+                    tgt = mods.get(tgt_rel)
+                    if tgt is not None and tgt_name in tgt.functions:
+                        yield tgt_rel, tgt_name
+            elif isinstance(f, ast.Attribute):
+                recv = f.value
+                if (isinstance(recv, ast.Name) and recv.id == "self"
+                        and cls is not None):
+                    qn = mod.classes.get(cls, {}).get(f.attr)
+                    if qn is not None:
+                        yield rel, qn
+                else:
+                    owners = method_owner.get(f.attr, [])
+                    if len(owners) == 1:
+                        yield owners[0]
+
+    # --- device-value taint + sync detection inside one function ---
+
+    @staticmethod
+    def _mentions(node, names):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return True
+        return False
+
+    def _device_names(self, mod, qualname, fn):
+        """Local names bound (possibly via unpack) to results of jitted
+        callables: self.<jitted attr>(...), a local jax.jit(...) value,
+        or an expression that mentions an already-tainted name."""
+        cls = qualname.split(".")[0] if "." in qualname else None
+        jitted_attrs = mod.jitted_attrs.get(cls, set())
+        local_jits = set()
+        tainted = set()
+
+        def _device_call(call):
+            f = call.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self" and f.attr in jitted_attrs):
+                return True
+            return isinstance(f, ast.Name) and f.id in local_jits
+
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = getattr(node, "value", None)
+            if value is None:
+                continue
+            targets = assign_name_targets(node)
+            if isinstance(value, ast.Call) and _is_jit_call(value):
+                local_jits.update(targets)
+                continue
+            taint = ((isinstance(value, ast.Call) and _device_call(value))
+                     or self._mentions(value, tainted))
+            if not taint:
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call) and _device_call(sub):
+                        taint = True
+                        break
+            if taint:
+                tainted.update(targets)
+        return tainted
+
+    def _sync_findings(self, mod, rel, qualname, root_desc):
+        fn = mod.functions.get(qualname)
+        if fn is None:
+            return
+        device = self._device_names(mod, qualname, fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = call_name(node)
+            if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f".block_until_ready() in {qualname} — device sync "
+                    f"reachable from {root_desc}")
+            elif name in ("jax.device_get", "device_get"):
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f"jax.device_get in {qualname} — device fetch "
+                    f"reachable from {root_desc}")
+            elif (isinstance(f, ast.Attribute) and f.attr == "item"
+                  and not node.args and not node.keywords):
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f".item() in {qualname} — scalar device fetch "
+                    f"reachable from {root_desc}")
+            elif (name is not None and "." in name
+                  and name.split(".")[0] in _NP_ROOTS
+                  and name.split(".")[-1] in ("asarray", "array")
+                  and any(self._mentions(a, device) for a in node.args)):
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f"{name} over a jitted-call result in {qualname} — "
+                    f"host sync reachable from {root_desc}")
+
+    def check(self, ctx):
+        mods, method_owner = self._index(ctx)
+        seen = set()
+        queue = []
+        for rel, qn in self.roots:
+            mod = mods.get(rel)
+            if mod is None or qn not in mod.functions:
+                yield Finding(
+                    self.name, rel, 1,
+                    f"hot-path root {qn!r} not found — the rule's root "
+                    "list rotted; update HotPathSync.DEFAULT_ROOTS")
+                continue
+            queue.append((rel, qn, qn))
+            seen.add((rel, qn))
+        while queue:
+            rel, qn, root = queue.pop()
+            yield from self._sync_findings(mods[rel], rel, qn, root)
+            for tgt in self._edges(mods, method_owner, rel, qn):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    queue.append((tgt[0], tgt[1], root))

@@ -1,0 +1,67 @@
+"""no-committed-logs: no ``*.log`` artifact may be tracked by git.
+
+The tpu_*.sh drivers tee their output into ``tools/*.log``; a round of
+those once landed in history and shipped stale silicon transcripts with
+every clone. The pattern is gitignored now — this rule keeps the class
+of mistake from returning via ``git add -f`` or a new un-ignored
+location. Only *tracked* files count: a local, ignored log from running
+the scripts is fine.
+"""
+
+import os
+import subprocess
+
+from paddle_tpu.analysis.lint import (DEFAULT_EXCLUDES, Finding, Rule,
+                                      register)
+
+
+@register
+class NoCommittedLogs(Rule):
+    name = "no-committed-logs"
+    help = "no *.log artifact tracked by git (gitignore tools/*.log)"
+
+    def __init__(self, use_git=None):
+        # None = use git when the tree is a work tree, else walk the
+        # filesystem (fixture trees aren't git roots)
+        self.use_git = use_git
+
+    def _git_logs(self, root):
+        try:
+            proc = subprocess.run(
+                ["git", "-C", root, "ls-files", "--", "*.log"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [p for p in proc.stdout.splitlines() if p.strip()]
+
+    def _walk_logs(self, root):
+        out = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != ".git"]
+            for f in sorted(filenames):
+                if f.endswith(".log"):
+                    rel = os.path.relpath(os.path.join(dirpath, f), root)
+                    out.append(rel.replace(os.sep, "/"))
+        return out
+
+    def check(self, ctx):
+        logs = None
+        if self.use_git is not False:
+            logs = self._git_logs(ctx.root)
+        if logs is None:
+            if self.use_git is True:
+                yield Finding(self.name, ".", 1,
+                              "git ls-files failed — cannot enforce "
+                              "no-committed-logs")
+                return
+            logs = self._walk_logs(ctx.root)
+        for rel in logs:
+            if any(part in rel for part in DEFAULT_EXCLUDES):
+                continue
+            yield Finding(
+                self.name, rel, 1,
+                "committed *.log artifact — remove it and rely on the "
+                ".gitignore'd tools/*.log pattern")

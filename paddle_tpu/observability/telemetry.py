@@ -157,13 +157,11 @@ class StepTelemetry:
             tokens / wall_s if tokens and wall_s else None)
         rec["mfu"] = _perf.mfu(self._flops, wall_s)
         try:
-            rec["loss"] = (float(jax.device_get(loss))
-                           if loss is not None else None)
+            rec["loss"] = float(jax.device_get(loss)) if loss is not None else None  # graft-lint: disable=hot-path-sync (trailing fetch: this loss is >= one full step old, so device_get returns without stalling the in-flight step)
         except Exception:
             rec["loss"] = None
         try:
-            rec["grad_norm"] = (float(jax.device_get(gnorm))
-                                if gnorm is not None else None)
+            rec["grad_norm"] = float(jax.device_get(gnorm)) if gnorm is not None else None  # graft-lint: disable=hot-path-sync (same parked-step fetch as loss above — never blocks on in-flight work)
         except Exception:
             rec["grad_norm"] = None
         rec["memory"] = _perf.device_memory_stats()

@@ -427,8 +427,8 @@ class ServingEngine:
                 toks_dev, self._caches = self._decode_jit(
                     self._params, self._caches, self._last_tokens,
                     self._page_table, self._lengths, self._active, key)
-                toks = np.asarray(toks_dev)    # host sync: the scheduler
-            except Exception as e:             # needs the tokens
+                toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
+            except Exception as e:
                 self._recover("serve.step", e)
         if toks is not None:
             self._retry_budget.success()       # consecutive-failure reset
@@ -687,7 +687,7 @@ class ServingEngine:
                 tok_dev, self._caches = self._prefill_jit(
                     self._params, self._caches, req.device_prompt[ci],
                     starts, lens, self._page_table[slot][None, :], key)
-                tok = int(np.asarray(tok_dev)[0])
+                tok = int(np.asarray(tok_dev)[0])  # graft-lint: disable=hot-path-sync (admission-time sync, once per prefill chunk: the slot table needs the first token before decode rounds start)
             except Exception as e:
                 self._recover("serve.prefill", e, pending=req)
                 return False

@@ -1,0 +1,12 @@
+"""Planted catalog: one gauge, one prefix family."""
+
+
+class MetricSpec:
+    def __init__(self, kind, labels=(), help=""):
+        pass
+
+
+CATALOG = {
+    "train.loss": MetricSpec("gauge"),
+    "span.": MetricSpec("histogram"),
+}

@@ -1,0 +1,19 @@
+"""Planted metric call sites: one uncataloged, one kind mismatch."""
+
+
+class _M:
+    def counter(self, name):
+        pass
+
+    def gauge(self, name):
+        pass
+
+    def histogram(self, name):
+        pass
+
+
+m = _M()
+m.gauge("train.loss")          # clean: exact match, right kind
+m.histogram("span.step")       # clean: prefix family, right kind
+m.counter("train.loss")        # PLANTED: cataloged as gauge
+m.counter("rogue.metric")      # PLANTED: not in the catalog

@@ -1,0 +1,10 @@
+"""Planted registry: one live entry, one with no call site."""
+
+FAULT_POINTS = {
+    "used.point": "has a call site",
+    "unused.point": "PLANTED: registered but never called",
+}
+
+
+def fault_point(name):
+    pass

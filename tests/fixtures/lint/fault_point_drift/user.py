@@ -1,0 +1,6 @@
+"""Planted unregistered fault-point call site."""
+
+from paddle_tpu.testing.chaos import fault_point
+
+fault_point("used.point")      # clean
+fault_point("rogue.point")     # PLANTED: not in FAULT_POINTS

@@ -1,0 +1,9 @@
+"""Planted flag registry: one documented flag, one not."""
+
+
+def define_flag(name, default, help_):
+    pass
+
+
+define_flag("documented", True, "Appears in the fixture README table.")
+define_flag("undocumented", 1, "PLANTED: missing from the table.")

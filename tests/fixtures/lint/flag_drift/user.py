@@ -1,0 +1,7 @@
+"""Planted undefined-flag reads."""
+
+from paddle_tpu.core.flags import get_flag, set_flags
+
+get_flag("documented")                 # clean
+get_flag("missing_flag")               # PLANTED: undefined flag read
+set_flags({"also_missing": 1})         # PLANTED: undefined set_flags key

@@ -1,0 +1,21 @@
+"""Planted hot-path-sync violations: a mini ServingEngine whose step
+path syncs four ways. The host-side np.asarray must stay silent."""
+
+import jax
+import numpy as np
+
+
+class ServingEngine:
+    def __init__(self, fn):
+        self._decode_jit = jax.jit(fn)
+
+    def step(self):
+        toks_dev = self._decode_jit(0)
+        toks = np.asarray(toks_dev)           # PLANTED: sync on device value
+        toks_dev.block_until_ready()          # PLANTED
+        host = np.asarray([1, 2, 3])          # clean: host staging, no device
+        return self._count(toks_dev), host
+
+    def _count(self, toks):
+        n = jax.device_get(toks)              # PLANTED: via step -> _count edge
+        return n.item()                       # PLANTED

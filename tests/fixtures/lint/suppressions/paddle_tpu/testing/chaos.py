@@ -1,0 +1,7 @@
+"""Empty registry so every fixture call site is a violation."""
+
+FAULT_POINTS = {}
+
+
+def fault_point(name):
+    pass

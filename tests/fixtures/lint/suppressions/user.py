@@ -1,0 +1,8 @@
+"""Suppression machinery controls: one valid suppression, one missing
+its reason, one naming an unknown rule."""
+
+from paddle_tpu.testing.chaos import fault_point
+
+fault_point("ghost.one")    # graft-lint: disable=fault-point-drift (fixture: proving the suppression machinery swallows this)
+fault_point("ghost.two")    # graft-lint: disable=fault-point-drift
+fault_point("ghost.three")  # graft-lint: disable=imaginary-rule (reasoned, but the rule does not exist)

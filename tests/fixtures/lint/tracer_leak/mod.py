@@ -1,0 +1,35 @@
+"""Planted tracer-leak violations, with the static/container patterns
+that must NOT fire sharing the same functions."""
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def decide(x):
+    if x > 0:                      # clean: Compare is not truthiness-rooted
+        pass
+    if x:                          # PLANTED: python `if` on a traced value
+        return x
+    return -x
+
+
+def body(carry, x):
+    while x:                       # PLANTED: staged via lax.scan
+        x = x - 1
+    return carry, x
+
+
+def run(xs):
+    return lax.scan(body, 0, xs)
+
+
+@jax.jit
+def static_ok(x, n):
+    leaves = tuple(jax.tree_util.tree_leaves(x))
+    if leaves:                     # clean: container truthiness is static
+        pass
+    if x.shape[0] > 2:             # clean: .shape is static at trace time
+        pass
+    y = x if n else -x             # PLANTED: IfExp on a traced value
+    return bool(y)                 # PLANTED: bool() concretizes the tracer

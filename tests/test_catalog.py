@@ -1,47 +1,22 @@
 """observability/catalog.py — the central metric table + the name-drift
 lint.
 
-The lint is the satellite's acceptance: every literal
-`.counter("x")` / `.gauge("x")` / `.histogram("x")` call site in the
-framework source (paddle_tpu/, bench.py, tools/) must name a metric the
-catalog knows, with the kind the catalog declares — so the exporter's
-HELP lines, dashboards, and alert rules never chase a renamed or ad-hoc
-metric."""
+The drift lint itself lives in the graft-lint rule framework now
+(paddle_tpu/analysis/rules/catalog_drift.py, AST-based instead of the
+original regex grep); this file drives the rule and keeps the
+catalog-API tests. `tests/test_lint.py` holds the planted-violation
+positive control proving the rule fires."""
 
 import os
-import re
 
 import pytest
 
+from paddle_tpu.analysis import lint
+from paddle_tpu.analysis.rules.catalog_drift import CatalogDrift
 from paddle_tpu.observability import catalog as C
 from paddle_tpu.observability import metrics as M
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-# literal-first-arg metric constructor calls; \s* spans newlines for the
-# multi-line call sites (trainer.py's stall counter)
-_CALL = re.compile(r'\.(counter|gauge|histogram)\(\s*"([^"]+)"')
-
-
-def _source_files():
-    for root, dirs, files in os.walk(os.path.join(REPO, "paddle_tpu")):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
-    yield os.path.join(REPO, "bench.py")
-    tools = os.path.join(REPO, "tools")
-    for f in sorted(os.listdir(tools)):
-        if f.endswith(".py"):
-            yield os.path.join(tools, f)
-
-
-def _call_sites():
-    for path in _source_files():
-        with open(path, encoding="utf-8") as fh:
-            text = fh.read()
-        for kind, name in _CALL.findall(text):
-            yield os.path.relpath(path, REPO), kind, name
 
 
 class TestCatalog:
@@ -62,21 +37,15 @@ class TestCatalog:
             C.preregister(["not.in.catalog"], registry=r)
 
     def test_no_metric_name_drift(self):
-        """The tier-1 lint: every literal metric call site in the tree
-        is cataloged, with the cataloged kind."""
-        sites = list(_call_sites())
-        # the wiring exists — if this ever goes to zero the regex rotted
-        assert len(sites) >= 25, sites
-        problems = []
-        for path, kind, name in sites:
-            spec = C.lookup(name)
-            if spec is None:
-                problems.append(f"{path}: {kind}({name!r}) not in "
-                                "observability/catalog.py CATALOG")
-            elif spec.kind != kind:
-                problems.append(f"{path}: {name!r} called as {kind} but "
-                                f"cataloged as {spec.kind}")
-        assert not problems, "\n".join(problems)
+        """The tier-1 lint, via the catalog-drift rule: every literal
+        metric call site in the tree is cataloged, with the cataloged
+        kind — and the site detection itself has not rotted (the rule's
+        MIN_SITES canary fires as a finding if it has)."""
+        ctx = lint.LintContext(REPO)
+        rule = CatalogDrift()
+        findings = list(rule.check(ctx))
+        assert not findings, "\n".join(f.format() for f in findings)
+        assert len(rule.sites(ctx)) >= rule.MIN_SITES
 
     def test_catalog_covers_the_live_families(self):
         for name in ("serve.goodput", "serve.slo_violations",

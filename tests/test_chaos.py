@@ -567,40 +567,26 @@ class TestChaosOnIngestPath:
 
 
 class TestFaultPointRegistry:
-    """The tier-1 lint for chaos.FAULT_POINTS: the registry and the
-    literal fault_point("...") call sites in the framework source may
-    never drift apart — a chaos plan targeting a renamed hook would
-    silently inject nothing."""
+    """The tier-1 lint for chaos.FAULT_POINTS, via the graft-lint
+    fault-point-drift rule (AST port of the original grep): the registry
+    and the literal fault_point("...") call sites may never drift apart,
+    in either direction — a chaos plan targeting a renamed hook would
+    silently inject nothing, and a registered point with no site is a
+    drill that tests nothing. The planted-violation positive control
+    lives in tests/test_lint.py."""
 
-    @staticmethod
-    def _sites():
-        import re
+    def test_registry_and_call_sites_never_drift(self):
+        from paddle_tpu.analysis import lint
+        from paddle_tpu.analysis.rules.fault_point_drift import (
+            FaultPointDrift)
+
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        pat = re.compile(r'fault_point\(\s*"([^"]+)"')
-        sites = {}
-        for root, dirs, files in os.walk(os.path.join(repo, "paddle_tpu")):
-            dirs[:] = [d for d in dirs if d != "__pycache__"]
-            for f in files:
-                if not f.endswith(".py"):
-                    continue
-                path = os.path.join(root, f)
-                with open(path, encoding="utf-8") as fh:
-                    text = fh.read()
-                for name in pat.findall(text):
-                    sites.setdefault(name, []).append(
-                        os.path.relpath(path, repo))
-        return sites
-
-    def test_every_call_site_is_registered(self):
-        sites = self._sites()
-        assert len(sites) >= 6, sites      # the wiring exists
-        unknown = {n: p for n, p in sites.items()
-                   if n not in chaos.FAULT_POINTS}
-        assert not unknown, (
-            f"fault_point call sites missing from chaos.FAULT_POINTS: "
-            f"{unknown}")
-
-    def test_every_registered_point_is_compiled_in(self):
-        unused = set(chaos.FAULT_POINTS) - set(self._sites())
-        assert not unused, (
-            f"chaos.FAULT_POINTS entries with no call site: {unused}")
+        ctx = lint.LintContext(repo)
+        rule = FaultPointDrift()
+        findings = list(rule.check(ctx))
+        assert not findings, "\n".join(f.format() for f in findings)
+        # the statically-parsed registry matches the live one, and the
+        # wiring exists (>= MIN_SITES sites, every one registered)
+        sites = rule.sites(ctx)
+        assert sum(len(v) for v in sites.values()) >= rule.MIN_SITES
+        assert set(sites) == set(chaos.FAULT_POINTS)

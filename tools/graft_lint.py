@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""graft-lint CLI: the repo's static-analysis front door.
+
+Runs the AST rule layer (paddle_tpu/analysis/lint.py + rules/) over the
+tree and exits non-zero on any finding. The heavy compile-contract layer
+(paddle_tpu/analysis/contracts.py, evaluated against real compiled HLO)
+is opt-in via --contracts because it compiles models.
+
+Usage:
+  python tools/graft_lint.py                    # whole tree, human output
+  python tools/graft_lint.py --format json      # machine-readable
+  python tools/graft_lint.py --changed-only     # pre-commit: only files
+                                                #   touched vs HEAD
+  python tools/graft_lint.py --rules flag-drift,catalog-drift
+  python tools/graft_lint.py --list             # rules + contract table
+  python tools/graft_lint.py --contracts serve.decode,train.gpt@dp2,tp2
+  python tools/graft_lint.py --contracts all    # every CONTRACTS row
+
+The AST layer is stdlib-only and finishes in well under a second: the
+repo package is entered through a namespace stub so paddle_tpu/__init__
+(and with it jax) is never imported for a plain lint run.
+
+Suppressions are per line, reason mandatory:
+  x = np.asarray(d)  # graft-lint: disable=hot-path-sync (scheduler needs this)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_analysis():
+    """paddle_tpu.analysis without paddle_tpu/__init__'s jax import: a
+    namespace stub with the real package __path__ keeps submodule
+    resolution intact while skipping the parent's side effects."""
+    if "paddle_tpu" not in sys.modules:
+        pkg = types.ModuleType("paddle_tpu")
+        pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = pkg
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from paddle_tpu.analysis import lint
+    return lint
+
+
+def _changed_paths():
+    """Repo-relative paths touched vs HEAD (staged + unstaged + new)."""
+    paths = set()
+    for extra in (["--cached"], []):
+        proc = subprocess.run(
+            ["git", "-C", REPO, "diff", "--name-only", "HEAD"] + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        if proc.returncode == 0:
+            paths.update(p for p in proc.stdout.splitlines() if p.strip())
+    proc = subprocess.run(
+        ["git", "-C", REPO, "ls-files", "--others", "--exclude-standard"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    if proc.returncode == 0:
+        paths.update(p for p in proc.stdout.splitlines() if p.strip())
+    return paths
+
+
+def _run_contracts(names):
+    """Evaluate CONTRACTS rows by name (compiles models — minutes, and
+    imports jax). Returns findings-shaped dicts."""
+    sys.modules.pop("paddle_tpu", None)   # drop the stub: real jax now
+    import tools.compile_smoke as cs
+    c = cs._contracts()
+    if names == ["all"]:
+        names = sorted(c.CONTRACTS)
+    unknown = [n for n in names if n not in c.CONTRACTS]
+    if unknown:
+        raise SystemExit(f"unknown contracts {unknown}; "
+                         f"known: {sorted(c.CONTRACTS)}")
+    out = []
+    for name in names:
+        if name.startswith("train."):
+            model = name[len("train."):].split("@")[0]
+            res = cs.sharded_vocab_check(model=model,
+                                         positive_control=False)
+        else:
+            res = cs.serve_smoke()
+        for v in res.get("violations", []):
+            out.append({"rule": f"contract:{name}", "path": name,
+                        "line": 0, "message": v})
+        if not res.get("clean", False) and not res.get("violations"):
+            out.append({"rule": f"contract:{name}", "path": name,
+                        "line": 0, "message": f"contract row failed: {res}"})
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="repo static analysis: AST rules + compile contracts")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files changed vs HEAD "
+                         "(tree-wide rules still see the whole tree)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--list", action="store_true",
+                    help="list rules and contract rows, then exit")
+    ap.add_argument("--contracts", default=None,
+                    help="also evaluate these CONTRACTS rows ('all' or "
+                         "comma-separated names) — compiles models, "
+                         "needs jax")
+    ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    lint = _import_analysis()
+
+    if args.list:
+        print("rules:")
+        for name, help_ in lint.rule_help().items():
+            print(f"  {name:20s} {help_}")
+        from paddle_tpu.analysis import contracts
+        print("contracts (--contracts, compiles models):")
+        for name, row in contracts.CONTRACTS.items():
+            print(f"  {name:30s} {', '.join(c.name for c in row)}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = lint.make_rules(
+            [r.strip() for r in args.rules.split(",") if r.strip()])
+
+    paths = _changed_paths() if args.changed_only else None
+    ctx = lint.LintContext(args.root)
+    findings = lint.run_lint(ctx, rules=rules, paths=paths)
+    records = [f.as_dict() for f in findings]
+
+    if args.contracts:
+        records.extend(_run_contracts(
+            [c.strip() for c in args.contracts.split(",") if c.strip()]))
+
+    if args.format == "json":
+        print(json.dumps({"findings": records, "ok": not records}))
+    else:
+        for r in records:
+            print(f"{r['path']}:{r['line']}: [{r['rule']}] {r['message']}")
+        n = len(records)
+        scope = f"{len(paths)} changed file(s)" if paths is not None \
+            else "tree"
+        print(f"graft-lint: {n} finding(s) over {scope}"
+              + ("" if n else " — clean"))
+    return 1 if records else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,16 +35,21 @@ def _cost_flops(jitted, *args):
 COMPILE_ONLY = False
 TINY = False
 DUMP_HLO = None    # --dump-hlo: write the compiled (post-SPMD) HLO text
-MESH_AXES = None   # --mesh: {"dp": 2, "tp": 2} parsed from "dp2,tp2"
+MESH_AXES = None   # --mesh: {"dp": 2, "tp": 2} parsed from "dp2,tp2",
+                   # or the string "auto" until the planner resolves it
+AUTO_PLAN = None   # --mesh auto: the winning autoplan MeshPlan
 RUN_LOG = None     # --run-log: RunLog streaming per-step bench records
 
 
 def _parse_mesh(spec):
     """"dp2,tp2" -> {"dp": 2, "tp": 2}. A bare trailing-digit-less axis
     means: the FIRST such axis takes the remaining devices (-1), later
-    ones default to 2 — so "--mesh dp,tp" reads as dp x tp=2."""
+    ones default to 2 — so "--mesh dp,tp" reads as dp x tp=2. "auto"
+    defers to the autoplan cost-model search at model-setup time."""
     if not spec:
         return None
+    if spec.strip().lower() == "auto":
+        return "auto"
     import re
     axes = {}
     first_bare = True
@@ -62,18 +67,36 @@ def _parse_mesh(spec):
     return axes
 
 
-def _mesh_setup(params, opt, cfg_vocab, batch):
+def _mesh_setup(params, opt, cfg_vocab, batch, cfg=None, seq=None):
     """Build the dp x tp mesh, shard params with the Megatron-flavored LM
     plan (vocab-dim embedding/projection over tp), and return everything
     the sharded step needs. Returns (mesh, params, opt_state, vocab_axis,
-    batch_axis, batch) — batch rounded up to a dp multiple."""
+    batch_axis, batch) — batch rounded up to a dp multiple.
+
+    --mesh auto: the autoplan cost-model search picks the factorization
+    (pipeline candidates pruned — this train step has no pipeline
+    executor) and its MeshPlan emits the param shardings through the
+    DistributionPlanner layer; the plan lands in the JSON row."""
+    global MESH_AXES, AUTO_PLAN
+    import jax
     import paddle_tpu as pt
-    mesh = pt.parallel.make_mesh(dict(MESH_AXES))
+    if MESH_AXES == "auto":
+        from paddle_tpu.parallel import autoplan
+        spec = autoplan.ModelSpec.from_config(cfg, batch=batch, seq=seq)
+        plan = autoplan.plan(spec, topology=autoplan.get_topology(),
+                             devices=len(jax.devices()), allow_pp=False)
+        AUTO_PLAN = plan
+        MESH_AXES = {k: int(v) for k, v in plan.axes.items()}
+        print(f"--mesh auto: {plan.reason}", file=sys.stderr)
+        mesh = plan.build_mesh()
+        params = plan.place(params)
+    else:
+        mesh = pt.parallel.make_mesh(dict(MESH_AXES))
+        MESH_AXES.update({k: int(v) for k, v in mesh.shape.items()})
+        params = pt.parallel.tp_lm_sharding(mesh, params)
     dp = mesh.shape.get("dp", 1)
     tp = mesh.shape.get("tp", 1)
     batch = ((batch + dp - 1) // dp) * dp
-    MESH_AXES.update({k: int(v) for k, v in mesh.shape.items()})
-    params = pt.parallel.tp_lm_sharding(mesh, params)
     opt_state = opt.init(params)
     vocab_axis = "tp" if tp > 1 and cfg_vocab % tp == 0 else None
     if tp > 1 and cfg_vocab % tp:
@@ -89,8 +112,10 @@ def _mesh_ctx(mesh):
 
 
 def _mesh_row(row):
-    if MESH_AXES:
+    if MESH_AXES and MESH_AXES != "auto":
         row["mesh"] = dict(MESH_AXES)
+    if AUTO_PLAN is not None:
+        row["autoplan"] = AUTO_PLAN.summary()
     return row
 
 
@@ -222,7 +247,8 @@ def _bench_mlm(model_cls, cfg, name, steps, batch, seq, use_flash=False):
     mesh = vocab_axis = batch_axis = None
     if MESH_AXES:
         mesh, params, opt_state, vocab_axis, batch_axis, batch = \
-            _mesh_setup(params, opt, cfg.vocab_size, batch)
+            _mesh_setup(params, opt, cfg.vocab_size, batch, cfg=cfg,
+                        seq=seq)
     else:
         opt_state = opt.init(params)
 
@@ -326,7 +352,8 @@ def bench_transformer(steps, batch, seq):
     mesh = vocab_axis = batch_axis = None
     if MESH_AXES:
         mesh, params, opt_state, vocab_axis, batch_axis, batch = \
-            _mesh_setup(params, opt, cfg.tgt_vocab, batch)
+            _mesh_setup(params, opt, cfg.tgt_vocab, batch, cfg=cfg,
+                        seq=seq)
     else:
         opt_state = opt.init(params)
 
@@ -597,7 +624,8 @@ def bench_gpt(steps, batch, seq):
     mesh = vocab_axis = batch_axis = None
     if MESH_AXES:
         mesh, params, opt_state, vocab_axis, batch_axis, batch = \
-            _mesh_setup(params, opt, cfg.vocab_size, batch)
+            _mesh_setup(params, opt, cfg.vocab_size, batch, cfg=cfg,
+                        seq=seq)
     else:
         opt_state = opt.init(params)
 
@@ -1070,7 +1098,10 @@ def main():
                          "params shard with the Megatron LM plan (vocab-"
                          "dim embedding over tp), the batch over dp, and "
                          "the fused cross-entropy runs vocab-sharded. "
-                         "bert/ernie/gpt/transformer_big only.")
+                         "'auto' lets the autoplan cost-model search "
+                         "pick the factorization (plan recorded in the "
+                         "JSON row). bert/ernie/gpt/transformer_big "
+                         "only.")
     ap.add_argument("--dump-hlo", default=None,
                     help="with --compile-only: write the compiled (post-"
                          "SPMD) HLO text here (tools/compile_smoke.py "

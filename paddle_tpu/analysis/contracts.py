@@ -340,6 +340,10 @@ def serve_prefill_contracts():
 # planted violation.
 CONTRACTS = {
     "train.gpt@dp2,tp2": sharded_train_contracts("gpt"),
+    # autoplan-resolved mesh (bench --mesh auto on 4 virtual devices):
+    # the planner may pick any dp in {1, 2, 4}; dp=4 gives the smallest
+    # per-shard row count, so this row is the strictest of the three
+    "train.gpt@auto": sharded_train_contracts("gpt", dp=4),
     "train.bert@dp2,tp2": sharded_train_contracts("bert"),
     "train.transformer_big@dp2,tp2":
         sharded_train_contracts("transformer_big"),

@@ -221,3 +221,17 @@ define_flag("watchdog_goodput_min", 0.5,
 define_flag("strict_mirror", False,
             "Fail training when a checkpoint remote-mirror push fails "
             "after retries, instead of degrading to queue-and-continue.")
+# auto-parallelism (parallel/autoplan): cost-model-driven mesh planning —
+# model + topology in, dp x tp x pp mesh + shardings out
+define_flag("auto_mesh", False,
+            "Treat an unset strategy as strategy='auto' in "
+            "fleet.build_mesh / fleet.distributed_optimizer: resolve the "
+            "mesh through the autoplan cost-model search (requires a "
+            "prior fleet.auto_plan(...) or uses its cached plan).")
+define_flag("autoplan_topology", "",
+            "Topology preset the autoplan search prices against (e.g. "
+            "cpu4, v5e-8, 2xv5e-16); '' auto-detects from jax.devices().")
+define_flag("autoplan_hbm_fraction", 0.9,
+            "Fraction of per-chip HBM the planner may budget; candidates "
+            "whose memory estimate exceeds it are pruned with a recorded "
+            "reason.")

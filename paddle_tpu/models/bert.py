@@ -142,7 +142,7 @@ class BertForPretraining(nn.Module):
 
     def loss(self, input_ids, mlm_labels, nsp_labels, mlm_mask,
              token_type_ids=None, attention_mask=None, mask_positions=None,
-             vocab_axis=None, batch_axis=None, mesh=None):
+             vocab_axis=None, batch_axis=None, mesh=None, mesh_plan=None):
         """MLM + NSP pretraining loss as an apply() entry point. Default
         path fuses the MLM vocab projection into the chunked cross-entropy
         (no [B, M, V] logits, no tied-head matmul output in HBM);
@@ -151,8 +151,13 @@ class BertForPretraining(nn.Module):
         vocab_axis/batch_axis: mesh axis names when the tied embedding
         (and mlm_bias) are vocab-partitioned and the batch dp-sharded
         under GSPMD — the fused CE then runs per vocab shard with
-        pmax/psum combines instead of gathering the table."""
+        pmax/psum combines instead of gathering the table. mesh_plan: an
+        autoplan MeshPlan — fills the three kwargs above from the
+        planned mesh (explicit values win)."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        if mesh_plan is not None:
+            vocab_axis, batch_axis, mesh = mesh_plan.resolve_loss_axes(
+                vocab_axis, batch_axis, mesh)
         if (not fused_xent_enabled()
                 or self.encoder.tok_emb.has_p("weight_q")):
             mlm_logits, nsp_logits = self.forward(

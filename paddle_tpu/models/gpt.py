@@ -172,7 +172,7 @@ class GPT(nn.Module):
                                   self.hidden(input_ids, pos_offset))
 
     def loss(self, input_ids, labels=None, pad_id=None, vocab_axis=None,
-             batch_axis=None, mesh=None):
+             batch_axis=None, mesh=None, mesh_plan=None):
         """Shifted next-token CE as an apply() entry point
         (``model.apply(vars, ids, method="loss")``). Default path: the
         chunked fused cross-entropy against the tied embedding table —
@@ -182,8 +182,13 @@ class GPT(nn.Module):
         vocab_axis/batch_axis: mesh axis names when the tied embedding is
         vocab-partitioned (P(tp, None)) and the batch dp-sharded under
         GSPMD — the fused CE then runs per vocab shard with pmax/psum
-        combines instead of gathering the table (ops/fused.py)."""
+        combines instead of gathering the table (ops/fused.py).
+        mesh_plan: an autoplan MeshPlan — fills the three kwargs above
+        from the planned mesh (explicit values win)."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        if mesh_plan is not None:
+            vocab_axis, batch_axis, mesh = mesh_plan.resolve_loss_axes(
+                vocab_axis, batch_axis, mesh)
         if labels is None:
             labels = input_ids
         h = self.hidden(input_ids)

@@ -133,7 +133,7 @@ class Transformer(nn.Module):
 
     def loss(self, src, tgt_in, tgt_out, src_mask=None, pad_id=0,
              label_smoothing=0.1, vocab_axis=None, batch_axis=None,
-             mesh=None):
+             mesh=None, mesh_plan=None):
         """Label-smoothed NMT loss as an apply() entry point. Default path
         fuses the vocab projection into the chunked cross-entropy — no
         [B, T, V] logits and no same-shape one_hot soft labels (the two
@@ -143,8 +143,13 @@ class Transformer(nn.Module):
         vocab_axis/batch_axis: mesh axis names when out_proj is
         vocab-partitioned (P(None, tp), the hv layout) and the batch
         dp-sharded under GSPMD — the fused CE then runs per vocab shard
-        with pmax/psum combines instead of gathering the projection."""
+        with pmax/psum combines instead of gathering the projection.
+        mesh_plan: an autoplan MeshPlan — fills the three kwargs above
+        from the planned mesh (explicit values win)."""
         from paddle_tpu.ops.fused import fused_xent, fused_xent_enabled
+        if mesh_plan is not None:
+            vocab_axis, batch_axis, mesh = mesh_plan.resolve_loss_axes(
+                vocab_axis, batch_axis, mesh)
         memory = self.encode(src, src_mask)
         h = self.decode_hidden(tgt_in, memory, src_mask)
         if not fused_xent_enabled() or self.out_proj.has_p("weight_q"):

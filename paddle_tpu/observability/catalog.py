@@ -16,6 +16,14 @@ MetricSpec = collections.namedtuple("MetricSpec", ["kind", "labels", "help"])
 
 # name -> (kind, label names, help). Keep alphabetized within each group.
 CATALOG = {
+    # parallel/autoplan/search.py
+    "autoplan.candidates": MetricSpec(
+        "counter", ("outcome",),
+        "Mesh factorizations considered by the auto-parallelism search, "
+        "by outcome (scored vs pruned-with-reason)."),
+    "autoplan.plan_s": MetricSpec(
+        "histogram", (),
+        "Wall time of one autoplan search (enumerate + price + rank)."),
     # bench.py
     "bench.step_time_s": MetricSpec(
         "histogram", (), "Per-step wall time of a timed bench window."),

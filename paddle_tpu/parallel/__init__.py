@@ -16,6 +16,7 @@ Ref map (reference → here):
 
 from paddle_tpu.parallel import (
     api,
+    autoplan,
     collective,
     communicator,
     dgc,
@@ -30,6 +31,8 @@ from paddle_tpu.parallel import (
     sparse,
 )
 from paddle_tpu.parallel.planner import DistributionPlan, DistributionPlanner
+from paddle_tpu.parallel.autoplan import (MeshPlan, ModelSpec, Topology,
+                                          plan as auto_plan)
 from paddle_tpu.parallel.sparse import HostTable, SparseTable
 from paddle_tpu.parallel.elastic import ElasticRunner
 from paddle_tpu.parallel.fleet import DistributedStrategy, Fleet, fleet

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel.autoplan import layouts
 from paddle_tpu.parallel.mesh import DP, FSDP, TP
 
 
@@ -72,24 +73,17 @@ def tp_lm_specs(tree, tp=TP, min_size=2 ** 11):
       * remaining large 2-D weights (FFN/attention) column-shard
         -> P(None, tp); everything else replicates.
 
-    Returns a pytree of PartitionSpec mirroring `tree`.
+    Returns a pytree of PartitionSpec mirroring `tree`. The rules
+    themselves live in parallel/autoplan/layouts.py (lm_layout) — one
+    source of truth shared with the DistributionPlanner emission layer.
     """
-    vocab_tables = {"tok_emb", "src_emb", "tgt_emb"}
 
     def spec(path, x):
         names = [str(getattr(p, "key", getattr(p, "name", p)))
                  for p in path]
-        leaf = names[-1] if names else ""
-        if (leaf == "weight" and x.ndim == 2
-                and vocab_tables & set(names)):
-            return P(tp, None)
-        if leaf == "weight" and x.ndim == 2 and "out_proj" in names:
-            return P(None, tp)
-        if leaf == "mlm_bias" and x.ndim == 1:
-            return P(tp)
-        if x.ndim == 2 and x.size >= min_size:
-            return P(None, tp)
-        return P()
+        t, _ = layouts.lm_layout(names, tuple(x.shape), tp=tp,
+                                 min_size=min_size)
+        return P(*t) if any(a is not None for a in t) else P()
 
     return jax.tree_util.tree_map_with_path(spec, tree)
 
@@ -100,15 +94,15 @@ def tp_lm_sharding(mesh, tree, tp=TP, min_size=2 ** 11):
     instead), so tiny demo configs never trap on divisibility."""
     size = mesh.shape[tp]
 
-    def place(x, s):
-        dims = tuple(s)
-        ok = all(d is None or x.shape[i] % size == 0
-                 for i, d in enumerate(dims))
-        return jax.device_put(
-            x, NamedSharding(mesh, s if ok else P()))
+    def place(path, x):
+        names = [str(getattr(p, "key", getattr(p, "name", p)))
+                 for p in path]
+        t, _ = layouts.lm_layout(names, tuple(x.shape), tp=tp,
+                                 min_size=min_size, tp_size=size)
+        s = P(*t) if any(a is not None for a in t) else P()
+        return jax.device_put(x, NamedSharding(mesh, s))
 
-    specs = tp_lm_specs(tree, tp=tp, min_size=min_size)
-    return jax.tree_util.tree_map(place, tree, specs)
+    return jax.tree_util.tree_map_with_path(place, tree)
 
 
 def infer_vocab_axis(arr, dim):

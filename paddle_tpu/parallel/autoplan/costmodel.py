@@ -1,0 +1,243 @@
+"""Analytic cost model for candidate (dp, tp, pp) factorizations.
+
+Per arxiv 2110.10548's framing, a candidate placement is scored with
+closed-form estimates of three resources:
+
+  * compute flops — transformer matmul flops (QKV/out projections, FFN,
+    S^2 attention scores, the vocab logits matmul), trained = 3x forward
+    (each matmul's backward is two matmuls). Calibrated against
+    ``jit(step).lower().compile().cost_analysis()`` on CPU by
+    :func:`calibration_report` / tests/test_autoplan.py.
+  * per-chip memory — params + Adam moments + grads (f32), sharded over
+    tp (and layers over pp), plus remat-policy-aware activation
+    residents and the fused-xent chunk temporary. Candidates whose
+    total exceeds usable HBM are pruned by the search.
+  * collective bytes — ring all-reduce of grads over dp
+    (2(n-1)/n x payload), the per-layer activation all-reduces of
+    Megatron tp (2 fwd + 4 bwd-equivalent, folded to 3x fwd here), and
+    p2p microbatch boundary sends for pp. The per-axis byte account is
+    the hook where quantized collectives (EQuARX, arxiv 2506.17615)
+    would later discount an axis.
+
+Everything here is an *estimate for ranking*: absolute step times are
+not promised, but the ordering of candidates on a given topology is
+what the search needs. Stdlib-only at import; jax is pulled in lazily
+by :func:`calibration_report`.
+"""
+
+import dataclasses
+
+# assumed fraction of peak the matmuls sustain — cancels out when
+# ranking candidates on one topology, kept explicit for step_s realism
+MFU_ASSUMED = 0.4
+
+# activation elements saved per token per layer, in units of H and I:
+# qkv + attn-out + 2 residual streams + ln stats ~= 8H; ffn hidden ~= 2I
+_ACT_H, _ACT_I = 8, 2
+
+# fraction of saved activations that survive each remat policy
+# (nn/encoder scan-over-layers checkpoint policies)
+REMAT_KEEP = {"nothing": 1.0, "dots_saveable": 0.6, "full": 0.15}
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """The cost model's view of one training job (model x batch x seq)."""
+    name: str
+    vocab: int
+    hidden: int
+    layers: int
+    heads: int
+    intermediate: int
+    seq: int
+    batch: int                 # global batch
+    mask_fraction: float = 1.0  # fraction of tokens entering the loss (MLM)
+    extra_vocab: int = 0       # second embedding table (NMT src_emb)
+    max_position: int = 0      # position-table rows (0 -> seq)
+    remat: str = "nothing"
+    param_bytes: int = 4       # f32 master params
+    act_bytes: int = 2         # bf16 activations (amp policy)
+
+    @property
+    def tokens(self):
+        return self.batch * self.seq
+
+    @property
+    def loss_rows(self):
+        """Rows entering the vocab-projection loss per step (matches
+        analysis/contracts.py ShardedCase.loss_rows)."""
+        if self.mask_fraction >= 1.0:
+            return self.batch * self.seq
+        return self.batch * max(1, int(self.mask_fraction * self.seq))
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(**d)
+
+    @classmethod
+    def from_config(cls, cfg, batch, seq, name=None):
+        """Build a spec from a model config dataclass (GPTConfig /
+        BertConfig / ErnieConfig / TransformerConfig)."""
+        cname = type(cfg).__name__.lower()
+        name = name or cname.replace("config", "")
+        if hasattr(cfg, "d_model"):       # NMT encoder-decoder
+            return cls(name=name, vocab=cfg.tgt_vocab, hidden=cfg.d_model,
+                       layers=cfg.enc_layers + cfg.dec_layers,
+                       heads=cfg.num_heads, intermediate=cfg.ffn_dim,
+                       seq=seq, batch=batch, extra_vocab=cfg.src_vocab,
+                       max_position=getattr(cfg, "max_len", 0))
+        mlm = "bert" in cname or "ernie" in cname
+        return cls(name=name, vocab=cfg.vocab_size, hidden=cfg.hidden_size,
+                   layers=cfg.num_layers, heads=cfg.num_heads,
+                   intermediate=cfg.intermediate_size, seq=seq, batch=batch,
+                   mask_fraction=0.15 if mlm else 1.0,
+                   max_position=getattr(cfg, "max_position", 0),
+                   remat=getattr(cfg, "remat", None) or "nothing")
+
+
+# ---------------------------------------------------------------- flops
+
+def fwd_flops(spec):
+    """Forward matmul flops for one step (2*M*N*K per matmul, XLA's
+    counting convention)."""
+    H, I = spec.hidden, spec.intermediate
+    T = spec.tokens
+    proj = 2 * T * (4 * H * H + 2 * H * I)          # qkv+out, ffn up+down
+    attn = 4 * spec.batch * spec.seq ** 2 * H       # QK^T and PV
+    loss = 2 * spec.loss_rows * H * spec.vocab      # (chunked) logits
+    return spec.layers * (proj + attn) + loss
+
+
+def train_flops(spec):
+    """Forward + backward: each matmul's grad is two matmuls -> 3x fwd.
+    Remat recompute (policy 'full') re-runs the forward once more."""
+    mult = 4.0 if spec.remat == "full" else 3.0
+    return mult * fwd_flops(spec)
+
+
+# --------------------------------------------------------------- memory
+
+def param_counts(spec):
+    """{embedding, per_layer, head} param counts. The embedding group is
+    the vocab-dim-shardable [V, H] mass (+ position table, replicated in
+    the count's 'head' bucket for simplicity)."""
+    H, I = spec.hidden, spec.intermediate
+    emb = (spec.vocab + spec.extra_vocab) * H
+    per_layer = 4 * H * H + 2 * H * I + 13 * H      # weights + biases + ln
+    pos = max(spec.max_position, spec.seq) * H
+    head = pos + 2 * H                               # pos table + final ln
+    if spec.mask_fraction < 1.0:                     # MLM transform head
+        head += H * H + H + spec.vocab               # dense + ln + mlm_bias
+    return {"embedding": emb, "per_layer": per_layer, "head": head}
+
+
+def chip_memory(spec, dp, tp, pp, microbatches=1, schedule="1f1b"):
+    """Per-chip memory estimate (bytes) for a candidate factorization.
+
+    Params follow the LM layout (autoplan/layouts.py): embedding tables
+    and 2-D weights shard over tp; layers split across pp stages; dp
+    replicates (no ZeRO here). Optimizer state = 2 Adam moments (f32).
+    """
+    counts = param_counts(spec)
+    layers_local = -(-spec.layers // pp)            # ceil: worst stage
+    params_c = (counts["embedding"] / tp
+                + layers_local * counts["per_layer"] / tp
+                + counts["head"])                   # head mostly replicated
+    state = params_c * spec.param_bytes * 3         # master + 2 moments
+    grads = params_c * spec.param_bytes
+    # activation residents between forward and backward
+    local_b = max(1, spec.batch // dp)
+    micro_b = max(1, local_b // microbatches) if pp > 1 else local_b
+    keep = REMAT_KEEP.get(spec.remat, 1.0)
+    act_layer = (micro_b * spec.seq
+                 * (_ACT_H * spec.hidden + _ACT_I * spec.intermediate)
+                 / tp * spec.act_bytes)
+    if pp > 1:
+        in_flight = microbatches if schedule == "gpipe" \
+            else min(pp, microbatches)
+    else:
+        in_flight = 1
+    acts = layers_local * act_layer * keep * in_flight
+    # fused-xent chunk temporary: [local rows, min(V/tp, chunk)] f32
+    rows_local = max(1, spec.loss_rows // dp)
+    loss_tmp = rows_local * min(spec.vocab / tp, 8192) * 4
+    total = state + grads + acts + loss_tmp
+    return {"params_state": state, "grads": grads, "activations": acts,
+            "loss_tmp": loss_tmp, "total": total}
+
+
+# ----------------------------------------------------------- collectives
+
+def collective_bytes(spec, dp, tp, pp, microbatches=1):
+    """Per-chip bytes moved per step, by mesh axis. Ring all-reduce of N
+    payload bytes moves 2(n-1)/n x N per chip; all-gather/reduce-scatter
+    halves (n-1)/n x N each — the dp grad sync is priced as the full
+    all-reduce, tp as the Megatron per-layer activation all-reduces, pp
+    as p2p boundary sends."""
+    out = {}
+    counts = param_counts(spec)
+    layers_local = -(-spec.layers // pp)
+    local_b = max(1, spec.batch // dp)
+    if dp > 1:
+        grad_payload = (counts["embedding"] / tp
+                        + layers_local * counts["per_layer"] / tp
+                        + counts["head"]) * spec.param_bytes
+        out["dp"] = 2.0 * (dp - 1) / dp * grad_payload
+    if tp > 1:
+        act = local_b * spec.seq * spec.hidden * spec.act_bytes
+        # 2 all-reduces/layer fwd (attn out + ffn out), ~3x for train
+        out["tp"] = (layers_local * 6 * act * 2.0 * (tp - 1) / tp
+                     + 4 * max(1, spec.loss_rows // dp) * 4)  # xent stats
+    if pp > 1:
+        micro_b = max(1, local_b // max(1, microbatches))
+        act = micro_b * spec.seq * spec.hidden * spec.act_bytes
+        out["pp"] = 2 * max(1, microbatches) * act   # fwd act + bwd grad
+    return out
+
+
+# -------------------------------------------------------------- predict
+
+def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b"):
+    """Score one candidate: predicted step seconds + the estimates that
+    produced it. dp is the outermost axis — it crosses slice boundaries
+    first on a multi-slice topology, so it prices at DCN bandwidth."""
+    flops_c = train_flops(spec) / (dp * tp * pp)
+    compute_s = flops_c / (topology.peak_flops * MFU_ASSUMED)
+    bubble = (pp - 1) / max(1, microbatches) if pp > 1 else 0.0
+    coll = collective_bytes(spec, dp, tp, pp, microbatches)
+    multi = topology.num_slices > 1
+    coll_s = sum(
+        b / topology.axis_bandwidth(crosses_slices=(ax == "dp" and multi))
+        for ax, b in coll.items())
+    mem = chip_memory(spec, dp, tp, pp, microbatches, schedule)
+    return {
+        "step_s": compute_s * (1.0 + bubble) + coll_s,
+        "compute_s": compute_s,
+        "collective_s": coll_s,
+        "bubble_fraction": bubble,
+        "flops_per_chip": flops_c,
+        "mem_bytes": mem["total"],
+        "mem": mem,
+        "collective_bytes": coll,
+    }
+
+
+# ----------------------------------------------------------- calibration
+
+def calibration_report(spec, jitted, *args):
+    """Compare the analytic flop count against XLA's own
+    ``compile().cost_analysis()`` for a jitted train step — the
+    cost-model's ground-truth hook (runs on CPU; tests assert the ratio
+    stays inside a tolerance band)."""
+    from paddle_tpu.observability.perf import cost_flops
+    measured = cost_flops(jitted, *args)
+    predicted = train_flops(spec)
+    return {
+        "model": spec.name,
+        "predicted_flops": float(predicted),
+        "measured_flops": float(measured),
+        "ratio": float(predicted / measured) if measured else None,
+    }

@@ -1,0 +1,93 @@
+"""One source of truth for the Megatron-flavored LM sharding layout.
+
+Both spec emitters in this package — `parallel.api.tp_lm_specs` (the
+hand-driven path) and `parallel.planner.DistributionPlanner` (autoplan's
+sharding-emission layer) — resolve a param's PartitionSpec through
+:func:`lm_layout`, so the [V, H] vocab-table / [H, V] out_proj /
+column-sharded-FFN rules live in exactly one place. Before this module
+the same rules were duplicated in api.py and approximated by the
+planner's generic largest-divisible-dim rule, and the two could drift.
+
+Stdlib-only on purpose: specs are plain tuples of axis-name-or-None
+(`PlanEntry.spec` convention); callers build `PartitionSpec(*spec)`.
+The planner and the cost model can therefore reason about layouts
+without importing jax.
+
+Divisibility is a *downgrade*, never an error: with `tp_size` given, a
+rule whose named dim does not divide evenly falls back to replicated
+and the returned reason records the skip (`"tp SKIPPED: ..."`) — the
+per-decision inspectability contract of `PlanEntry.reason`.
+"""
+
+# the tied-embedding tables across the LM families (GPT/BERT/ERNIE tok_emb,
+# NMT src/tgt) — [V, H] "vh" layout, vocab dim 0 shards over tp so the
+# fused cross-entropy runs per vocab shard with no weight gather
+LM_VOCAB_TABLES = frozenset({"tok_emb", "src_emb", "tgt_emb"})
+
+# default: 2-D weights smaller than this many elements stay replicated
+LM_MIN_SIZE = 2 ** 11
+
+
+def _downgrade(spec, shape, tp_size, reason):
+    """Replicate any dim whose size does not divide tp_size; explain.
+
+    tp_size=None skips the divisibility check (spec-emission callers
+    like tp_lm_specs, where the mesh is unknown); tp_size=1 means the
+    mesh has NO tp axis, so every named axis must be stripped or
+    NamedSharding rejects the spec on a pure-dp mesh."""
+    if tp_size is None:
+        return tuple(spec), reason
+    if tp_size <= 1:
+        return ((None,) * len(spec),
+                f"replicated (tp=1 — no tp axis in mesh; rule was: "
+                f"{reason})")
+    out = list(spec)
+    for i, axis in enumerate(spec):
+        if axis is not None and shape[i] % tp_size != 0:
+            out[i] = None
+            reason = (f"tp SKIPPED: dim {i} ({shape[i]}) not divisible "
+                      f"by tp={tp_size} — replicated (was: {reason})")
+    return tuple(out), reason
+
+
+def lm_layout(names, shape, tp="tp", min_size=LM_MIN_SIZE, tp_size=None):
+    """The LM tensor-parallel layout rule for one param.
+
+    Args:
+      names: path components of the param (e.g. ["tok_emb", "weight"]).
+      shape: the param's shape tuple.
+      tp: mesh axis name to shard over.
+      min_size: 2-D weights below this many elements replicate.
+      tp_size: when given (the axis size), non-divisible dims are
+        downgraded to replicated with a recorded reason instead of
+        emitting a spec that would fail at placement.
+
+    Returns (spec, reason): spec is a tuple of axis-name-or-None per
+    dim; reason is the human-readable decision record. Never raises.
+    """
+    names = [str(n) for n in names]
+    leaf = names[-1] if names else ""
+    ndim = len(shape)
+    size = 1
+    for d in shape:
+        size *= d
+    if leaf == "weight" and ndim == 2 and LM_VOCAB_TABLES & set(names):
+        return _downgrade(
+            (tp, None), shape, tp_size,
+            "tp: vocab dim 0 of embedding table ([V, H] vh layout; fused "
+            "xent runs per shard)")
+    if leaf == "weight" and ndim == 2 and "out_proj" in names:
+        return _downgrade(
+            (None, tp), shape, tp_size,
+            "tp: vocab dim 1 of output projection ([H, V] hv layout)")
+    if leaf == "mlm_bias" and ndim == 1:
+        return _downgrade(
+            (tp,), shape, tp_size,
+            "tp: vocab-length bias follows the table shard")
+    if ndim == 2 and size >= min_size:
+        return _downgrade(
+            (None, tp), shape, tp_size,
+            f"tp: column-shard 2-D weight (size {size} >= {min_size})")
+    return (None,) * ndim, (
+        "replicated (not an LM tp target: "
+        f"{'scalar' if ndim == 0 else f'{ndim}-D, size {size}'})")

@@ -1,0 +1,119 @@
+"""Hardware topology descriptions for the auto-parallelism planner.
+
+A :class:`Topology` is the planner's entire view of the machine: chip
+count, per-chip HBM and peak flops, and the two link classes that price
+collectives — intra-slice ICI and inter-slice/host DCN (the hierarchical
+topology of arxiv 2110.10548: placement cost depends on which links a
+collective crosses, not just payload bytes).
+
+Built-ins cover the CPU host (``cpuN`` — the tier-1/dev environment,
+matching conftest's forced virtual devices) and common TPU slice shapes
+(``v5e-8``, ``2xv5e-8`` for two slices, ...). When running live,
+:func:`detect` derives a Topology from ``jax.devices()`` instead.
+
+Numbers are *planning estimates* (peak specs, not measured), good for
+ranking candidate meshes; they are not a performance model of record.
+Stdlib-only at import — jax is pulled in lazily by :func:`detect`.
+"""
+
+import dataclasses
+import re
+
+GIB = 1024 ** 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """One machine the planner can place a mesh on."""
+    name: str
+    num_chips: int            # total chips (all slices)
+    hbm_bytes: int            # per-chip accelerator memory
+    peak_flops: float         # per-chip peak (bf16 matmul units)
+    intra_bw: float           # bytes/s per chip over in-slice links (ICI)
+    inter_bw: float           # bytes/s per chip across slices/hosts (DCN)
+    cores_per_chip: int = 1
+    num_slices: int = 1
+
+    @property
+    def chips_per_slice(self):
+        return max(1, self.num_chips // max(1, self.num_slices))
+
+    def axis_bandwidth(self, crosses_slices):
+        """Per-chip bandwidth a collective sees on this axis."""
+        return self.inter_bw if crosses_slices else self.intra_bw
+
+    def to_json(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(**d)
+
+
+# per-chip characteristics by device kind: (hbm, peak bf16 flops, ici
+# bytes/s per chip, dcn bytes/s per chip). Peaks mirror
+# observability/perf.py peak_flops(); link numbers are spec-sheet order
+# of magnitude, enough to rank dp-over-DCN vs tp-over-ICI correctly.
+_CHIPS = {
+    "cpu": (4 * GIB, 5.0e10, 2.0e10, 2.0e10),
+    "v4": (32 * GIB, 275e12, 2.4e11, 2.5e10),
+    "v5e": (16 * GIB, 197e12, 1.0e11, 2.5e10),
+    "v5p": (95 * GIB, 459e12, 4.8e11, 2.5e10),
+    "v6e": (32 * GIB, 918e12, 1.8e11, 2.5e10),
+}
+
+# "kind-N" (one slice of N chips) or "MxKIND-N" (M slices). cpuN means N
+# virtual host devices (XLA_FLAGS --xla_force_host_platform_device_count).
+_NAME_RE = re.compile(r"(?:(\d+)x)?([a-z0-9]+?)-?(\d+)$")
+
+# presets listed by the CLI; any "(Mx)kind-N" spelling parses too
+PRESETS = ("cpu1", "cpu4", "cpu8", "v5e-4", "v5e-8", "v5e-16", "v5e-64",
+           "2xv5e-16", "v4-8", "v4-32", "v5p-8", "v5p-16", "v6e-8",
+           "v6e-16")
+
+
+def get_topology(name=None, devices=None):
+    """Resolve a Topology: explicit name, else the ``autoplan_topology``
+    flag, else auto-detection from the live jax devices."""
+    if name is None:
+        from paddle_tpu.core.flags import get_flag
+        name = get_flag("autoplan_topology")
+    if not name or name == "auto":
+        return detect(devices)
+    m = _NAME_RE.match(name.strip().lower())
+    if not m or m.group(2) not in _CHIPS:
+        raise KeyError(
+            f"unknown topology {name!r} (want e.g. {', '.join(PRESETS)}, "
+            "or 'auto' to detect from jax.devices())")
+    slices = int(m.group(1)) if m.group(1) else 1
+    kind, per_slice = m.group(2), int(m.group(3))
+    hbm, peak, ici, dcn = _CHIPS[kind]
+    return Topology(name=name, num_chips=slices * per_slice,
+                    hbm_bytes=hbm, peak_flops=peak, intra_bw=ici,
+                    inter_bw=dcn, num_slices=slices)
+
+
+def detect(devices=None):
+    """Derive a Topology from the live ``jax.devices()``."""
+    import jax
+    devices = list(devices) if devices is not None else jax.devices()
+    kind = (getattr(devices[0], "device_kind", "") or "cpu").lower()
+    key = "cpu"
+    for k in ("v6e", "v5p", "v5e", "v4"):
+        if k in kind:
+            key = k
+            break
+    hbm, peak, ici, dcn = _CHIPS[key]
+    stats = getattr(devices[0], "memory_stats", None)
+    if callable(stats):
+        try:
+            limit = (stats() or {}).get("bytes_limit")
+            if limit:
+                hbm = int(limit)
+        except Exception:
+            pass  # CPU backends often have no memory_stats
+    slices = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    return Topology(name=f"detected:{key}{len(devices)}",
+                    num_chips=len(devices), hbm_bytes=hbm, peak_flops=peak,
+                    intra_bw=ici, inter_bw=dcn,
+                    num_slices=max(1, len(slices)))

@@ -55,6 +55,17 @@ class DistributedStrategy:
                 axes[name] = size
         return axes or {"dp": -1}
 
+    @classmethod
+    def from_plan(cls, plan):
+        """The strategy equivalent of an autoplan MeshPlan: mesh axes
+        from the winning factorization, pipeline schedule + microbatch
+        count from the plan's choice."""
+        pp = plan.axes.get("pp", 1)
+        return cls(dp=plan.axes.get("dp", 1),
+                   tp=plan.axes.get("tp", 1), pp=pp,
+                   pp_schedule=plan.schedule if pp > 1 else "gpipe",
+                   pp_chunks=1)
+
     def pipeline_kwargs(self):
         """kwargs for parallel.pipeline.make_pipeline_train_step matching
         this strategy's pipeline schedule (ref: PipelineOptimizer config +
@@ -78,6 +89,7 @@ class Fleet:
         self._strategy = None
         self._mesh = None
         self._barrier_gen = 0
+        self._auto_plan = None   # cached autoplan MeshPlan ("auto")
 
     # -- role / topology (ref: role_maker.py) --
     def init(self, coordinator_address=None, num_processes=None,
@@ -107,8 +119,52 @@ class Fleet:
     def is_first_worker(self):
         return self.worker_index == 0
 
+    # -- auto-parallelism (parallel/autoplan) --
+    def auto_plan(self, model_cfg=None, batch=None, seq=None, spec=None,
+                  topology=None, devices=None, allow_pp=True, **kw):
+        """Run the autoplan cost-model search and cache the winning
+        MeshPlan as this fleet's ``strategy="auto"`` resolution.
+
+        Pass a model config (+ batch/seq) or a prebuilt
+        autoplan.ModelSpec; the device budget defaults to the live
+        ``jax.devices()`` while `topology` (name or Topology) supplies
+        per-chip characteristics."""
+        from paddle_tpu.parallel import autoplan as ap
+        if spec is None:
+            enforce(model_cfg is not None and batch and seq,
+                    "fleet.auto_plan needs model_cfg + batch + seq "
+                    "(or a prebuilt spec=ModelSpec(...))")
+            spec = ap.ModelSpec.from_config(model_cfg, batch=batch,
+                                            seq=seq)
+        n = devices if devices is not None else len(jax.devices())
+        self._auto_plan = ap.plan(spec, topology=topology, devices=n,
+                                  allow_pp=allow_pp, **kw)
+        return self._auto_plan
+
+    @property
+    def mesh_plan(self):
+        """The cached autoplan MeshPlan (None until auto_plan runs)."""
+        return self._auto_plan
+
+    def _resolve_strategy(self, strategy):
+        """Map strategy='auto' (or the auto_mesh flag with no explicit
+        strategy) onto the cached MeshPlan's DistributedStrategy."""
+        if strategy is None:
+            from paddle_tpu.core.flags import get_flag
+            if get_flag("auto_mesh") and self._auto_plan is not None:
+                strategy = "auto"
+        if strategy == "auto":
+            enforce(self._auto_plan is not None,
+                    "strategy='auto' requires a prior "
+                    "fleet.auto_plan(model_cfg, batch=..., seq=...) — "
+                    "the planner must see the model and topology before "
+                    "it can choose a mesh")
+            return DistributedStrategy.from_plan(self._auto_plan)
+        return strategy
+
     # -- mesh (ref: ParallelExecutor places / nccl rings) --
     def build_mesh(self, strategy=None, devices=None):
+        strategy = self._resolve_strategy(strategy)
         strategy = strategy or self._strategy or DistributedStrategy()
         self._mesh = mesh_lib.make_mesh(strategy.mesh_axes(), devices)
         self._strategy = strategy
@@ -124,7 +180,9 @@ class Fleet:
 
         Returns an object with init/apply_gradients/minimize (GradientMerge,
         plain) or init/step (LocalSGD/GeoSGD — divergent replicas, run under
-        shard_map)."""
+        shard_map). strategy="auto" resolves through the cached
+        fleet.auto_plan(...) MeshPlan."""
+        strategy = self._resolve_strategy(strategy)
         strategy = strategy or self._strategy or DistributedStrategy()
         self._strategy = strategy
         enforce(sum(bool(x) for x in (strategy.local_sgd_steps,

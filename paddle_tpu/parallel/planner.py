@@ -34,6 +34,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.parallel.autoplan import layouts
 
 
 @dataclasses.dataclass
@@ -86,12 +87,19 @@ class DistributionPlanner:
     """Plan shardings for an arbitrary captured program's params/inputs."""
 
     def __init__(self, mesh, tp_patterns=(), tp_auto=False,
-                 fsdp_min_size=None, ep_patterns=()):
+                 fsdp_min_size=None, ep_patterns=(), lm_rules=False,
+                 lm_min_size=layouts.LM_MIN_SIZE):
         self.mesh = mesh
         self.axes = dict(mesh.shape)
         self.tp_patterns = [re.compile(p) for p in tp_patterns]
         self.tp_auto = tp_auto
         self.fsdp_min_size = fsdp_min_size
+        # lm_rules: resolve tp specs through the shared LM layout table
+        # (autoplan/layouts.py — the same source of truth as
+        # api.tp_lm_specs) before the generic pattern rules. This is the
+        # mode autoplan's MeshPlan emits shardings through.
+        self.lm_rules = lm_rules
+        self.lm_min_size = lm_min_size
         # expert-parallel: params matching these patterns shard their
         # LEADING dim (the [E, ...] expert stack convention, nn/moe.py)
         # over the "ep" axis — the pserver table-shard successor rule
@@ -111,6 +119,16 @@ class DistributionPlanner:
         for path, leaf in jax.tree_util.tree_leaves_with_path(params):
             name = _path_name(path)
             shape = tuple(getattr(leaf, "shape", ()))
+            if self.lm_rules and tp > 1:
+                t, lm_reason = layouts.lm_layout(
+                    name.split("/"), shape, min_size=self.lm_min_size,
+                    tp_size=tp)
+                if "tp" in t or lm_reason.startswith("tp SKIPPED"):
+                    # an LM rule decided (sharded, or downgraded with its
+                    # skip recorded); non-targets fall through to the
+                    # generic ep/fsdp/dp rules below
+                    entries[name] = PlanEntry(name, t, lm_reason)
+                    continue
             spec = [None] * len(shape)
             reason = "replicated (dp)"
             if ep > 1 and shape and any(
@@ -127,11 +145,17 @@ class DistributionPlanner:
                     self.tp_auto
                     or any(rx.search(name) for rx in self.tp_patterns)):
                 dim = self._largest_divisible_dim(shape, tp)
+                suffix = ("; " + reason
+                          if reason.startswith("ep SKIPPED") else "")
                 if dim is not None:
                     spec[dim] = "tp"
-                    suffix = ("; " + reason
-                              if reason.startswith("ep SKIPPED") else "")
                     reason = f"tp: dim {dim} over {tp}" + suffix
+                else:
+                    # tp matched but no dim divides: skip with the
+                    # decision recorded (never raise mid-plan) — the
+                    # param stays replicated and may still pick up fsdp
+                    reason = (f"tp SKIPPED: no dim of {shape} divisible "
+                              f"by tp={tp}" + suffix)
             min_size = (self.fsdp_min_size if self.fsdp_min_size is not None
                         else 0)  # None = shard everything over fsdp
             if "tp" not in spec and "ep" not in spec and fsdp > 1 \
@@ -140,8 +164,8 @@ class DistributionPlanner:
                 dim = self._largest_divisible_dim(shape, fsdp)
                 if dim is not None:
                     spec[dim] = "fsdp"
-                    suffix = ("; " + reason
-                              if reason.startswith("ep SKIPPED") else "")
+                    suffix = ("; " + reason if "SKIPPED" in reason
+                              else "")
                     reason = f"fsdp: dim {dim} over {fsdp}" + suffix
             entries[name] = PlanEntry(name, tuple(spec), reason)
         return entries

@@ -17,6 +17,7 @@ parity comes from optional sparse-table pull/push hooks around each step
 (parallel/sparse.HostTable — rows cross PCIe, exactly PSLib's flow).
 """
 
+import contextlib
 import dataclasses
 import queue
 import signal
@@ -99,6 +100,11 @@ class TrainerConfig:
     # function's jit cache is polled for retraces (jit.retraces{fn=
     # trainer.step}) — all host-side, nothing added to the device path.
     watchdog: object = None
+    # auto-parallelism (parallel/autoplan): a MeshPlan — the train loop
+    # runs inside the planned mesh context and stages batches dp-sharded
+    # over it, so a step_fn jitted against the plan's shardings consumes
+    # Trainer batches with no per-call placement code
+    mesh_plan: object = None
 
 
 class _EndOfData:
@@ -380,6 +386,28 @@ class Trainer:
             # host->device transfer starts now, overlapping the running step
             return tuple(jax.device_put(a) for a in batch)
 
+        plan = cfg.mesh_plan
+        plan_mesh = None
+        if plan is not None:
+            # autoplan MeshPlan: stage batches dp-sharded onto the planned
+            # mesh (leading dim over "dp" when divisible; replicated
+            # otherwise) and run the loop inside the mesh context so the
+            # jitted step resolves the plan's axis names
+            plan_mesh = plan.build_mesh()
+            from jax.sharding import NamedSharding, PartitionSpec
+            plan_dp = plan.axes.get("dp", 1)
+
+            def stage(batch):  # noqa: F811 — plan-aware staging
+                def put(a):
+                    nd = getattr(a, "ndim", 0)
+                    spec = (PartitionSpec("dp")
+                            if plan_dp > 1 and nd >= 1
+                            and a.shape[0] % plan_dp == 0
+                            else PartitionSpec())
+                    return jax.device_put(
+                        a, NamedSharding(plan_mesh, spec))
+                return tuple(put(a) for a in batch)
+
         def get_item():
             tw0 = time.perf_counter()
             item = chan.get()
@@ -404,6 +432,9 @@ class Trainer:
 
         clean = False
         preempted_sig = None
+        mesh_scope = contextlib.ExitStack()
+        if plan_mesh is not None:
+            mesh_scope.enter_context(plan_mesh)
         try:
             with span("ingest"):
                 nxt = next_batch()
@@ -464,6 +495,7 @@ class Trainer:
                         nxt = next_batch()
             clean = preempted_sig is None
         finally:
+            mesh_scope.close()
             stop.set()  # release producers even when step_fn raises
             restore_signals()
             # a preempted worker is NOT complete: no done marker — peers

@@ -50,16 +50,19 @@ def _mesh_devices(mesh):
 
 
 def run(model="gpt", tiny=False, timeout=600, extra_env=None, mesh=None,
-        batch=None, seq=None, dump_hlo=None):
+        batch=None, seq=None, dump_hlo=None, devices=None):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the tunnel
     env["JAX_PLATFORMS"] = "cpu"
     if mesh:
+        # '--mesh auto' has no explicit sizes; the caller must say how
+        # many fake devices to fabricate (devices=)
+        n = devices if devices is not None else _mesh_devices(mesh)
         flags = " ".join(
             f for f in env.get("XLA_FLAGS", "").split()
             if not f.startswith("--xla_force_host_platform_device_count"))
         env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
-                            f"count={_mesh_devices(mesh)}").strip()
+                            f"count={n}").strip()
     env.update(extra_env or {})
     args = [sys.executable, os.path.join(REPO, "bench.py"),
             "--compile-only", "--model", model]
@@ -177,6 +180,37 @@ def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
     return out
 
 
+def autoplan_check(model="gpt", topology="cpu4", timeout=600):
+    """Compile ``bench.py --mesh auto`` — the autoplan search resolves
+    the mesh from the named topology on fake CPU devices — and evaluate
+    the model's ``train.<model>@auto`` CONTRACTS row against the
+    compiled per-device HLO. The acceptance gate for the planner: its
+    winning mesh must not just compile, it must compile CLEAN under the
+    same NoTemporary/no-vocab-all-gather judgments as the hand-picked
+    dp2,tp2 row."""
+    c = _contracts()
+    case = c.SHARDED_TRAIN_CASES[model]
+    m = re.fullmatch(r"(?:\d+x)?[a-z0-9]+?-?(\d+)", topology)
+    if not m:
+        raise SystemExit(f"unparseable topology {topology!r}")
+    devices = int(m.group(1))
+    env = {"PT_FLAGS_autoplan_topology": topology,
+           "PT_FLAGS_xent_chunk": "64"}
+    out = {"model": model, "topology": topology, "devices": devices}
+    with tempfile.TemporaryDirectory() as td:
+        hlo = os.path.join(td, "auto.hlo")
+        row = run(model=model, tiny=True, timeout=timeout, mesh="auto",
+                  batch=case.batch, seq=case.seq, dump_hlo=hlo,
+                  extra_env=env, devices=devices)
+        text = open(hlo).read()
+        violations = c.evaluate(c.CONTRACTS[f"train.{model}@auto"],
+                                c.ContractContext(hlo_text=text))
+        out.update(row=row, plan=row.get("autoplan"),
+                   violations=[v.format() for v in violations],
+                   clean=not violations)
+    return out
+
+
 # serve-probe shapes: every dim distinct from TMAX=48 (vocab 512, hidden
 # 64, ffn 128, heads 4, hd 16, page 8, pages 13, slots 2, prefill 16) so
 # the detector can key on the padded slot capacity alone. min_rows=8
@@ -284,12 +318,23 @@ def main():
                     help="with --mesh: enforce the sharded-HLO contract "
                          "(no [rows, V] temporary, no vocab-weight "
                          "all-gather) with a positive control")
+    ap.add_argument("--autoplan", metavar="TOPOLOGY", default=None,
+                    help="autoplan probe: resolve the mesh via "
+                         "--mesh auto on the named topology (e.g. cpu4) "
+                         "and enforce the train.<model>@auto HLO "
+                         "contract")
     ap.add_argument("--serve", action="store_true",
                     help="serving fast-path probe: the jitted serve step "
                          "compiles once across admissions and its paged "
                          "HLO holds no [rows, Tmax]-dense attention "
                          "temporary (positive control included)")
     args = ap.parse_args()
+    if args.autoplan:
+        out = autoplan_check(args.model, args.autoplan, args.timeout)
+        print(json.dumps(out))
+        if not out["clean"]:
+            raise SystemExit("autoplan-mesh HLO contract violated")
+        return
     if args.serve:
         out = serve_smoke()
         print(json.dumps(out))

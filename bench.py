@@ -844,6 +844,89 @@ def _enable_compile_cache():
         print(f"compile cache unavailable: {e}", file=sys.stderr)
 
 
+def _autotune_presweep(args):
+    """--autotune: sweep the Pallas tile space eagerly at this row's
+    flagship kernel shapes BEFORE the jitted step traces. A traced
+    contact can only consume the tile cache (sweeps need eager
+    execution), so without this the flag would quietly bench the static
+    defaults. Returns the sweep wall time; the chosen tiles ride along
+    in the row JSON (``autotune`` key) so a BENCH artifact records which
+    tiles the run had. Failures degrade to the untuned defaults —
+    autotuning must never sink a bench row."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"autotune": True})
+    fam = {"bert": "mlm", "ernie": "mlm", "gpt": "lm", "gpt_decode": "lm",
+           "gpt_serve": "lm"}.get(args.model)
+    if fam is None:  # resnet/ctr/transformer_big: no autotuned kernels;
+        return 0.0   # the flag is on, the jitted step just finds no cache
+    t0 = time.monotonic()
+    batch = args.batch or {"bert": 64, "ernie": 64, "gpt": 16,
+                           "gpt_decode": 16, "gpt_serve": 8}[args.model]
+    seq = args.seq
+    if args.model == "bert":
+        from paddle_tpu.models.bert import BertConfig as _C
+    elif args.model == "ernie":
+        from paddle_tpu.models.ernie import ErnieConfig as _C
+    else:
+        from paddle_tpu.models.gpt import GPTConfig as _C
+    cfg = _C.tiny() if TINY else (_C.base() if fam == "mlm" else _C.small())
+    causal = fam == "lm"
+    # the bench steps run under the amp bf16 policy — sweep the same
+    # dtype or the cache signatures won't match the traced lookups
+    from paddle_tpu.ops.pallas import on_tpu
+    dtype = jnp.bfloat16 if on_tpu() else jnp.float32
+    rng = np.random.RandomState(0)
+
+    def arr(*s):
+        import jax.numpy as jnp
+        return jnp.asarray(0.02 * rng.randn(*s), dtype)
+
+    rows = batch * seq
+    # bert/ernie gather masked positions before the vocab fc
+    rows_x = batch * max(1, int(0.15 * seq)) if fam == "mlm" else rows
+    hd = cfg.hidden_size // cfg.num_heads
+    try:
+        if hd % 64 == 0 and seq % 8 == 0:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention
+            q = arr(batch, cfg.num_heads, seq, hd)
+            flash_attention(q, q, q, causal=causal).block_until_ready()
+        from paddle_tpu.ops.pallas.layer_norm import layer_norm_fused
+        layer_norm_fused(arr(rows, cfg.hidden_size), arr(cfg.hidden_size),
+                         arr(cfg.hidden_size)).block_until_ready()
+        from paddle_tpu.ops.pallas.mlp import fused_mlp
+        fused_mlp(arr(rows, cfg.hidden_size),
+                  arr(cfg.hidden_size, cfg.intermediate_size),
+                  arr(cfg.intermediate_size),
+                  arr(cfg.intermediate_size, cfg.hidden_size),
+                  arr(cfg.hidden_size)).block_until_ready()
+        from paddle_tpu.ops.pallas.xent import xent_stats
+        import jax.numpy as jnp
+        lbl = jnp.asarray(rng.randint(0, cfg.vocab_size, rows_x), jnp.int32)
+        st = xent_stats(arr(rows_x, cfg.hidden_size),
+                        arr(cfg.vocab_size, cfg.hidden_size),
+                        arr(cfg.vocab_size), lbl)
+        if st is not None:
+            st[0].block_until_ready()
+    except Exception as e:
+        print(f"autotune presweep failed (benching untuned): {e}",
+              file=sys.stderr)
+    return round(time.monotonic() - t0, 2)
+
+
+def _autotune_row(presweep_s):
+    """The ``autotune`` block of the row JSON: the chip's chosen tiles
+    per (kernel, signature) plus where they came from."""
+    from paddle_tpu.ops.pallas import autotune
+    chip = autotune.chip_key()
+    entries = autotune.cache().load().entries
+    tiles = {k.rsplit("|", 1)[0]: v.get("blocks")
+             for k, v in sorted(entries.items())
+             if k.endswith("|" + chip)}
+    return {"cache": autotune.cache().path, "chip": chip,
+            "presweep_s": presweep_s, "tiles": tiles}
+
+
 def _run_inner(args):
     global COMPILE_ONLY, TINY, DUMP_HLO, MESH_AXES, RUN_LOG
     COMPILE_ONLY = bool(getattr(args, "compile_only", False))
@@ -861,6 +944,9 @@ def _run_inner(args):
     _enable_compile_cache()
     if os.environ.get("PT_BENCH_FORCE_FAIL"):  # self-test hook for the
         raise RuntimeError("forced failure")   # outer error-JSON path
+    presweep_s = None
+    if getattr(args, "autotune", False):
+        presweep_s = _autotune_presweep(args)
     if args.model == "bert":
         res = bench_bert(args.steps, args.batch or 64, args.seq,
                          use_flash=args.flash)
@@ -884,6 +970,11 @@ def _run_inner(args):
         res = bench_ctr(args.steps, args.batch or 512)
     else:
         res = bench_resnet(args.steps, args.batch or 128)
+    if presweep_s is not None:
+        try:
+            res["autotune"] = _autotune_row(presweep_s)
+        except Exception as e:
+            res["autotune"] = {"error": str(e)[:200]}
     if "mfu" in res:
         res["vs_baseline"] = round(res["mfu"] / 0.45, 4)
     else:  # bandwidth-bound rows (decode) have no meaningful MFU framing
@@ -1106,6 +1197,11 @@ def main():
                     help="with --compile-only: write the compiled (post-"
                          "SPMD) HLO text here (tools/compile_smoke.py "
                          "asserts no full-vocab temporaries on it)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="pre-sweep the Pallas tile space at this row's "
+                         "kernel shapes (eager, cached), then bench with "
+                         "the tuned tiles; the chosen tiles are recorded "
+                         "in the row JSON under 'autotune'")
     ap.add_argument("--run-log", default=None,
                     help="stream a per-step RunLog (observability JSONL) "
                          "of the timed bench steps here; suite mode "

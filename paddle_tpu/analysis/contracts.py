@@ -312,6 +312,31 @@ def sharded_train_contracts(model, dp=2, tp=2):
     ]
 
 
+# fused-MLP probe dims: rows=512, H=256, I=1024 (the 4H convention).
+# I must exceed the kernel's 512 intermediate-tile cap so the fused path
+# genuinely blocks the I axis — at I <= 512 the single [rows, I] block
+# IS the activation and the detector could not tell fused from unfused.
+# MLP_MIN_ROWS sits above H=256 so the [H, I] / [I, H] weights
+# (legitimate I-axis residents) never trip; the [512, 1024] activation
+# of the unfused composition does.
+MLP_ROWS = 512
+MLP_HIDDEN = 256
+MLP_INTER = 1024
+MLP_MIN_ROWS = 320
+
+
+def fused_mlp_contracts(inter=MLP_INTER, min_rows=MLP_MIN_ROWS):
+    """The fused GLU/MLP forward contract: the [rows, 4H] activation
+    never materializes in the compiled module (the kernel streams
+    I-axis tiles through a [block_rows, H] accumulator)."""
+    return [
+        NoTemporary({inter}, min_rows,
+                    what="[rows, 4H] MLP activation temporary"),
+        MaxDtypeWidth(32),
+        NoHostCallback(),
+    ]
+
+
 SERVE_TMAX = 48
 SERVE_MIN_ROWS = 8
 
@@ -349,4 +374,5 @@ CONTRACTS = {
         sharded_train_contracts("transformer_big"),
     "serve.decode": serve_decode_contracts(),
     "serve.prefill": serve_prefill_contracts(),
+    "mlp.fused": fused_mlp_contracts(),
 }

@@ -6,4 +6,4 @@ a fixture test can instantiate a single rule against a planted tree.
 
 from paddle_tpu.analysis.rules import (  # noqa: F401
     catalog_drift, fault_point_drift, flag_drift, hot_path_sync,
-    no_committed_logs, tracer_leak)
+    no_committed_logs, raw_pallas_call, tracer_leak)

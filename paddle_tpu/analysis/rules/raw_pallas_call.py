@@ -1,0 +1,60 @@
+"""raw-pallas-call: every ``pl.pallas_call`` must live in
+ops/pallas/core.py.
+
+The shared primitive core (ops/pallas/core.py kernel_call) owns
+interpret-mode plumbing, grid/grid_spec handling, and the fallback
+telemetry contract; a kernel calling ``pl.pallas_call`` directly
+re-opens the per-kernel drift the PR-11 refactor closed (private
+interpret flags, missed autotune hooks, untracked fallbacks). The rule
+is the enforcement half of that refactor: new kernels route through
+:func:`kernel_call` or they are a finding.
+"""
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules._common import call_name, walk_calls
+
+
+@register
+class RawPallasCall(Rule):
+    name = "raw-pallas-call"
+    help = ("pl.pallas_call outside ops/pallas/core.py — kernels must "
+            "route through the shared kernel_call wrapper")
+
+    DEFAULT_ALLOWED = "paddle_tpu/ops/pallas/core.py"
+    DEFAULT_SCOPE = ("paddle_tpu/**/*.py", "paddle_tpu/*.py")
+    MIN_SITES = 1   # core.py holds the one real site; 0 => detection rotted
+
+    def __init__(self, allowed=None, scope=None, min_sites=None):
+        self.allowed = allowed or self.DEFAULT_ALLOWED
+        self.scope = tuple(scope or self.DEFAULT_SCOPE)
+        self.min_sites = (self.MIN_SITES if min_sites is None
+                          else min_sites)
+
+    def sites(self, ctx):
+        """[(relpath, lineno), ...] of every pallas_call call site,
+        the allowed wrapper module included."""
+        out = []
+        for sf in ctx.glob(*self.scope):
+            if sf.tree is None:
+                continue
+            for call in walk_calls(sf.tree):
+                cn = call_name(call)
+                if cn is not None and cn.split(".")[-1] == "pallas_call":
+                    out.append((sf.relpath, call.lineno))
+        return out
+
+    def check(self, ctx):
+        sites = self.sites(ctx)
+        if len(sites) < self.min_sites:
+            yield Finding(
+                self.name, self.allowed, 1,
+                f"only {len(sites)} pallas_call sites detected "
+                f"(expected >= {self.min_sites}) — the site detection "
+                "rotted")
+        for rel, lineno in sorted(sites):
+            if rel != self.allowed:
+                yield Finding(
+                    self.name, rel, lineno,
+                    "pl.pallas_call outside the shared wrapper — use "
+                    "ops/pallas/core.py kernel_call (owns interpret "
+                    "mode, grid plumbing, and fallback telemetry)")

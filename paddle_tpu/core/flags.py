@@ -235,3 +235,20 @@ define_flag("autoplan_hbm_fraction", 0.9,
             "Fraction of per-chip HBM the planner may budget; candidates "
             "whose memory estimate exceeds it are pruned with a recorded "
             "reason.")
+# Pallas tile autotuner (ops/pallas/autotune.py): sweep candidate block
+# sizes on first eager contact with a (kernel, shape, chip) triple, cache
+# winners, and feed measured achieved-flops/s into the autoplan cost model
+define_flag("autotune", False,
+            "Autotune Pallas kernel tile sizes: sweep candidate block "
+            "shapes on first eager contact with a (kernel, shape, chip) "
+            "triple and reuse the cached winner afterwards; False keeps "
+            "the static defaults.")
+define_flag("autotune_cache", "/tmp/paddle_tpu_autotune.json",
+            "JSON cache file for autotuned tile winners (and the measured "
+            "per-tile times the autoplan cost model consumes).")
+# fused MLP/GLU block (ops/pallas/mlp.py) — the first kernel built on the
+# shared primitive core; used by the GPT/BERT feed-forward
+define_flag("use_pallas_mlp", True,
+            "Route the transformer feed-forward through the fused Pallas "
+            "MLP kernel (never materializes the [rows, intermediate] "
+            "activation in HBM); False keeps the unfused XLA composition.")

@@ -66,7 +66,7 @@ class TransformerLayer(nn.Module):
     def forward(self, x, mask=None):
         h = self.attn(x, mask=mask)
         x = self.ln1(self.drop(h), residual=x)   # fused add+LN
-        h = self.fc2(A.gelu(self.fc1(x)))
+        h = nn.fused_ffn(self.fc1, self.fc2, x)
         x = self.ln2(self.drop(h), residual=x)
         return x
 

@@ -75,7 +75,7 @@ class GPTBlock(nn.Module):
     def _ffn(self, x):
         if self.cfg.moe_experts:
             return self.mlp(x)
-        return self.fc2(A.gelu(self.fc1(x)))
+        return nn.fused_ffn(self.fc1, self.fc2, x)
 
     def forward(self, x):
         # pre-norm residual blocks (GPT-2 style)

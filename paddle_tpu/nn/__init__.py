@@ -30,6 +30,7 @@ from paddle_tpu.nn.layers import (
     SpectralNorm,
     SyncBatchNorm,
     TreeConv,
+    fused_ffn,
     tied_vocab_head,
 )
 

@@ -60,6 +60,22 @@ class Linear(Module):
         return _act(self.act, out)
 
 
+def fused_ffn(fc1, fc2, x, act="gelu"):
+    """The transformer feed-forward ``fc2(act(fc1(x)))`` routed through
+    the fused Pallas MLP kernel (ops/pallas/mlp.py) when it applies —
+    the [rows, intermediate] activation never reaches HBM. Quantized
+    layers (weight-only int8) and layers with their own fused activation
+    keep the unfused path: the int8 mixed-dtype dot is its own kernel."""
+    if (fc1.has_p("weight_q") or fc2.has_p("weight_q")
+            or fc1.act is not None or fc2.act is not None):
+        return fc2(_act(act, fc1(x)))
+    from paddle_tpu.ops.pallas.mlp import fused_mlp
+    return fused_mlp(x, fc1.p("weight"),
+                     fc1.p("bias") if fc1.has_bias else None,
+                     fc2.p("weight"),
+                     fc2.p("bias") if fc2.has_bias else None, act=act)
+
+
 class Conv2D(Module):
     """ref: dygraph/nn.py Conv2D — weight OIHW (NCHW) or HWIO (NHWC).
 

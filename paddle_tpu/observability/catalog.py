@@ -24,6 +24,14 @@ CATALOG = {
     "autoplan.plan_s": MetricSpec(
         "histogram", (),
         "Wall time of one autoplan search (enumerate + price + rank)."),
+    # ops/pallas/autotune.py
+    "autotune.cache": MetricSpec(
+        "counter", ("event",),
+        "Autotune tile-cache lookups by event (hit | miss | corrupt)."),
+    "autotune.sweeps": MetricSpec(
+        "counter", ("kernel",),
+        "Tile-shape sweeps run by the Pallas autotuner (first eager "
+        "contact with a kernel/shape/chip triple)."),
     # bench.py
     "bench.step_time_s": MetricSpec(
         "histogram", (), "Per-step wall time of a timed bench window."),

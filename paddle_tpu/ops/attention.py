@@ -156,25 +156,25 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     over page tiles. Elsewhere, or with use_pallas_decode=False: the XLA
     gather-and-mask formulation (same semantics, dense temporaries)."""
     from paddle_tpu.core.flags import get_flag
-    from paddle_tpu.ops.pallas import log_fallback, on_tpu
+    from paddle_tpu.ops.pallas.core import kernel_mode
     scale = (float(scale) if scale is not None
              else 1.0 / (q.shape[-1] ** 0.5))
     page_size = k_pages.shape[2]
-    if get_flag("use_pallas_decode"):
-        interpret = get_flag("pallas_interpret")
-        if (on_tpu() or interpret):
-            from paddle_tpu.ops.pallas.decode_attention import (
-                paged_decode_attention_tpu, pltpu)
-            if pltpu is not None and page_size % 8 == 0 \
-                    and (interpret or q.shape[-1] % 64 == 0):
-                return paged_decode_attention_tpu(
-                    q, k_pages, v_pages, page_table, lengths, scale,
-                    interpret=interpret)
-            log_fallback(
-                "decode_attention",
-                f"page_size={page_size} not a multiple of 8 or "
-                f"hd={q.shape[-1]} not a multiple of 64 "
-                "(supported: page_size%8==0, hd%64==0 on silicon)")
+    interpret = get_flag("pallas_interpret")
+    shape_ok = (page_size % 8 == 0
+                and (interpret or q.shape[-1] % 64 == 0))
+    mode = kernel_mode(
+        "decode_attention", enable_flag="use_pallas_decode",
+        unsupported=None if shape_ok else (
+            f"page_size={page_size} not a multiple of 8 or "
+            f"hd={q.shape[-1]} not a multiple of 64 "
+            "(supported: page_size%8==0, hd%64==0 on silicon)"))
+    if mode is not None:
+        from paddle_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention_tpu)
+        return paged_decode_attention_tpu(
+            q, k_pages, v_pages, page_table, lengths, scale,
+            interpret=interpret)
     return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
                                 scale)
 

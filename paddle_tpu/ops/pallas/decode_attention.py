@@ -31,12 +31,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-NEG_INF = -1e30
+from paddle_tpu.ops.pallas.core import (NEG_INF, kernel_call, pltpu,
+                                        softmax_finalize, softmax_init,
+                                        softmax_update)
 
 
 def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
@@ -47,9 +44,7 @@ def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        softmax_init(m_scr, l_scr, acc_scr)
 
     length = lens_ref[s]
 
@@ -63,25 +58,16 @@ def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32) * scale  # [H, ps]
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
-        valid = pos < length                            # [1, ps] -> rows
-        sc = jnp.where(valid, sc, NEG_INF)
-        m_prev = m_scr[:]                               # [H, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
-        # mask p, not just scores: with the whole tile masked m_new stays
-        # at the NEG_INF sentinel and exp(sc - m_new) would be 1
-        p = jnp.where(valid, jnp.exp(sc - m_new), 0.0)  # [H, ps]
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        valid = pos < length                 # [1, ps] broadcasts over heads
+        p, alpha = softmax_update(sc, m_scr, l_scr,
+                                  jnp.broadcast_to(valid, sc.shape))
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)         # [H, hd]
-        m_scr[:] = m_new
 
     @pl.when(j == nj - 1)
     def _finalize():
-        l = l_scr[:]
-        o_ref[0] = jnp.where(l > 0, acc_scr[:] / jnp.maximum(l, 1e-30),
-                             0.0).astype(o_ref.dtype)
+        o_ref[0] = softmax_finalize(l_scr[:], acc_scr[:], o_ref.dtype)
 
 
 def paged_decode_attention_tpu(q, k_pages, v_pages, page_table, lengths,
@@ -113,8 +99,9 @@ def paged_decode_attention_tpu(q, k_pages, v_pages, page_table, lengths,
             pltpu.VMEM((h, hd), jnp.float32),
         ],
     )
-    return pl.pallas_call(
+    return kernel_call(
         kernel,
+        name="decode_attention",
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_slots, h, hd), q.dtype),
         interpret=interpret,

@@ -9,7 +9,9 @@ so the MXU stays fed from on-chip memory.
 Layout: q,k,v [B, H, T, D]. Grid (B*H, Tq/BQ, Tk/BK); the kv axis is the
 innermost (sequential on TPU), carrying the online-softmax state (running
 max m, running sum l, unnormalized accumulator acc) in VMEM scratch across
-kv steps. fp32 accumulation regardless of input dtype.
+kv steps. fp32 accumulation regardless of input dtype. The tiling,
+masking, and (m, l, acc) combiner all come from ops/pallas/core.py — this
+module contributes only the attention math.
 
 Masking: `kv_mask` [B, Tk] (True = attend) covers the padded-batch case —
 the mask the reference's fused multihead path handles via the eltwise-add
@@ -43,14 +45,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-from paddle_tpu.ops.pallas import describe_sharding, log_fallback, on_tpu
-
-NEG_INF = -1e30
+from paddle_tpu.ops.pallas import describe_sharding, log_fallback
+from paddle_tpu.ops.pallas.core import (NEG_INF, block_valid, kernel_call,
+                                        kernel_mode, legal_block,
+                                        softmax_finalize, softmax_init,
+                                        softmax_update, tail_zero,
+                                        tail_zero_row, tile_spec)
 
 logger = logging.getLogger("paddle_tpu.flash")
 
@@ -59,51 +59,6 @@ def _log_fallback(reason):
     """One-time notice when the Pallas fast path is refused — so a user
     benchmarking "flash" knows they are measuring the chunked fallback."""
     log_fallback("flash_attention", reason)
-
-
-def _block_valid(qi, ki, *, block_q, block_k, tq, tk, causal, causal_offset,
-                 mask_row):
-    """[BQ, BK] validity for this tile: tail rows/cols past the true
-    sequence end, the causal triangle, and the kv padding mask. Returns
-    None when every position is valid (no masking work needed)."""
-    valid = None
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-
-    def _and(a, b):
-        return b if a is None else a & b
-
-    if tq % block_q:
-        valid = _and(valid, q_pos < tq)
-    if tk % block_k:
-        valid = _and(valid, k_pos < tk)
-    if causal:
-        valid = _and(valid, q_pos + causal_offset >= k_pos)
-    if mask_row is not None:
-        valid = _and(valid, mask_row > 0)      # (1, BK) broadcasts over rows
-    return valid
-
-
-def _tail_zero(x, idx, block, t):
-    """Zero the rows of a loaded [block, D] tile that lie past the true
-    sequence end t. Pallas pads out-of-bounds block regions with undefined
-    values (NaN in interpret mode) and 0 * NaN = NaN, so masking the
-    probabilities alone is not enough — the operands themselves must be
-    clean before they enter a matmul. Static no-op when block divides t."""
-    if t % block == 0:
-        return x
-    rows = idx * block + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
-    return jnp.where(rows < t, x, 0.0)
-
-
-def _tail_zero_row(x, idx, block, t):
-    """Same for a (1, block) lane-major tile (lse/delta)."""
-    if t % block == 0:
-        return x
-    cols = idx * block + jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
-    return jnp.where(cols < t, x, 0.0)
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
@@ -119,38 +74,23 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        softmax_init(m_scr, l_scr, acc_scr)
 
     def _step():
-        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
-        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
-        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
+        q = tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BQ, BK]
-        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
-                             tq=tq, tk=tk, causal=causal,
-                             causal_offset=causal_offset,
-                             mask_row=mask_ref[0] if has_mask else None)
-        if valid is not None:
-            s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_scr[:]                            # [BQ, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                       # [BQ, BK]
-        if valid is not None:
-            # mask p, not just s: in a fully-masked row m_new stays at the
-            # NEG_INF sentinel and exp(s - m_new) = exp(0) = 1 — without
-            # this, masked positions would contribute weight 1 each
-            p = jnp.where(valid, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)              # [BQ, 1]
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        valid = block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                            tq=tq, tk=tk, causal=causal,
+                            causal_offset=causal_offset,
+                            mask_row=mask_ref[0] if has_mask else None)
+        p, alpha = softmax_update(s, m_scr, l_scr, valid)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[:] = m_new
 
     if causal:
         # skip kv blocks entirely above the diagonal — sound with or
@@ -164,27 +104,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q, block_k,
     @pl.when(ki == nk - 1)
     def _finalize():
         l = l_scr[:]
-        l_safe = jnp.maximum(l, 1e-30)
-        # fully-masked rows (l == 0): define the output as exactly zero in
-        # every path (chunked_attention matches)
-        o_ref[0] = jnp.where(l > 0, acc_scr[:] / l_safe, 0.0).astype(
-            o_ref.dtype)
-        lse_ref[0] = jnp.transpose(m_scr[:] + jnp.log(l_safe), (1, 0))
-
-
-def _legal_block(block, t, interpret=False):
-    """Largest Mosaic-tileable block ≤ the request. lse/delta/mask ride
-    with the block size in the lane dimension, which Mosaic accepts only
-    when it is a multiple of 128 or covers the whole sequence — a perf
-    knob, never semantics, so silently legalize rather than fall back.
-    Interpret mode does NOT legalize: the interpreter has no tiling rule,
-    and the CPU suite's small-block cases (block 8/16/32 at T ≤ 128) are
-    what exercise the multi-block online-softmax, tail-masking, and
-    causal block-skip paths."""
-    b = min(block, t)
-    if interpret or b == t or b % 128 == 0:
-        return b
-    return (b // 128) * 128 if b >= 128 else min(t, 128)
+        o_ref[0] = softmax_finalize(l, acc_scr[:], o_ref.dtype)
+        lse_ref[0] = jnp.transpose(
+            m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), (1, 0))
 
 
 def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
@@ -192,14 +114,15 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
+    from paddle_tpu.ops.pallas.core import pltpu
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bh = b * h
     q3 = q.reshape(bh, tq, d)
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
-    block_q = _legal_block(block_q, tq, interpret)
-    block_k = _legal_block(block_k, tk, interpret)
+    block_q = legal_block(block_q, tq, interpret)
+    block_k = legal_block(block_k, tk, interpret)
     grid = (bh, pl.cdiv(tq, block_q), pl.cdiv(tk, block_k))
     has_mask = kv_mask is not None
     kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
@@ -207,22 +130,23 @@ def _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q, block_k,
                                causal_offset=tk - tq, tq=tq, tk=tk,
                                has_mask=has_mask)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        tile_spec((1, block_q, d), (0, 1, None)),
+        tile_spec((1, block_k, d), (0, 2, None)),
+        tile_spec((1, block_k, d), (0, 2, None)),
     ]
     operands = [q3, k3, v3]
     if has_mask:
         in_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bhi, qi, ki: (bhi // h, 0, ki)))
         operands.append(kv_mask.astype(jnp.int32).reshape(b, 1, tk))
-    out, lse = pl.pallas_call(
+    out, lse = kernel_call(
         kernel,
+        name="flash_attention",
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
+            tile_spec((1, block_q, d), (0, 1, None)),
+            tile_spec((1, 1, block_q), (0, None, 1)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
@@ -270,19 +194,19 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _step():
-        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
-        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
-        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
-        do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
-        lse = _tail_zero_row(lse_ref[0], qi, block_q, tq)
-        dlt = _tail_zero_row(dlt_ref[0], qi, block_q, tq)
+        q = tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
+        do = tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
+        lse = tail_zero_row(lse_ref[0], qi, block_q, tq)
+        dlt = tail_zero_row(dlt_ref[0], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
-                             tq=tq, tk=tk, causal=causal,
-                             causal_offset=causal_offset,
-                             mask_row=mask_ref[0] if has_mask else None)
+        valid = block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                            tq=tq, tk=tk, causal=causal,
+                            causal_offset=causal_offset,
+                            mask_row=mask_ref[0] if has_mask else None)
         p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -323,19 +247,19 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, *rest,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _step():
-        q = _tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
-        k = _tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
-        v = _tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
-        do = _tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
-        lse = _tail_zero_row(lse_ref[0], qi, block_q, tq)
-        dlt = _tail_zero_row(dlt_ref[0], qi, block_q, tq)
+        q = tail_zero(q_ref[0].astype(jnp.float32), qi, block_q, tq)
+        k = tail_zero(k_ref[0].astype(jnp.float32), ki, block_k, tk)
+        v = tail_zero(v_ref[0].astype(jnp.float32), ki, block_k, tk)
+        do = tail_zero(do_ref[0].astype(jnp.float32), qi, block_q, tq)
+        lse = tail_zero_row(lse_ref[0], qi, block_q, tq)
+        dlt = tail_zero_row(dlt_ref[0], qi, block_q, tq)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        valid = _block_valid(qi, ki, block_q=block_q, block_k=block_k,
-                             tq=tq, tk=tk, causal=causal,
-                             causal_offset=causal_offset,
-                             mask_row=mask_ref[0] if has_mask else None)
+        valid = block_valid(qi, ki, block_q=block_q, block_k=block_k,
+                            tq=tq, tk=tk, causal=causal,
+                            causal_offset=causal_offset,
+                            mask_row=mask_ref[0] if has_mask else None)
         p = _bwd_p(s, lse, valid)                    # [BQ, BK]
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -367,6 +291,7 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
+    from paddle_tpu.ops.pallas.core import pltpu
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bh = b * h
@@ -379,8 +304,8 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
     do3 = do.reshape(bh, tq, d)
     lse2 = lse.reshape(bh, 1, tq)
     dlt2 = delta.reshape(bh, 1, tq)
-    block_q = _legal_block(block_q, tq, interpret)
-    block_k = _legal_block(block_k, tk, interpret)
+    block_q = legal_block(block_q, tq, interpret)
+    block_k = legal_block(block_k, tk, interpret)
     nq = pl.cdiv(tq, block_q)
     nk = pl.cdiv(tk, block_k)
     offset = tk - tq
@@ -390,49 +315,52 @@ def _flash_attention_bwd_tpu(q, k, v, out, lse, do, scale, causal,
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, causal_offset=offset, tq=tq, tk=tk,
                   has_mask=has_mask)
+    # dq grid (bh, nq, nk): grid axis 1 picks q blocks, axis 2 kv blocks
     q_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, qi, ki: (bhi, 0, qi)),
+        tile_spec((1, block_q, d), (0, 1, None)),
+        tile_spec((1, block_k, d), (0, 2, None)),
+        tile_spec((1, block_k, d), (0, 2, None)),
+        tile_spec((1, block_q, d), (0, 1, None)),
+        tile_spec((1, 1, block_q), (0, None, 1)),
+        tile_spec((1, 1, block_q), (0, None, 1)),
     ]
     q_ops = [q3, k3, v3, do3, lse2, dlt2]
     if has_mask:
         q_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bhi, qi, ki: (bhi // h, 0, ki)))
         q_ops.append(mask_i32)
-    dq = pl.pallas_call(
+    dq = kernel_call(
         functools.partial(_fa_bwd_dq_kernel, **common),
+        name="flash_attention_bwd_dq",
         grid=(bh, nq, nk),
         in_specs=q_specs,
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_specs=tile_spec((1, block_q, d), (0, 1, None)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*q_ops)
+    # dkv grid (bh, nk, nq): grid axis 1 picks kv blocks, axis 2 q blocks
     kv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, ki, qi: (bhi, 0, qi)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, ki, qi: (bhi, 0, qi)),
+        tile_spec((1, block_q, d), (0, 2, None)),
+        tile_spec((1, block_k, d), (0, 1, None)),
+        tile_spec((1, block_k, d), (0, 1, None)),
+        tile_spec((1, block_q, d), (0, 2, None)),
+        tile_spec((1, 1, block_q), (0, None, 2)),
+        tile_spec((1, 1, block_q), (0, None, 2)),
     ]
     kv_ops = [q3, k3, v3, do3, lse2, dlt2]
     if has_mask:
         kv_specs.append(pl.BlockSpec(
             (1, 1, block_k), lambda bhi, ki, qi: (bhi // h, 0, ki)))
         kv_ops.append(mask_i32)
-    dk, dv = pl.pallas_call(
+    dk, dv = kernel_call(
         functools.partial(_fa_bwd_dkv_kernel, **common),
+        name="flash_attention_bwd_dkv",
         grid=(bh, nk, nq),
         in_specs=kv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            tile_spec((1, block_k, d), (0, 1, None)),
+            tile_spec((1, block_k, d), (0, 1, None)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
@@ -546,6 +474,39 @@ def _flash_core_bwd(scale, causal, block_q, block_k, has_mask, res, g):
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+def _tuned_flash_blocks(q, k, v, scale, causal, kv_mask, block_q, block_k,
+                        interpret):
+    """Autotune hook: with the `autotune` flag on, resolve (block_q,
+    block_k) through the tile cache — sweeping the forward eagerly on
+    first contact with this (shape, chip), reusing the cached winner
+    (or the static flag defaults, under tracing) afterwards."""
+    from paddle_tpu.ops.pallas import autotune
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    sig = autotune.signature(b=b, h=h, tq=tq, tk=tk, d=d, c=int(causal),
+                             m=int(kv_mask is not None), dt=q.dtype.name)
+
+    def candidates():
+        qs = sorted({legal_block(x, tq, interpret)
+                     for x in (64, 128, 256, 512)})
+        ks = sorted({legal_block(x, tk, interpret)
+                     for x in (64, 128, 256, 512)})
+        return [{"block_q": bq, "block_k": bk} for bq in qs for bk in ks]
+
+    def runner(block_q, block_k):
+        return _flash_attention_fwd_tpu(q, k, v, scale, causal, block_q,
+                                        block_k, kv_mask=kv_mask,
+                                        interpret=interpret)
+
+    blocks = autotune.tuned_blocks(
+        "flash_attention", sig,
+        defaults={"block_q": block_q, "block_k": block_k},
+        candidates=candidates, runner=runner,
+        flops=4.0 * b * h * tq * tk * d,
+        args=(q, k, v) + (() if kv_mask is None else (kv_mask,)))
+    return blocks["block_q"], blocks["block_k"]
+
+
 def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
                     block_q=None, block_k=None):
     """Memory-efficient attention. q,k,v: [B, H, T, D]; kv_mask: [B, Tk]
@@ -565,24 +526,31 @@ def flash_attention(q, k, v, scale=None, causal=False, kv_mask=None,
     block_q = block_q if block_q is not None else get_flag("flash_block_q")
     block_k = block_k if block_k is not None else get_flag("flash_block_k")
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    if (on_tpu() or get_flag("pallas_interpret")) and pltpu is not None:
-        if q.shape[-1] % 64 == 0 and q.shape[2] % 8 == 0 \
-                and k.shape[2] % 8 == 0:
-            if kv_mask is None:
-                # dummy float operand keeps the custom_vjp signature static;
-                # has_mask=False drops it before the pallas_call
-                mask = jnp.zeros((1, 1), jnp.float32)
-                return _flash_core(q, k, v, mask, scale, causal, block_q,
-                                   block_k, False)
-            return _flash_core(q, k, v, kv_mask.astype(jnp.float32), scale,
-                               causal, block_q, block_k, True)
-        # include the requested shardings: under GSPMD/shard_map the
-        # PER-SHARD T is what must divide by 8, so a globally-legal shape
-        # can still land here once the sequence axis is partitioned — the
-        # log must show what was asked for vs what the kernel supports
-        _log_fallback(f"D={q.shape[-1]} not a multiple of 64 or "
-                      f"T={q.shape[2]}/{k.shape[2]} not a multiple of 8; "
-                      f"requested {describe_sharding(q=q, k=k)} "
-                      "(supported: per-shard D%64==0 and T%8==0)")
+    shape_ok = (q.shape[-1] % 64 == 0 and q.shape[2] % 8 == 0
+                and k.shape[2] % 8 == 0)
+    # include the requested shardings: under GSPMD/shard_map the
+    # PER-SHARD T is what must divide by 8, so a globally-legal shape
+    # can still land here once the sequence axis is partitioned — the
+    # log must show what was asked for vs what the kernel supports
+    mode = kernel_mode(
+        "flash_attention",
+        unsupported=None if shape_ok else (
+            f"D={q.shape[-1]} not a multiple of 64 or "
+            f"T={q.shape[2]}/{k.shape[2]} not a multiple of 8; "
+            f"requested {describe_sharding(q=q, k=k)} "
+            "(supported: per-shard D%64==0 and T%8==0)"))
+    if mode is not None:
+        if get_flag("autotune"):
+            block_q, block_k = _tuned_flash_blocks(
+                q, k, v, scale, causal, kv_mask, block_q, block_k,
+                interpret=(mode == "interpret"))
+        if kv_mask is None:
+            # dummy float operand keeps the custom_vjp signature static;
+            # has_mask=False drops it before the pallas_call
+            mask = jnp.zeros((1, 1), jnp.float32)
+            return _flash_core(q, k, v, mask, scale, causal, block_q,
+                               block_k, False)
+        return _flash_core(q, k, v, kv_mask.astype(jnp.float32), scale,
+                           causal, block_q, block_k, True)
     return chunked_attention(q, k, v, scale=scale, causal=causal,
                              kv_mask=kv_mask, chunk_size=block_k)

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from paddle_tpu.ops.pallas import on_tpu
+from paddle_tpu.ops.pallas.core import (INTERPRET, kernel_call, kernel_mode,
+                                        pick_block_rows, tile_spec)
 
 
 def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, m_ref, r_ref, *, epsilon):
@@ -40,29 +41,48 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, m_ref, r_ref, *, epsilon):
     r_ref[:] = r
 
 
-def _pick_block_rows(rows, cols, dtype_bytes, vmem_budget=2 ** 21):
-    """Rows per tile: keep ~2 copies of the tile within a 2MB VMEM slice.
-    Need not divide rows — the grid rounds up and the tail tile is padded."""
-    per_row = max(cols * dtype_bytes * 2, 1)
-    return max(min(vmem_budget // per_row, rows, 256), 1)
-
-
-def _stats_pallas(x2d, gamma, beta, epsilon, interpret=False):
+def _tuned_block_rows(kernel, x2d, runner):
+    """Row-tile size, autotuned when the flag is on (the default comes
+    from the shared VMEM heuristic). ``runner(block_rows=...)`` executes
+    the live kernel for the sweep."""
     R, C = x2d.shape
-    br = _pick_block_rows(R, C, x2d.dtype.itemsize)
+    br = pick_block_rows(R, C, x2d.dtype.itemsize)
+    from paddle_tpu.core.flags import get_flag
+    if not get_flag("autotune"):
+        return br
+    from paddle_tpu.ops.pallas import autotune
+    sig = autotune.signature(r=R, c=C, dt=x2d.dtype.name)
+    cands = [{"block_rows": b} for b in (32, 64, 128, 256) if b <= R]
+    blocks = autotune.tuned_blocks(
+        kernel, sig, defaults={"block_rows": br}, candidates=cands,
+        runner=runner, flops=9.0 * R * C, args=(x2d,))
+    return blocks["block_rows"]
+
+
+def _stats_pallas(x2d, gamma, beta, epsilon, interpret=False,
+                  block_rows=None):
+    R, C = x2d.shape
+    if block_rows is None:
+        block_rows = _tuned_block_rows(
+            "layer_norm", x2d,
+            lambda block_rows: _stats_pallas(x2d, gamma, beta, epsilon,
+                                             interpret, block_rows))
+    br = block_rows
     kern = functools.partial(_ln_fwd_kernel, epsilon=epsilon)
-    return pl.pallas_call(
+    grid = (pl.cdiv(R, br),)
+    return kernel_call(
         kern,
-        grid=(pl.cdiv(R, br),),
+        name="layer_norm",
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            tile_spec((br, C), (0, None)),
+            tile_spec((C,), (None,)),
+            tile_spec((C,), (None,)),
         ],
         out_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            tile_spec((br, C), (0, None)),
+            tile_spec((br, 1), (0, None)),
+            tile_spec((br, 1), (0, None)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, C), x2d.dtype),
@@ -88,13 +108,13 @@ def _stats_xla(x2d, gamma, beta, epsilon):
 def _stats(x2d, gamma, beta, epsilon):
     # escape hatch (ADVICE r1): PT_FLAGS_use_pallas_layer_norm=0 forces the
     # XLA twin if the Pallas kernel misbehaves on some shape/hardware;
-    # pallas_interpret engages the kernel off-TPU via the interpreter
-    from paddle_tpu.core.flags import get_flag
-    if get_flag("use_pallas_layer_norm"):
-        if on_tpu():
-            return _stats_pallas(x2d, gamma, beta, epsilon)
-        if get_flag("pallas_interpret"):
-            return _stats_pallas(x2d, gamma, beta, epsilon, interpret=True)
+    # pallas_interpret engages the kernel off-TPU via the interpreter.
+    # LN refuses silently — every shape is supported, so the only refusal
+    # is "not on TPU", which is not an anomaly worth a log line.
+    mode = kernel_mode("layer_norm", enable_flag="use_pallas_layer_norm")
+    if mode is not None:
+        return _stats_pallas(x2d, gamma, beta, epsilon,
+                             interpret=mode == INTERPRET)
     return _stats_xla(x2d, gamma, beta, epsilon)
 
 
@@ -167,23 +187,31 @@ def _ln_add_fwd_kernel(x_ref, h_ref, g_ref, b_ref, o_ref, m_ref, r_ref, *,
     r_ref[:] = r
 
 
-def _stats_add_pallas(x2d, h2d, gamma, beta, epsilon, interpret=False):
+def _stats_add_pallas(x2d, h2d, gamma, beta, epsilon, interpret=False,
+                      block_rows=None):
     R, C = x2d.shape
-    br = _pick_block_rows(R, C, x2d.dtype.itemsize)
+    if block_rows is None:
+        block_rows = _tuned_block_rows(
+            "add_layer_norm", x2d,
+            lambda block_rows: _stats_add_pallas(x2d, h2d, gamma, beta,
+                                                 epsilon, interpret,
+                                                 block_rows))
+    br = block_rows
     kern = functools.partial(_ln_add_fwd_kernel, epsilon=epsilon)
-    return pl.pallas_call(
+    return kernel_call(
         kern,
+        name="add_layer_norm",
         grid=(pl.cdiv(R, br),),
         in_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((C,), lambda i: (0,)),
-            pl.BlockSpec((C,), lambda i: (0,)),
+            tile_spec((br, C), (0, None)),
+            tile_spec((br, C), (0, None)),
+            tile_spec((C,), (None,)),
+            tile_spec((C,), (None,)),
         ],
         out_specs=[
-            pl.BlockSpec((br, C), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
-            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            tile_spec((br, C), (0, None)),
+            tile_spec((br, 1), (0, None)),
+            tile_spec((br, 1), (0, None)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((R, C), x2d.dtype),
@@ -195,13 +223,10 @@ def _stats_add_pallas(x2d, h2d, gamma, beta, epsilon, interpret=False):
 
 
 def _stats_add(x2d, h2d, gamma, beta, epsilon):
-    from paddle_tpu.core.flags import get_flag
-    if get_flag("use_pallas_layer_norm"):
-        if on_tpu():
-            return _stats_add_pallas(x2d, h2d, gamma, beta, epsilon)
-        if get_flag("pallas_interpret"):
-            return _stats_add_pallas(x2d, h2d, gamma, beta, epsilon,
-                                     interpret=True)
+    mode = kernel_mode("layer_norm", enable_flag="use_pallas_layer_norm")
+    if mode is not None:
+        return _stats_add_pallas(x2d, h2d, gamma, beta, epsilon,
+                                 interpret=mode == INTERPRET)
     return _stats_xla((x2d.astype(jnp.float32)
                        + h2d.astype(jnp.float32)).astype(x2d.dtype),
                       gamma, beta, epsilon)

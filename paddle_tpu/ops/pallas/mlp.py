@@ -1,0 +1,239 @@
+"""Fused transformer feed-forward (MLP / GLU) — the first kernel built
+on the shared primitive core (ops/pallas/core.py).
+
+The unfused composition ``fc2(act(fc1(x)))`` writes the [rows,
+intermediate] activation — 4x the hidden width on GPT/BERT — to HBM and
+immediately reads it back. This kernel tiles the intermediate axis
+through VMEM instead: grid (rows/BN, I/BI) with the intermediate axis
+innermost, a [BN, H_out] f32 accumulator resident in scratch across
+intermediate tiles, so no [rows, I] array ever exists. With gate weights
+(``wg``/``bg``) the block computes the GLU family
+``(act(x@w1+b1) * (x@wg+bg)) @ w2 + b2`` in the same sweep.
+
+Everything but the ~50 lines of math here comes from the core layer:
+tile routing (tile_spec), tile-size choice (pick_block_rows +
+the autotuner), tail masking (tail_valid_cols / tail_zero), dispatch and
+fallback telemetry (kernel_mode / kernel_call). The padded row tail
+computes garbage rows whose writes fall off the array (the layer_norm
+discipline); the padded intermediate tail is masked on BOTH operands of
+the second matmul — the activation tile by validity select, the w2 tile
+by tail_zero — because 0 * NaN = NaN (Pallas pads out-of-bounds block
+regions with undefined values).
+
+Forward only: the backward recomputes through the unfused XLA
+composition (jax.vjp over `_mlp_unfused`) — flash-attention-style
+recompute-not-store, so training never materializes the activation in
+the forward pass either. Numerics: the kernel accumulates in f32
+regardless of input dtype; the unfused composition stays in the input
+dtype (it IS the pre-existing model math, and the parity reference).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas.core import (INTERPRET, kernel_call, kernel_mode,
+                                        legal_block, pick_block_rows,
+                                        tail_valid_cols, tail_zero,
+                                        tile_spec)
+
+_ACTS = {
+    # exact erf gelu — must match ops/activations.py A.gelu for parity
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": lambda x: jnp.maximum(x, 0.0),
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+}
+
+
+def _mlp_kernel(x_ref, w1_ref, b1_ref, *rest, act, total_i, block_i,
+                has_gate):
+    if has_gate:
+        wg_ref, bg_ref, w2_ref, b2_ref, o_ref, acc_scr = rest
+    else:
+        w2_ref, b2_ref, o_ref, acc_scr = rest
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[:].astype(jnp.float32)                       # [BN, H]
+    h = jax.lax.dot_general(
+        x, w1_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [BN, BI]
+    h = h + b1_ref[:].astype(jnp.float32)[None, :]
+    a = _ACTS[act](h)
+    if has_gate:
+        g = jax.lax.dot_general(
+            x, wg_ref[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        a = a * (g + bg_ref[:].astype(jnp.float32)[None, :])
+    w2 = w2_ref[:].astype(jnp.float32)                     # [BI, Hout]
+    if total_i % block_i:
+        # padded intermediate tail: clean BOTH matmul operands (select
+        # discards the garbage; 0 * NaN would not)
+        a = jnp.where(tail_valid_cols(j, block_i, total_i, a.shape), a, 0.0)
+        w2 = tail_zero(w2, j, block_i, total_i)
+    acc_scr[:] += jax.lax.dot_general(
+        a, w2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [BN, Hout]
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:]
+                    + b2_ref[:].astype(jnp.float32)[None, :]).astype(
+                        o_ref.dtype)
+
+
+def _mlp_pallas(x2, w1, b1, w2, b2, wg, bg, act, interpret=False,
+                blocks=None):
+    from paddle_tpu.ops.pallas.core import pltpu
+    R, H = x2.shape
+    I, Hout = w1.shape[1], w2.shape[1]
+    if blocks is None:
+        blocks = _tuned_mlp_blocks(x2, w1, b1, w2, b2, wg, bg, act,
+                                   interpret)
+    bn, bi = blocks
+    has_gate = wg is not None
+    kern = functools.partial(_mlp_kernel, act=act, total_i=I, block_i=bi,
+                             has_gate=has_gate)
+    in_specs = [
+        tile_spec((bn, H), (0, None)),
+        tile_spec((H, bi), (None, 1)),
+        tile_spec((bi,), (1,)),
+    ]
+    operands = [x2, w1, b1]
+    if has_gate:
+        in_specs += [tile_spec((H, bi), (None, 1)), tile_spec((bi,), (1,))]
+        operands += [wg, bg]
+    in_specs += [tile_spec((bi, Hout), (1, None)), tile_spec((Hout,),
+                                                             (None,))]
+    operands += [w2, b2]
+    return kernel_call(
+        kern,
+        name="mlp",
+        grid=(pl.cdiv(R, bn), pl.cdiv(I, bi)),
+        in_specs=in_specs,
+        out_specs=tile_spec((bn, Hout), (0, None)),
+        out_shape=jax.ShapeDtypeStruct((R, Hout), x2.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, Hout), jnp.float32)],
+        interpret=interpret,
+    )(*operands)
+
+
+def _default_mlp_blocks(x2, w1, w2, interpret):
+    R, H = x2.shape
+    I, Hout = w1.shape[1], w2.shape[1]
+    # per row the kernel holds the x row, one activation row and the
+    # accumulator row — budget the row tile for those three
+    bn = pick_block_rows(R, H + Hout + 512, 4, copies=1)
+    if not interpret and bn % 8:
+        bn = max((bn // 8) * 8, min(R, 8))
+    bi = legal_block(min(I, 512), I, interpret)
+    return bn, bi
+
+
+def _tuned_mlp_blocks(x2, w1, b1, w2, b2, wg, bg, act, interpret):
+    bn, bi = _default_mlp_blocks(x2, w1, w2, interpret)
+    from paddle_tpu.core.flags import get_flag
+    if not get_flag("autotune"):
+        return bn, bi
+    from paddle_tpu.ops.pallas import autotune
+    R, H = x2.shape
+    I, Hout = w1.shape[1], w2.shape[1]
+    sig = autotune.signature(r=R, h=H, i=I, ho=Hout,
+                             g=int(wg is not None), dt=x2.dtype.name)
+    cands = [{"bn": cn, "bi": ci}
+             for cn in (32, 64, 128, 256) if cn <= max(R, 8)
+             for ci in (128, 256, 512) if ci <= I]
+    blocks = autotune.tuned_blocks(
+        "mlp", sig, defaults={"bn": bn, "bi": bi}, candidates=cands,
+        runner=lambda bn, bi: _mlp_pallas(x2, w1, b1, w2, b2, wg, bg, act,
+                                          interpret, blocks=(bn, bi)),
+        flops=2.0 * R * I * (H + Hout) * (1 + (wg is not None)),
+        args=(x2, w1, w2))
+    return blocks["bn"], blocks["bi"]
+
+
+def _mlp_unfused(x2, w1, b1, w2, b2, wg, bg, act):
+    """The plain composition — exactly the pre-existing model math
+    (Linear matmul + bias in the input dtype, then the activation), kept
+    as the fallback, the parity reference, and the backward recompute."""
+    h = x2 @ w1 + b1
+    a = _ACTS[act](h)
+    if wg is not None:
+        a = a * (x2 @ wg + bg)
+    return a @ w2 + b2
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _mlp_core(x2, w1, b1, w2, b2, wg, bg, act, has_gate, interpret):
+    return _mlp_pallas(x2, w1, b1, w2, b2, wg if has_gate else None,
+                       bg if has_gate else None, act, interpret)
+
+
+def _mlp_core_fwd(x2, w1, b1, w2, b2, wg, bg, act, has_gate, interpret):
+    out = _mlp_core(x2, w1, b1, w2, b2, wg, bg, act, has_gate, interpret)
+    return out, (x2, w1, b1, w2, b2, wg, bg)
+
+
+def _mlp_core_bwd(act, has_gate, interpret, res, g):
+    x2, w1, b1, w2, b2, wg, bg = res
+    if has_gate:
+        _, vjp = jax.vjp(lambda *a: _mlp_unfused(*a, act=act),
+                         x2, w1, b1, w2, b2, wg, bg)
+        return vjp(g)
+    _, vjp = jax.vjp(
+        lambda x2_, w1_, b1_, w2_, b2_: _mlp_unfused(
+            x2_, w1_, b1_, w2_, b2_, None, None, act=act),
+        x2, w1, b1, w2, b2)
+    dx2, dw1, db1, dw2, db2 = vjp(g)
+    return dx2, dw1, db1, dw2, db2, jnp.zeros_like(wg), jnp.zeros_like(bg)
+
+
+_mlp_core.defvjp(_mlp_core_fwd, _mlp_core_bwd)
+
+
+def fused_mlp(x, w1, b1, w2, b2, wg=None, bg=None, act="gelu"):
+    """Fused feed-forward ``act(x@w1+b1) @ w2 + b2`` (GLU with
+    ``wg``/``bg``: the activation branch is gated by ``x@wg+bg``).
+
+    x [..., H]; w1 [H, I]; w2 [I, Hout]; biases may be None (zeros).
+    On TPU / under pallas_interpret (``use_pallas_mlp`` flag on): the
+    Pallas kernel — the [rows, I] activation never reaches HBM.
+    Elsewhere: the plain XLA composition, bit-identical to the
+    pre-existing unfused model math."""
+    if act not in _ACTS:
+        raise ValueError(f"fused_mlp: unknown act {act!r} "
+                         f"(have {sorted(_ACTS)})")
+    H, I = w1.shape
+    Hout = w2.shape[1]
+    b1 = b1 if b1 is not None else jnp.zeros((I,), x.dtype)
+    b2 = b2 if b2 is not None else jnp.zeros((Hout,), x.dtype)
+    has_gate = wg is not None
+    if has_gate and bg is None:
+        bg = jnp.zeros((I,), x.dtype)
+    # MLP refuses silently, like layer_norm: every shape is supported,
+    # so the only refusal is "not on TPU" — not an anomaly worth logging
+    mode = kernel_mode("mlp", enable_flag="use_pallas_mlp")
+    if mode is None:
+        # unfused fallback on the ORIGINAL leading shape — flattening to
+        # [rows, H] hands XLA different fusion boundaries than the
+        # pre-existing model math (and a collapsed row count that can
+        # collide with the HLO-contract probe dims)
+        return _mlp_unfused(x, w1, b1, w2, b2, wg, bg, act)
+    lead = x.shape[:-1]
+    R = 1
+    for d in lead:
+        R *= d
+    x2 = x.reshape(R, H)
+    # dummy gate operands keep the custom_vjp signature static
+    wg_ = wg if has_gate else jnp.zeros((1, 1), x.dtype)
+    bg_ = bg if has_gate else jnp.zeros((1,), x.dtype)
+    out = _mlp_core(x2, w1, b1, w2, b2, wg_, bg_, act, has_gate,
+                    mode == INTERPRET)
+    return out.reshape(*lead, Hout)

@@ -41,14 +41,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-from paddle_tpu.ops.pallas import log_fallback, on_tpu
-
-_NEG_INF = -1e30
+from paddle_tpu.ops.pallas.core import (INTERPRET, NEG_INF, kernel_call,
+                                        kernel_mode, logsumexp_update,
+                                        pick_rv_blocks, tile_spec)
 
 
 def _xent_fwd_kernel(h_ref, w_ref, b_ref, lbl_ref, m_ref, s_ref, p_ref,
@@ -57,7 +52,7 @@ def _xent_fwd_kernel(h_ref, w_ref, b_ref, lbl_ref, m_ref, s_ref, p_ref,
 
     @pl.when(j == 0)
     def _init():
-        m_ref[:] = jnp.full(m_ref.shape, _NEG_INF, m_ref.dtype)
+        m_ref[:] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
         s_ref[:] = jnp.zeros(s_ref.shape, s_ref.dtype)
         p_ref[:] = jnp.zeros(p_ref.shape, p_ref.dtype)
         sl_ref[:] = jnp.zeros(sl_ref.shape, sl_ref.dtype)
@@ -70,13 +65,8 @@ def _xent_fwd_kernel(h_ref, w_ref, b_ref, lbl_ref, m_ref, s_ref, p_ref,
     logits = logits + b_ref[:].astype(jnp.float32)[None, :]
     col = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
     valid = col < total_vocab                   # mask the padded tail tile
-    masked = jnp.where(valid, logits, _NEG_INF)
-
-    m_old = m_ref[:]                                       # [BN, 1]
-    m_new = jnp.maximum(m_old, jnp.max(masked, axis=1, keepdims=True))
-    s_ref[:] = (s_ref[:] * jnp.exp(m_old - m_new)
-                + jnp.sum(jnp.exp(masked - m_new), axis=1, keepdims=True))
-    m_ref[:] = m_new
+    masked = jnp.where(valid, logits, NEG_INF)
+    logsumexp_update(masked, m_ref, s_ref)
     # the label's column. Out-of-range labels — another vocab shard's rows
     # in the GSPMD case — must pick 0: a label in [V, padded_V) would
     # otherwise match a PADDED column and pick up its undefined logit, so
@@ -87,16 +77,27 @@ def _xent_fwd_kernel(h_ref, w_ref, b_ref, lbl_ref, m_ref, s_ref, p_ref,
                          keepdims=True)
 
 
-def _pick_blocks(n, v, h, dtype_bytes, vmem_budget=2 ** 22):
-    """Row/vocab tile sizes: h-tile + w-tile + f32 logits tile within ~4MB."""
-    bv = max(min(v, 1024), 128)
-    per_row = h * dtype_bytes + bv * 4          # hidden row + logits row
-    bn = max(min(vmem_budget // max(per_row, 1), n, 512), 8)
-    return bn, bv
+def _tuned_blocks(kernel, hidden, v, runner):
+    """(bn, bv) from the shared VMEM heuristic, or — with the ``autotune``
+    flag on — the cached/swept winner for this (shape, chip)."""
+    n, h = hidden.shape
+    bn, bv = pick_rv_blocks(n, v, h, hidden.dtype.itemsize)
+    from paddle_tpu.core.flags import get_flag
+    if not get_flag("autotune"):
+        return bn, bv
+    from paddle_tpu.ops.pallas import autotune
+    sig = autotune.signature(n=n, v=v, h=h, dt=hidden.dtype.name)
+    cands = [{"bn": cn, "bv": cv}
+             for cn in (64, 128, 256, 512) if cn <= max(n, 8)
+             for cv in (256, 512, 1024) if cv <= max(v, 128)]
+    blocks = autotune.tuned_blocks(
+        kernel, sig, defaults={"bn": bn, "bv": bv}, candidates=cands,
+        runner=runner, flops=2.0 * n * v * h, args=(hidden,))
+    return blocks["bn"], blocks["bv"]
 
 
 def xent_stats_pallas(hidden, weight, bias, labels, interpret=False,
-                      return_parts=False):
+                      return_parts=False, blocks=None):
     """Per-row loss stats. Default: (logz, picked, sum_logits), each [N]
     f32. return_parts=True: the raw online pair (m, s, picked, sum_logits)
     — the vocab-sharded caller combines (m, s) across shards with
@@ -106,23 +107,26 @@ def xent_stats_pallas(hidden, weight, bias, labels, interpret=False,
     """
     N, H = hidden.shape
     V = weight.shape[0]
-    bn, bv = _pick_blocks(N, V, H, hidden.dtype.itemsize)
+    if blocks is None:
+        bn, bv = _tuned_blocks(
+            "xent_stats", hidden, V,
+            lambda bn, bv: xent_stats_pallas(hidden, weight, bias, labels,
+                                             interpret, blocks=(bn, bv)))
+    else:
+        bn, bv = blocks
     kern = functools.partial(_xent_fwd_kernel, total_vocab=V, block_v=bv)
-    m, s, picked, sl = pl.pallas_call(
+    row_out = tile_spec((bn, 1), (0, None))
+    m, s, picked, sl = kernel_call(
         kern,
+        name="xent_stats",
         grid=(pl.cdiv(N, bn), pl.cdiv(V, bv)),
         in_specs=[
-            pl.BlockSpec((bn, H), lambda i, j: (i, 0)),
-            pl.BlockSpec((bv, H), lambda i, j: (j, 0)),
-            pl.BlockSpec((bv,), lambda i, j: (j,)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            tile_spec((bn, H), (0, None)),
+            tile_spec((bv, H), (1, None)),
+            tile_spec((bv,), (1,)),
+            tile_spec((bn, 1), (0, None)),
         ],
-        out_specs=[
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        ],
+        out_specs=[row_out] * 4,
         out_shape=[jax.ShapeDtypeStruct((N, 1), jnp.float32)] * 4,
         interpret=interpret,
     )(hidden, weight, bias, labels[:, None].astype(jnp.int32))
@@ -135,18 +139,15 @@ def xent_stats_pallas(hidden, weight, bias, labels, interpret=False,
 def xent_stats(hidden, weight, bias, labels, return_parts=False, context=""):
     """Kernel when it applies (TPU, or interpreter when pallas_interpret is
     set), else None — the caller falls back to the chunked XLA stats."""
-    from paddle_tpu.core.flags import get_flag
-    if not get_flag("use_pallas_xent"):
+    mode = kernel_mode(
+        "xent_stats", enable_flag="use_pallas_xent", log_unavailable=True,
+        unavailable_reason="no TPU and pallas_interpret off" + context,
+        level=logging.WARNING if context else logging.DEBUG)
+    if mode is None:
         return None
-    if on_tpu():
-        return xent_stats_pallas(hidden, weight, bias, labels,
-                                 return_parts=return_parts)
-    if get_flag("pallas_interpret"):
-        return xent_stats_pallas(hidden, weight, bias, labels,
-                                 interpret=True, return_parts=return_parts)
-    log_fallback("xent_stats", "no TPU and pallas_interpret off" + context,
-                 level=logging.WARNING if context else logging.DEBUG)
-    return None
+    return xent_stats_pallas(hidden, weight, bias, labels,
+                             interpret=mode == INTERPRET,
+                             return_parts=return_parts)
 
 
 # ---- backward ------------------------------------------------------------
@@ -233,49 +234,43 @@ def xent_bwd_pallas(hidden, weight, bias, labels, logz, g, sn, sp,
     """
     N, H = hidden.shape
     V = weight.shape[0]
-    bn, bv = _pick_blocks(N, V, H, hidden.dtype.itemsize)
+    bn, bv = pick_rv_blocks(N, V, H, hidden.dtype.itemsize)
     lbl2 = labels[:, None].astype(jnp.int32)
     logz2 = logz[:, None].astype(jnp.float32)
     g2 = g[:, None].astype(jnp.float32)
-    row_specs = [
-        pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-        pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
-    ]
-    dh = pl.pallas_call(
+    row_specs = [tile_spec((bn, 1), (0, None))] * 3
+    dh = kernel_call(
         functools.partial(_xent_bwd_dh_kernel, total_vocab=V, block_v=bv,
                           sn=sn, sp=sp),
+        name="xent_bwd_dh",
         grid=(pl.cdiv(N, bn), pl.cdiv(V, bv)),
         in_specs=[
-            pl.BlockSpec((bn, H), lambda i, j: (i, 0)),
-            pl.BlockSpec((bv, H), lambda i, j: (j, 0)),
-            pl.BlockSpec((bv,), lambda i, j: (j,)),
+            tile_spec((bn, H), (0, None)),
+            tile_spec((bv, H), (1, None)),
+            tile_spec((bv,), (1,)),
             *row_specs,
         ],
-        out_specs=pl.BlockSpec((bn, H), lambda i, j: (i, 0)),
+        out_specs=tile_spec((bn, H), (0, None)),
         out_shape=jax.ShapeDtypeStruct((N, H), jnp.float32),
         interpret=interpret,
     )(hidden, weight, bias, lbl2, logz2, g2)
     # transposed grid — vocab outer, rows inner — so the [BV, H] dw block
     # (and [1, BV] db block) stays resident across the row sweep
-    tr_row_specs = [
-        pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
-        pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
-        pl.BlockSpec((bn, 1), lambda j, i: (i, 0)),
-    ]
-    dw, db = pl.pallas_call(
+    tr_row_specs = [tile_spec((bn, 1), (1, None))] * 3
+    dw, db = kernel_call(
         functools.partial(_xent_bwd_dwb_kernel, total_vocab=V, total_rows=N,
                           block_n=bn, block_v=bv, sn=sn, sp=sp),
+        name="xent_bwd_dwb",
         grid=(pl.cdiv(V, bv), pl.cdiv(N, bn)),
         in_specs=[
-            pl.BlockSpec((bn, H), lambda j, i: (i, 0)),
-            pl.BlockSpec((bv, H), lambda j, i: (j, 0)),
-            pl.BlockSpec((bv,), lambda j, i: (j,)),
+            tile_spec((bn, H), (1, None)),
+            tile_spec((bv, H), (0, None)),
+            tile_spec((bv,), (0,)),
             *tr_row_specs,
         ],
         out_specs=[
-            pl.BlockSpec((bv, H), lambda j, i: (j, 0)),
-            pl.BlockSpec((1, bv), lambda j, i: (0, j)),
+            tile_spec((bv, H), (0, None)),
+            tile_spec((1, bv), (None, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((V, H), jnp.float32),
@@ -290,15 +285,11 @@ def xent_bwd(hidden, weight, bias, labels, logz, g, sn, sp, context=""):
     """Backward kernels when they apply (TPU, or interpreter when
     pallas_interpret is set), else None — the caller falls back to the
     chunked XLA recompute."""
-    from paddle_tpu.core.flags import get_flag
-    if not get_flag("use_pallas_xent_bwd"):
+    mode = kernel_mode(
+        "xent_bwd", enable_flag="use_pallas_xent_bwd", log_unavailable=True,
+        unavailable_reason="no TPU and pallas_interpret off" + context,
+        level=logging.WARNING if context else logging.DEBUG)
+    if mode is None:
         return None
-    if on_tpu():
-        return xent_bwd_pallas(hidden, weight, bias, labels, logz, g,
-                               sn, sp)
-    if get_flag("pallas_interpret"):
-        return xent_bwd_pallas(hidden, weight, bias, labels, logz, g,
-                               sn, sp, interpret=True)
-    log_fallback("xent_bwd", "no TPU and pallas_interpret off" + context,
-                 level=logging.WARNING if context else logging.DEBUG)
-    return None
+    return xent_bwd_pallas(hidden, weight, bias, labels, logz, g,
+                           sn, sp, interpret=mode == INTERPRET)

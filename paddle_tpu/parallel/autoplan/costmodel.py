@@ -200,12 +200,44 @@ def collective_bytes(spec, dp, tp, pp, microbatches=1):
 
 # -------------------------------------------------------------- predict
 
+def _topology_chip(topology):
+    """The chip-family key of a topology name ("v5e-16" -> "v5e",
+    "detected:cpu4" -> "cpu") — what the autotune cache keys rates by."""
+    name = (topology.name or "").split(":")[-1].lower()
+    for key in ("v6e", "v5p", "v5e", "v4"):
+        if key in name:
+            return key
+    return "cpu"
+
+
+def achieved_rate(topology):
+    """(achieved flops/s, source) for pricing compute: the harmonic-mean
+    measured rate from the autotune cache when this chip family has
+    entries (source "measured"), else the analytic ``peak * MFU_ASSUMED``
+    constant (source "analytic"). Import is lazy and failure-tolerant —
+    this module stays stdlib-importable and a broken cache must never
+    take down a plan."""
+    try:
+        from paddle_tpu.ops.pallas import autotune
+        rate = autotune.measured_rate(_topology_chip(topology))
+    except Exception:
+        rate = None
+    if rate is not None:
+        return rate[0], "measured"
+    return topology.peak_flops * MFU_ASSUMED, "analytic"
+
+
 def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b"):
     """Score one candidate: predicted step seconds + the estimates that
     produced it. dp is the outermost axis — it crosses slice boundaries
-    first on a multi-slice topology, so it prices at DCN bandwidth."""
+    first on a multi-slice topology, so it prices at DCN bandwidth.
+
+    Compute is priced at the achieved-flops/s rate measured by the tile
+    autotuner when its cache has entries for this chip family (the
+    ``rate_source`` field says which constant priced the candidate)."""
     flops_c = train_flops(spec) / (dp * tp * pp)
-    compute_s = flops_c / (topology.peak_flops * MFU_ASSUMED)
+    rate, rate_source = achieved_rate(topology)
+    compute_s = flops_c / rate
     bubble = (pp - 1) / max(1, microbatches) if pp > 1 else 0.0
     coll = collective_bytes(spec, dp, tp, pp, microbatches)
     multi = topology.num_slices > 1
@@ -222,22 +254,45 @@ def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b"):
         "mem_bytes": mem["total"],
         "mem": mem,
         "collective_bytes": coll,
+        "rate_source": rate_source,
+        "rate_flops_s": rate,
     }
 
 
 # ----------------------------------------------------------- calibration
 
-def calibration_report(spec, jitted, *args):
+def calibration_report(spec, jitted, *args, topology=None):
     """Compare the analytic flop count against XLA's own
     ``compile().cost_analysis()`` for a jitted train step — the
     cost-model's ground-truth hook (runs on CPU; tests assert the ratio
-    stays inside a tolerance band)."""
+    stays inside a tolerance band).
+
+    The ``constants`` block labels which source prices compute on this
+    chip family: "measured" (autotune-cache achieved-flops/s, with the
+    rate and how many cache entries back it) vs "analytic"
+    (``peak * MFU_ASSUMED``)."""
     from paddle_tpu.observability.perf import cost_flops
     measured = cost_flops(jitted, *args)
     predicted = train_flops(spec)
+    if topology is None:
+        from paddle_tpu.parallel.autoplan import topology as _topo
+        topology = _topo.detect()
+    rate, rate_source = achieved_rate(topology)
+    try:
+        from paddle_tpu.ops.pallas import autotune
+        chip = _topology_chip(topology)
+        entries = len(autotune.measured_rates().get(chip, ()))
+    except Exception:
+        chip, entries = _topology_chip(topology), 0
     return {
         "model": spec.name,
         "predicted_flops": float(predicted),
         "measured_flops": float(measured),
         "ratio": float(predicted / measured) if measured else None,
+        "constants": {
+            "chip": chip,
+            "rate_source": rate_source,
+            "rate_flops_s": float(rate),
+            "measured_entries": entries,
+        },
     }

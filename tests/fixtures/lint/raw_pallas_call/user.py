@@ -1,0 +1,22 @@
+"""Planted raw pallas_call outside the shared wrapper."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas.core import kernel_call
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+def clean(x):
+    # clean: routed through the shared wrapper
+    return kernel_call(_kernel, name="double", grid=(1,),
+                       out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+
+def rogue(x):
+    # PLANTED: direct pl.pallas_call, bypasses kernel_call
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)

@@ -179,6 +179,10 @@ class TestCalibration:
         row = json.loads(out.stdout.strip().splitlines()[-1])
         assert row["measured_flops"] > 0, row
         assert 0.6 < row["ratio"] < 1.6, row
+        # the constants block labels which source prices compute (the
+        # autotune-cache measured rate vs the analytic MFU assumption)
+        assert row["constants"]["rate_source"] in ("measured", "analytic")
+        assert row["constants"]["rate_flops_s"] > 0, row
         return row
 
     def test_gpt_flops_within_band(self):

@@ -1,0 +1,214 @@
+"""Tile autotuner: deterministic sweeps through an injected fake timer,
+the JSON winner cache (round-trip, corruption tolerance, counter-verified
+second-invocation hits), and the measured achieved-flops/s feed the
+autoplan cost model prices compute with."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.core.flags import all_flags, set_flags
+from paddle_tpu.observability import metrics
+from paddle_tpu.ops.pallas import autotune
+
+
+@pytest.fixture
+def flags():
+    saved = all_flags()
+    yield set_flags
+    set_flags(saved)
+
+
+@pytest.fixture
+def tuning(flags, tmp_path):
+    """autotune on, pointed at a fresh per-test cache file; the injected
+    timer is restored afterwards."""
+    path = str(tmp_path / "tiles.json")
+    flags({"autotune": True, "autotune_cache": path})
+    yield path
+    autotune.set_timer(None)
+
+
+def _count(name, **labels):
+    return metrics.counter(name).value(**labels)
+
+
+def _fake_timer(calls):
+    """Deterministic 'bigger bn*bv is faster' clock; appends the blocks
+    each timed candidate ran with."""
+    def timer(thunk):
+        thunk()
+        return 1.0 / (calls[-1]["bn"] * calls[-1]["bv"])
+    return timer
+
+
+def _runner(calls):
+    def runner(**blocks):
+        calls.append(blocks)
+    return runner
+
+
+CANDS = [{"bn": 16, "bv": 8}, {"bn": 32, "bv": 8}]
+DEFAULTS = {"bn": 8, "bv": 8}
+
+
+class TestSweep:
+    def test_fake_timer_picks_deterministic_winner(self, tuning):
+        calls = []
+        autotune.set_timer(_fake_timer(calls))
+        rec = autotune.sweep("k1", "s1", DEFAULTS, CANDS, _runner(calls),
+                             flops=1e6)
+        assert rec["blocks"] == {"bn": 32, "bv": 8}
+        # ranked list: every candidate (defaults included), best first
+        assert [r["blocks"] for r in rec["swept"]] == [
+            {"bn": 32, "bv": 8}, {"bn": 16, "bv": 8}, {"bn": 8, "bv": 8}]
+        assert rec["flops"] == 1e6 and rec["chip"] == autotune.chip_key()
+        rec2 = autotune.sweep("k1", "s1", DEFAULTS, CANDS, _runner(calls))
+        assert rec2["blocks"] == rec["blocks"]  # same inputs, same winner
+
+    def test_failing_candidate_skipped_all_failing_keeps_defaults(
+            self, tuning):
+        def runner(**blocks):
+            if blocks["bn"] > 8:
+                raise ValueError("illegal tile")
+        autotune.set_timer(lambda thunk: (thunk(), 1.0)[1])
+        rec = autotune.sweep("k2", "s1", DEFAULTS, CANDS, runner)
+        assert rec["blocks"] == DEFAULTS  # only the defaults survived
+        rec_all = autotune.sweep(
+            "k3", "s1", {"bn": 99, "bv": 8}, [], lambda **b: 1 / 0)
+        assert rec_all["blocks"] == {"bn": 99, "bv": 8}
+        assert rec_all["time_s"] is None
+
+
+class TestTunedBlocks:
+    def test_flag_off_returns_defaults_untouched(self, flags):
+        flags({"autotune": False})
+        sweeps = _count("autotune.sweeps", kernel="k4")
+        out = autotune.tuned_blocks("k4", "s", DEFAULTS, CANDS,
+                                    lambda **b: None)
+        assert out == DEFAULTS and out is not DEFAULTS
+        assert _count("autotune.sweeps", kernel="k4") == sweeps
+
+    def test_second_invocation_is_counter_verified_cache_hit(self, tuning):
+        calls = []
+        autotune.set_timer(_fake_timer(calls))
+        hits = _count("autotune.cache", event="hit")
+        misses = _count("autotune.cache", event="miss")
+        sweeps = _count("autotune.sweeps", kernel="k5")
+        first = autotune.tuned_blocks("k5", "s1", DEFAULTS, CANDS,
+                                      _runner(calls))
+        assert first == {"bn": 32, "bv": 8}
+        assert _count("autotune.cache", event="miss") == misses + 1
+        assert _count("autotune.sweeps", kernel="k5") == sweeps + 1
+        timed = len(calls)
+        second = autotune.tuned_blocks("k5", "s1", DEFAULTS, CANDS,
+                                       _runner(calls))
+        assert second == first
+        assert _count("autotune.cache", event="hit") == hits + 1
+        assert _count("autotune.sweeps", kernel="k5") == sweeps + 1
+        assert len(calls) == timed  # the runner never re-executed
+
+    def test_traced_miss_keeps_static_defaults(self, tuning):
+        calls = []
+
+        def f(x):
+            blocks = autotune.tuned_blocks(
+                "k6", "s1", DEFAULTS, CANDS, _runner(calls), args=(x,))
+            return x * blocks["bn"]
+
+        out = jax.jit(f)(jnp.ones((2,)))
+        assert float(out[0]) == DEFAULTS["bn"]
+        assert calls == []  # no sweep inside tracing
+
+    def test_cached_winner_filtered_to_known_keys(self, tuning):
+        autotune.cache().put(autotune.cache_key("k7", "s1"),
+                             {"blocks": {"bn": 64, "rogue": 3}})
+        out = autotune.tuned_blocks("k7", "s1", DEFAULTS)
+        assert out == {"bn": 64, "bv": 8}  # rogue key dropped
+
+
+class TestCache:
+    def test_round_trip_through_file(self, tuning):
+        calls = []
+        autotune.set_timer(_fake_timer(calls))
+        autotune.sweep("k8", "s1", DEFAULTS, CANDS, _runner(calls))
+        with open(tuning) as f:
+            data = json.load(f)
+        assert data["version"] == 1
+        fresh = autotune.AutotuneCache(tuning)
+        rec = fresh.get(autotune.cache_key("k8", "s1"))
+        assert rec["blocks"] == {"bn": 32, "bv": 8}
+
+    def test_corrupt_file_counted_and_rebuilt(self, tuning):
+        with open(tuning, "w") as f:
+            f.write("{not json")
+        corrupt = _count("autotune.cache", event="corrupt")
+        fresh = autotune.AutotuneCache(tuning)
+        assert fresh.get("anything") is None
+        assert _count("autotune.cache", event="corrupt") == corrupt + 1
+        fresh.put("k|s|cpu", {"blocks": {"bn": 8}})  # still writable
+        assert autotune.AutotuneCache(tuning).get("k|s|cpu") is not None
+
+    def test_signature_is_sorted_and_stable(self):
+        assert autotune.signature(v=3, b=1) == "b1,v3"
+        assert autotune.signature(b=1, v=3) == "b1,v3"
+
+
+class TestCostModelFeed:
+    def _seed(self, path):
+        # write through the process-global cache, exactly as a sweep
+        # does — a fresh instance would leave the already-loaded global
+        # (and thus the cost model) blind to the new entries
+        c = autotune.cache(path)
+        c.put("a|s|cpu", {"blocks": {}, "time_s": 1.0, "flops": 1e9,
+                          "chip": "cpu"})
+        c.put("b|s|cpu", {"blocks": {}, "time_s": 1.0, "flops": 3e9,
+                          "chip": "cpu"})
+        c.put("c|s|cpu", {"blocks": {}, "time_s": None, "chip": "cpu"})
+
+    def test_measured_rate_harmonic_mean(self, tuning):
+        self._seed(tuning)
+        rate, n = autotune.measured_rate("cpu", tuning)
+        assert n == 2  # the timeless entry contributes nothing
+        assert rate == pytest.approx(1.5e9)
+        assert autotune.measured_rate("v5e", tuning) is None
+
+    def test_costmodel_prices_with_measured_rate(self, tuning):
+        from paddle_tpu.parallel.autoplan import costmodel, topology
+        topo = topology.get_topology("cpu4")
+        empty_rate, empty_src = costmodel.achieved_rate(topo)
+        assert empty_src == "analytic"
+        assert empty_rate == pytest.approx(
+            topo.peak_flops * costmodel.MFU_ASSUMED)
+        self._seed(tuning)
+        rate, src = costmodel.achieved_rate(topo)
+        assert src == "measured" and rate == pytest.approx(1.5e9)
+        # the measured rate flows into predict()'s compute pricing
+        spec = costmodel.ModelSpec(
+            name="t", vocab=64, hidden=32, layers=1, heads=2,
+            intermediate=64, seq=8, batch=4)
+        row = costmodel.predict(spec, topo, dp=1, tp=1, pp=1)
+        assert row["rate_source"] == "measured"
+        assert row["rate_flops_s"] == pytest.approx(1.5e9)
+        assert row["compute_s"] == pytest.approx(
+            row["flops_per_chip"] / 1.5e9)
+
+    def test_calibration_report_labels_rate_source(self, tuning):
+        from paddle_tpu.parallel.autoplan import costmodel, topology
+        self._seed(tuning)
+        spec = costmodel.ModelSpec(
+            name="t", vocab=64, hidden=32, layers=1, heads=2,
+            intermediate=64, seq=8, batch=4)
+        jitted = jax.jit(lambda x: (x @ x).sum())
+        rep = costmodel.calibration_report(
+            spec, jitted, jnp.ones((32, 32)),
+            topology=topology.get_topology("cpu4"))
+        assert set(rep) >= {"model", "predicted_flops", "measured_flops",
+                            "ratio", "constants"}
+        const = rep["constants"]
+        assert const["chip"] == "cpu"
+        assert const["rate_source"] == "measured"
+        assert const["rate_flops_s"] == pytest.approx(1.5e9)
+        assert const["measured_entries"] == 2

@@ -63,6 +63,20 @@ def test_serve_step_traced_once_and_paged_hlo_contract():
 
 
 @pytest.mark.perf
+def test_fused_mlp_hlo_contract():
+    """Fused GLU/MLP (in-process, CPU): the compiled forward of both the
+    plain and gated variants must hold no [rows, 4H] activation
+    temporary — the kernel streams I-axis tiles through a
+    [block_rows, H] accumulator. The unfused composition
+    (use_pallas_mlp=0) is the positive control that proves the detector
+    sees the materialized activation."""
+    import tools.compile_smoke as cs
+    out = cs.mlp_smoke()
+    assert out["clean"], (out["mlp_temporaries"], out["glu_temporaries"])
+    assert out["positive_control_trips"]
+
+
+@pytest.mark.perf
 def test_bench_bert_sharded_dp_tp_hlo_contract():
     """Same contract for the BERT-pretrain step (masked-position MLM head
     over the vocab-sharded table + tp-sharded mlm_bias). Detector

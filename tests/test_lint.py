@@ -18,6 +18,7 @@ from paddle_tpu.analysis.rules.fault_point_drift import FaultPointDrift
 from paddle_tpu.analysis.rules.flag_drift import FlagDrift
 from paddle_tpu.analysis.rules.hot_path_sync import HotPathSync
 from paddle_tpu.analysis.rules.no_committed_logs import NoCommittedLogs
+from paddle_tpu.analysis.rules.raw_pallas_call import RawPallasCall
 from paddle_tpu.analysis.rules.tracer_leak import TracerLeak
 
 pytestmark = pytest.mark.lint
@@ -115,6 +116,21 @@ def test_fault_point_drift_fixture_fires_both_directions():
     assert len(fs) == 2, [f.format() for f in fs]
     assert any("'rogue.point'" in m for m in msgs)
     assert any("'unused.point'" in m for m in msgs)
+
+
+def test_raw_pallas_call_fixture_fires():
+    rule = RawPallasCall(scope=_ALL, min_sites=1)
+    fs = list(rule.check(_fixture_ctx("raw_pallas_call")))
+    assert len(fs) == 1, [f.format() for f in fs]
+    assert fs[0].path == "user.py" and "kernel_call" in fs[0].message
+    # the allowed wrapper module's own site stays silent, and counts
+    # toward the rot canary (min_sites=1 satisfied by core.py alone)
+
+
+def test_raw_pallas_call_rot_canary():
+    rule = RawPallasCall(scope=_ALL, min_sites=10)
+    fs = list(rule.check(_fixture_ctx("raw_pallas_call")))
+    assert any("detection rotted" in f.message for f in fs)
 
 
 def test_no_committed_logs_fixture_fires():

@@ -306,6 +306,68 @@ def serve_smoke(positive_control=True):
     return out
 
 
+def mlp_smoke(positive_control=True):
+    """Tier-1 contract for the fused GLU/MLP kernel, in-process on CPU:
+
+    with the Pallas path engaged (interpret mode off-TPU), the compiled
+    forward holds no [rows, 4H] activation temporary — the kernel
+    streams I-axis tiles through a [block_rows, H] accumulator. The
+    unfused composition (use_pallas_mlp=0) must TRIP the detector
+    (positive control — proves the grep sees the materialized
+    activation). Both the plain MLP and the gated (GLU) variant run
+    under the same judgment.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    if REPO not in sys.path:       # CLI use; in-suite runs already see it
+        sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core.flags import all_flags, set_flags
+
+    c = _contracts()
+    rows, h, inter = c.MLP_ROWS, c.MLP_HIDDEN, c.MLP_INTER
+    rng = np.random.RandomState(0)
+
+    def arr(*s):
+        return jnp.asarray(0.02 * rng.randn(*s), jnp.float32)
+
+    x = arr(rows, h)
+    mlp_args = (x, arr(h, inter), arr(inter), arr(inter, h), arr(h))
+    glu_args = mlp_args + (arr(h, inter), arr(inter))
+    detector = c.NoTemporary({inter}, c.MLP_MIN_ROWS)
+
+    def _hlo(*a):
+        # fresh jit per flag state: use_pallas_mlp is read at trace time
+        from paddle_tpu.ops.pallas.mlp import fused_mlp
+        return (jax.jit(lambda *b: fused_mlp(*b))
+                .lower(*a).compile().as_text())
+
+    out = {"rows": rows, "hidden": h, "inter": inter}
+    saved = all_flags()
+    try:
+        set_flags({"pallas_interpret": True, "use_pallas_mlp": True})
+        violations = []
+        for name, a in (("mlp", mlp_args), ("glu", glu_args)):
+            hlo = _hlo(*a)
+            out[f"{name}_temporaries"] = detector.temporaries(hlo)
+            violations += c.evaluate(c.CONTRACTS["mlp.fused"],
+                                     c.ContractContext(hlo_text=hlo))
+        out["violations"] = [v.format() for v in violations]
+        out["clean"] = not violations
+        if positive_control:
+            set_flags({"use_pallas_mlp": False})
+            ref_temps = detector.temporaries(_hlo(*glu_args))
+            out["positive_control_trips"] = bool(ref_temps)
+    finally:
+        set_flags(saved)
+    out["ok"] = bool(out.get("clean")
+                     and out.get("positive_control_trips",
+                                 not positive_control))
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="gpt")
@@ -323,6 +385,10 @@ def main():
                          "--mesh auto on the named topology (e.g. cpu4) "
                          "and enforce the train.<model>@auto HLO "
                          "contract")
+    ap.add_argument("--mlp", action="store_true",
+                    help="fused GLU/MLP probe: the compiled forward "
+                         "holds no [rows, 4H] activation temporary "
+                         "(positive control included)")
     ap.add_argument("--serve", action="store_true",
                     help="serving fast-path probe: the jitted serve step "
                          "compiles once across admissions and its paged "
@@ -334,6 +400,12 @@ def main():
         print(json.dumps(out))
         if not out["clean"]:
             raise SystemExit("autoplan-mesh HLO contract violated")
+        return
+    if args.mlp:
+        out = mlp_smoke()
+        print(json.dumps(out))
+        if not out["ok"]:
+            raise SystemExit("fused-MLP contract violated")
         return
     if args.serve:
         out = serve_smoke()

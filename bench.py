@@ -603,6 +603,142 @@ def bench_gpt_serve(steps, batch, seq):
     }
 
 
+def bench_gpt_serve_fleet(steps, batch, seq):
+    """Fleet-router serving (paddle_tpu/serving/fleet.py): aggregate
+    goodput + decoded tokens/s vs replica count (PT_BENCH_FLEET_REPLICAS,
+    default "1,2,4"; `batch` decode slots per replica), with each run's
+    per-replica telemetry snapshot in the row JSON. Under
+    PT_BENCH_FLEET_KILL=1 every multi-replica run also exercises the
+    failover path itself — one busy replica killed mid-stream — and
+    reports the recovery round's wall time (respawn + token-exact
+    re-route) against the mean healthy round as the failover overhead."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.serving import FleetConfig, FleetRouter, ServeConfig
+
+    cfg = GPTConfig.tiny() if TINY else GPTConfig.small()
+    cfg.dropout = 0.0
+    model = GPTDecoder(cfg)
+    variables = model.init(jax.random.key(0))
+
+    max_new = 16 if TINY else 64
+    page = int(os.environ.get("PT_BENCH_PAGE_SIZE", "64"))
+    prefill_len = min(max(page, seq // 2), cfg.max_position - max_new)
+    cache_dtype = (jnp.float32
+                   if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
+                   else jnp.bfloat16)
+    slo_ttft = float(os.environ.get("PT_BENCH_SLO_TTFT", "2.0"))
+    slo_tok = float(os.environ.get("PT_BENCH_SLO_TOKEN", "0.5"))
+    kill = os.environ.get("PT_BENCH_FLEET_KILL", "0") == "1"
+    counts = [int(x) for x in os.environ.get(
+        "PT_BENCH_FLEET_REPLICAS", "1,2,4").split(",") if x.strip()]
+
+    def serve_cfg():
+        return ServeConfig(num_slots=batch, page_size=page,
+                           max_len=prefill_len + max_new,
+                           prefill_len=prefill_len,
+                           cache_dtype=cache_dtype, slo_ttft_s=slo_ttft,
+                           slo_token_latency_s=slo_tok, metrics_port=0)
+
+    if COMPILE_ONLY:
+        router = FleetRouter(model, variables,
+                             FleetConfig(num_replicas=1, metrics_port=0),
+                             serve_config=serve_cfg())
+        t0 = time.perf_counter()
+        router._replicas[0].engine.compiled_decode()
+        router.close()
+        return {"metric": "gpt_serve_fleet_compile_only", "value": 1.0,
+                "unit": "compiled", "vs_baseline": 0.0,
+                "compile_s": round(time.perf_counter() - t0, 1)}
+
+    def settle(router):
+        # step (never drain) until quiet: drain() latches the router
+        # draining and would reject the next window's submissions
+        while any(r.status not in ("done", "rejected", "shed",
+                                   "cancelled", "failed")
+                  for r in router.requests.values()):
+            router.step()
+
+    by_replicas = {}
+    for n in counts:
+        router = FleetRouter(
+            model, variables,
+            FleetConfig(num_replicas=n, heartbeat_s=60.0,
+                        metrics_port=0),
+            serve_config=serve_cfg())
+        rng = np.random.RandomState(0)
+
+        def submit(k, router=router, rng=rng):
+            for _ in range(k):
+                plen = int(rng.randint(max(1, seq // 8),
+                                       prefill_len + 1))
+                router.submit(rng.randint(0, cfg.vocab_size, (plen,),
+                                          dtype=np.int32),
+                              max_new=max_new)
+
+        # warmup: compile every replica's prefill + decode outside the
+        # timed window
+        submit(n * batch)
+        settle(router)
+        warm = len(router.requests)
+        n_req = max(4 * batch * n, steps)
+        submit(n_req)
+        step_times = []
+        failover_ms = None
+        t0 = time.perf_counter()
+        if kill and n > 1:
+            for _ in range(3):           # measure healthy rounds first
+                s0 = time.perf_counter()
+                router.step()
+                step_times.append(time.perf_counter() - s0)
+            victim = max(range(n),
+                         key=lambda i: router._replicas[i].load())
+            router.kill_replica(victim)
+            s0 = time.perf_counter()
+            router.step()                # the failover round
+            failover_ms = round((time.perf_counter() - s0) * 1e3, 1)
+        settle(router)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        recs = [r for r in router.requests.values()
+                if r.id >= warm and r.status == "done"]
+        tokens = sum(len(r.tokens) for r in recs)
+        entry = {
+            "requests": n_req,
+            "completed": len(recs),
+            "tokens_per_sec": round(tokens / dt, 1),
+            "goodput": round(router.goodput(), 4),
+            "failovers": router.failovers,
+            "telemetry": router.telemetry(),
+        }
+        if failover_ms is not None:
+            mean_ms = 1e3 * sum(step_times) / len(step_times)
+            entry["mean_step_ms"] = round(mean_ms, 1)
+            entry["failover_step_ms"] = failover_ms
+            entry["failover_overhead_ms"] = round(failover_ms - mean_ms,
+                                                  1)
+        by_replicas[str(n)] = entry
+        router.close()
+
+    top = by_replicas[str(max(counts))]
+    return {
+        "metric": "gpt_serve_fleet_tokens_per_sec",
+        "value": top["tokens_per_sec"],
+        "unit": "decoded tokens/s (fleet aggregate)",
+        "vs_baseline": 0.0,
+        "slots_per_replica": batch,
+        "page_size": page,
+        "max_new": max_new,
+        "goodput": top["goodput"],
+        "fleet_kill": kill,
+        "by_replicas": by_replicas,
+        "note": "FleetRouter over in-process engine replicas; "
+                "least-loaded dispatch, heartbeat liveness, token-exact "
+                "failover replay (PT_BENCH_FLEET_KILL=1 kills a busy "
+                "replica mid-stream)",
+    }
+
+
 def bench_gpt(steps, batch, seq):
     """GPT-small causal-LM training step (long-context flagship; flash
     causal attention default-on)."""
@@ -963,6 +1099,9 @@ def _run_inner(args):
         res = bench_gpt_decode(args.steps, args.batch or 16, args.seq)
     elif args.model == "gpt_serve":
         res = bench_gpt_serve(args.steps, args.batch or 8, args.seq)
+    elif args.model == "gpt_serve_fleet":
+        res = bench_gpt_serve_fleet(args.steps, args.batch or 4,
+                                    args.seq)
     elif args.model == "ernie":
         res = bench_ernie(args.steps, args.batch or 64, args.seq,
                           use_flash=args.flash)
@@ -1066,7 +1205,7 @@ def _probe(timeout_s):
 # the tunnel is slow enough that bert's 240s cap trips. Override with
 # PT_BENCH_SUITE="bert,gpt".
 _MODELS = ["bert", "resnet50", "transformer_big", "gpt", "gpt_decode",
-           "gpt_serve", "ernie", "ctr"]
+           "gpt_serve", "gpt_serve_fleet", "ernie", "ctr"]
 
 
 def _suite_list():
@@ -1168,8 +1307,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="all",
                     choices=["all", "bert", "resnet50", "transformer_big",
-                             "gpt", "gpt_decode", "gpt_serve", "ernie",
-                             "ctr"])
+                             "gpt", "gpt_decode", "gpt_serve",
+                             "gpt_serve_fleet", "ernie", "ctr"])
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=512)

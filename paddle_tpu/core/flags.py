@@ -148,6 +148,25 @@ define_flag("serve_chunked_prefill", True,
             "Admit prompts longer than prefill_len in fixed-shape "
             "prefill_len chunks (one prefill trace, page tables grown "
             "per chunk); False restores the long-prompt rejection.")
+# fleet serving (serving/fleet.py): a router in front of N ServingEngine
+# replicas — least-loaded dispatch, heartbeat liveness, failover replay
+# of in-flight requests, bounded respawn, graceful drain
+define_flag("serve_replicas", 1,
+            "Engine replicas owned by the fleet router (FleetConfig "
+            "fields left unset resolve from the fleet_* flags).")
+define_flag("fleet_heartbeat_s", 1.0,
+            "Fleet router heartbeat timeout per replica, in seconds: a "
+            "replica whose ping is older than this is marked stalled "
+            "(no new dispatch); silent past heartbeat_dead_factor x "
+            "this, it is declared dead and failed over.")
+define_flag("fleet_respawn_budget", 3,
+            "Consecutive failures (crash, heartbeat death, failed "
+            "respawn) the fleet router tolerates per replica before it "
+            "stops respawning that replica and leaves it dead.")
+define_flag("fleet_drain_timeout_s", 120.0,
+            "Wall-clock budget for FleetRouter.drain() to retire every "
+            "accepted request while quiescing replicas one at a time; "
+            "0 = unbounded.")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

@@ -51,6 +51,27 @@ CATALOG = {
     "exporter.scrapes": MetricSpec(
         "counter", ("path",),
         "HTTP requests served by the /metrics exporter."),
+    # serving/fleet.py
+    "fleet.dispatch_depth": MetricSpec(
+        "gauge", ("replica",),
+        "Requests dispatched to a replica and not yet terminal, by "
+        "replica index — the single /metrics endpoint's per-replica "
+        "aggregation label."),
+    "fleet.failovers": MetricSpec(
+        "counter", (),
+        "Replica deaths handled by the fleet router (step crash past "
+        "the engine budget, killed process, heartbeat loss); each one "
+        "re-routes in-flight work and respawns the replica."),
+    "fleet.replicas": MetricSpec(
+        "gauge", ("state",),
+        "Fleet replicas by state (live | stalled | draining | dead)."),
+    "fleet.rerouted": MetricSpec(
+        "counter", (),
+        "In-flight requests re-routed to a healthy replica after a "
+        "replica death (token-exact failover replay)."),
+    "fleet.respawns": MetricSpec(
+        "counter", ("replica",),
+        "Replica respawns performed under the fleet RetryBudget."),
     # parallel/heartbeat.py
     "heartbeat.barrier_wait_s": MetricSpec(
         "counter", ("barrier",),
@@ -96,8 +117,9 @@ CATALOG = {
         "serve.step)."),
     "serve.requests": MetricSpec(
         "counter", ("status",),
-        "Request lifecycle tallies (status: submitted | completed | "
-        "rejected | shed | cancelled | failed)."),
+        "Request lifecycle tallies (status: submitted | adopted | "
+        "completed | rejected | shed | cancelled | failed; adopted = "
+        "fleet dispatch / failover replay into an engine)."),
     "serve.shed": MetricSpec(
         "counter", ("cause",),
         "Queued requests shed by deadline expiry or watchdog-driven "

@@ -18,6 +18,7 @@ Usage:
 
 import argparse
 import os
+import re
 import subprocess
 import sys
 
@@ -42,38 +43,72 @@ def init_distributed(coordinator_address=None, num_processes=None,
     return True
 
 
-def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0):
+def _gather_retryable(exc):
+    """host_allgather's wait-for-peer predicate: an absent file is the
+    normal not-published-yet state here (unlike remote I/O, where
+    core/retry.py treats FileNotFoundError as an answer), and
+    ValueError/EOFError are a peer's np.save caught mid-os.replace."""
+    return isinstance(exc, (FileNotFoundError, ValueError, EOFError,
+                            OSError))
+
+
+def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0,
+                   generation=None, policy=None):
     """All-gather host numpy arrays across local processes via the shared
     filesystem — no XLA collectives, so it works on backends where
     multi-process computations are unimplemented (jax 0.4.x CPU, where
     multihost_utils.process_allgather raises inside the worker). Each
     rank atomically publishes its array (temp file + os.replace), then
-    polls for the others. `tag` must be unique per collective call site.
-    Returns [world, *arr.shape]."""
-    import time as _time
+    waits for the others under a core/retry.py RetryPolicy (jittered
+    backoff, overall deadline = `timeout`; pass `policy` to override).
+    `tag` must be unique per collective call site. Returns
+    [world, *arr.shape].
 
+    `generation` isolates incarnations of the SAME tag (the fleet
+    router's respawned subprocess replicas restart their command
+    sequence at 0): files are published as `{tag}.g{generation}_{rank}`
+    and any file of this tag from an older generation is removed before
+    publishing, so a respawned rank can never read a dead peer's stale
+    payload as fresh."""
     import numpy as np
+
+    from paddle_tpu.core.retry import RetryPolicy
 
     os.makedirs(exchange_dir, exist_ok=True)
     arr = np.asarray(arr)
-    tmp = os.path.join(exchange_dir, f".{tag}_{rank}.tmp.npy")
+    base = tag if generation is None else f"{tag}.g{int(generation)}"
+    if generation is not None:
+        stale = re.compile(rf"^{re.escape(tag)}\.g(\d+)_\d+\.npy$")
+        for name in os.listdir(exchange_dir):
+            m = stale.match(name)
+            if m and int(m.group(1)) < int(generation):
+                try:
+                    os.remove(os.path.join(exchange_dir, name))
+                except OSError:
+                    pass           # the other rank's cleanup won the race
+    tmp = os.path.join(exchange_dir, f".{base}_{rank}.tmp.npy")
     with open(tmp, "wb") as f:
         np.save(f, arr)
-    os.replace(tmp, os.path.join(exchange_dir, f"{tag}_{rank}.npy"))
+    os.replace(tmp, os.path.join(exchange_dir, f"{base}_{rank}.npy"))
+    pol = policy or RetryPolicy(
+        max_attempts=1_000_000_000, backoff_base_s=0.005,
+        backoff_max_s=0.05, backoff_multiplier=1.5, deadline_s=timeout,
+        retryable=_gather_retryable)
     out = []
-    deadline = _time.monotonic() + timeout
     for r in range(world):
-        path = os.path.join(exchange_dir, f"{tag}_{r}.npy")
-        while True:
-            try:
-                out.append(np.load(path))
-                break
-            except (FileNotFoundError, ValueError):  # absent / mid-replace
-                if _time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"host_allgather({tag}): rank {r} did not publish "
-                        f"within {timeout}s")
-                _time.sleep(0.02)
+        path = os.path.join(exchange_dir, f"{base}_{r}.npy")
+
+        def load_peer(p=path):
+            return np.load(p)
+
+        try:
+            out.append(pol.call(load_peer))
+        except Exception as e:
+            if not _gather_retryable(e):
+                raise
+            raise TimeoutError(
+                f"host_allgather({tag}): rank {r} did not publish "
+                f"within {timeout}s") from e
     return np.stack(out)
 
 

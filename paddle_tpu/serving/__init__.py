@@ -12,8 +12,21 @@ jitted fixed-shape serve step, no per-admission retrace.
     engine = ServingEngine(model, variables, ServeConfig(num_slots=8))
     rid = engine.submit([1, 2, 3], max_new=32)
     finished = engine.drain()
+
+serving/fleet.py layers the multi-replica front door on top: a
+FleetRouter spreading traffic over N engine replicas with heartbeat
+liveness, token-exact failover replay, bounded respawn, and graceful
+drain.
+
+    router = FleetRouter(model, variables, FleetConfig(num_replicas=3))
 """
 
 from paddle_tpu.serving.engine import Request, ServeConfig, ServingEngine
+from paddle_tpu.serving.fleet import (FleetConfig, FleetRequest,
+                                      FleetRouter, InProcessReplica,
+                                      SubprocessReplica,
+                                      replica_worker_loop)
 
-__all__ = ["Request", "ServeConfig", "ServingEngine"]
+__all__ = ["Request", "ServeConfig", "ServingEngine", "FleetConfig",
+           "FleetRequest", "FleetRouter", "InProcessReplica",
+           "SubprocessReplica", "replica_worker_loop"]

@@ -220,6 +220,7 @@ class ServingEngine:
         from paddle_tpu.data.loader import DataLoader
         self._stager = DataLoader(None, prefetch=cfg.prefetch)
 
+        self.anomaly_sink = None      # fleet router watchdog uplink
         self._run_log = None
         self._own_run_log = False
         if cfg.run_log:
@@ -371,6 +372,68 @@ class ServingEngine:
         self._queue.append(req)
         _metrics.gauge("serve.queue_depth").set(len(self._queue))
         return req.id
+
+    def adopt(self, prompt, tokens=(), max_new=None, eos_id=None,
+              priority=0, deadline_t=None, submit_t=None,
+              first_token_t=None, origin="fleet"):
+        """Failover/dispatch entry for the fleet router: queue a request
+        whose generation may already be `tokens` deep, preserving the
+        caller's accounting clock — submit_t, first_token_t and the
+        ABSOLUTE deadline_t survive verbatim, so TTFT/SLO classification
+        lands on the engine that completes the request, not the one that
+        first saw it. The full replay sequence (prompt + tokens) is
+        staged exactly like a crash-recovery requeue: greedy adoption
+        finishes token-exact. Bypasses the queue_limit bound — the
+        router does its own dispatch bounding, and a failover re-route
+        must never be rejected. Returns the request id."""
+        cfg = self.cfg
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        tokens = [int(t) for t in tokens]
+        max_new = max_new if max_new is not None else cfg.default_max_new
+        cap = cfg.max_len if cfg.chunked_prefill else cfg.prefill_len
+        enforce(1 <= prompt.size <= cap,
+                f"prompt length {prompt.size} not in [1, {cap}]")
+        enforce(prompt.size + max_new <= cfg.max_len,
+                f"prompt {prompt.size} + max_new {max_new} exceeds "
+                f"max_len {cfg.max_len}")
+        enforce(len(tokens) <= max_new,
+                f"adopted with {len(tokens)} tokens > max_new {max_new}")
+        req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
+                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
+                      priority=int(priority))
+        req.tokens = tokens
+        req.deadline_t = deadline_t
+        req.first_token_t = first_token_t
+        req.trace_id = f"{self._trace_run}/{req.id}"
+        self.requests[req.id] = req
+        t = self._trace_event(req, "adopted", origin=origin,
+                              prompt_len=int(prompt.size),
+                              tokens_kept=len(tokens))
+        req.submit_t = submit_t if submit_t is not None else t
+        _metrics.counter("serve.requests").inc(status="adopted")
+        req.device_prompt = self._stage_chunks(req.output if tokens
+                                               else prompt)
+        self._queue.append(req)
+        _metrics.gauge("serve.queue_depth").set(len(self._queue))
+        return req.id
+
+    def export_inflight(self):
+        """Replica-side export of every non-terminal request's durable
+        host state — the fleet router's failover mirror, refreshed each
+        healthy round so a later kill replays token-exact from the last
+        synced point. Host-only reads (no device sync): the prompt stays
+        with the router, so entries carry ids, token mirrors, and the
+        accounting clocks `adopt()` preserves."""
+        out = []
+        live = list(self._queue) + sorted(self._running.values(),
+                                          key=lambda r: r.id)
+        for req in live:
+            out.append(dict(
+                rid=req.id, status=req.status, tokens=list(req.tokens),
+                prompt_len=int(req.prompt.size), priority=req.priority,
+                submit_t=req.submit_t, first_token_t=req.first_token_t,
+                deadline_t=req.deadline_t))
+        return out
 
     def cancel(self, request_id):
         """Client-initiated cancellation: a first-class terminal status.
@@ -924,9 +987,14 @@ class ServingEngine:
 
     def _on_anomaly(self, event):
         """Watchdog mitigation hook: a goodput collapse or ingest stall
-        sheds queued load instead of only latching a counter."""
+        sheds queued load instead of only latching a counter. When a
+        fleet router owns this engine it installs `anomaly_sink` so the
+        same signal also sheds expired/lowest-priority work fleet-wide
+        (a supervisor decision no single replica can make)."""
         if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
             self.shed_queued(cause=event["anomaly"])
+        if self.anomaly_sink is not None:
+            self.anomaly_sink(event)
 
     def _done_reason(self, req, tok):
         """Retirement reason for the token just emitted, or None."""

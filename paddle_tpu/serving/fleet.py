@@ -1,0 +1,945 @@
+"""Fleet router — fault-tolerant multi-replica serving.
+
+Ref: the reference framework's fleet runtime (fleet_wrapper / the PSLib
+server) keeps a job alive through worker death and stragglers, but its
+serving story stops at one predictor per process. This module is the
+serving-side fleet layer our ROADMAP names ("Fleet-scale serving front
+door"): a `FleetRouter` owning N `ServingEngine` replicas, so one
+replica loss degrades capacity instead of availability. Placement
+follows the hierarchical-supervisor argument of arxiv 2110.10548:
+routing and recovery decisions live in the one component that sees the
+whole topology, never inside a single engine.
+
+What the router does:
+
+  dispatch   least-loaded + priority-aware (the engine's admission key,
+             fleet-wide): per-replica bounded queues, a global
+             admission limit, expired work shed before it wastes pages.
+  liveness   per-replica heartbeat (parallel/heartbeat.py) pinged every
+             healthy round through `fault_point("fleet.heartbeat")`;
+             a silent replica goes `stalled` (no new dispatch) and,
+             past `heartbeat_dead_factor x heartbeat_s`, dead.
+  failover   on replica death (step crash past the engine's own retry
+             budget, a killed process, heartbeat loss) every in-flight
+             request is re-routed to a healthy replica with PR-7's
+             token-exact replay: the router keeps a durable host-side
+             mirror (prompt + tokens synced each round from
+             `engine.export_inflight()`), and `engine.adopt()` restages
+             it with submit_t / first_token_t / deadline / priority
+             preserved — greedy failover completions are bit-exact and
+             SLO accounting lands on the completing replica.
+  respawn    dead replicas respawn under a per-replica `RetryBudget`
+             (core/retry.py backoff pacing, `fleet.respawn` fault
+             point); a replica past its budget stays dead, the fleet
+             serves on. A fresh engine re-traces its jits once — that
+             first trace is per-engine, so `jit.retraces{fn=
+             serve.decode}` stays flat across failover.
+  degrade    engine watchdog anomalies (goodput collapse) propagate up
+             through `anomaly_sink`, and the router sheds expired /
+             lowest-priority pending work fleet-wide.
+  drain      `drain()` quiesces replicas one at a time — no new
+             dispatch to a draining replica while the rest absorb the
+             backlog — and retires every accepted request.
+
+Replicas are in-process by default (N engines, one process — the test
+and bench shape). `SubprocessReplica` + `replica_worker_loop` run an
+engine in a child process over the `parallel/launch.py host_allgather`
+file transport (one command/response exchange per round, generation-
+suffixed so a respawned worker never reads its dead predecessor's
+exchange files), with `parallel/elastic.py`-style respawn pacing.
+
+    router = FleetRouter(model, variables, FleetConfig(num_replicas=3))
+    fid = router.submit([1, 2, 3], max_new=32)
+    finished = router.drain()
+"""
+
+import collections
+import dataclasses
+import itertools
+import json
+import os
+import subprocess
+import time
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.flags import get_flag
+from paddle_tpu.core.retry import RetryBudget, RetryPolicy
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.parallel.heartbeat import STALLED, HeartBeatMonitor
+from paddle_tpu.serving.engine import ServeConfig, ServingEngine
+from paddle_tpu.testing.chaos import fault_point
+
+_TERMINAL = ("done", "rejected", "shed", "cancelled", "failed")
+
+
+class ReplicaDead(RuntimeError):
+    """A replica handle was used after its process/engine died."""
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    num_replicas: int = None      # None -> serve_replicas flag
+    heartbeat_s: float = None     # None -> fleet_heartbeat_s flag
+    heartbeat_dead_factor: float = 10.0   # silent this many heartbeats
+    #                               past the stall mark -> declared dead
+    respawn_budget: int = None    # None -> fleet_respawn_budget flag
+    drain_timeout_s: float = None  # None -> fleet_drain_timeout_s flag
+    admission_limit: int = 0      # pending + dispatched cap; 0 = off
+    replica_queue_limit: int = 0  # queued-per-replica dispatch bound;
+    #                               0 -> 2 x the engine's decode slots
+    metrics_port: int = None      # None -> metrics_port flag; 0 = off
+
+    def resolve(self):
+        if self.num_replicas is None:
+            self.num_replicas = int(get_flag("serve_replicas"))
+        if self.heartbeat_s is None:
+            self.heartbeat_s = float(get_flag("fleet_heartbeat_s"))
+        if self.respawn_budget is None:
+            self.respawn_budget = int(get_flag("fleet_respawn_budget"))
+        if self.drain_timeout_s is None:
+            self.drain_timeout_s = float(get_flag("fleet_drain_timeout_s"))
+        if self.metrics_port is None:
+            self.metrics_port = int(get_flag("metrics_port"))
+        enforce(self.num_replicas >= 1, "fleet needs at least 1 replica")
+        enforce(self.heartbeat_s > 0, "fleet_heartbeat_s must be > 0")
+        return self
+
+
+@dataclasses.dataclass
+class FleetRequest:
+    """The router's durable record of one accepted request — the
+    failover mirror. `tokens` is synced from the owning replica every
+    healthy round, so a later replica death replays prompt + tokens
+    token-exact even though the dead engine's state is gone."""
+    id: int
+    prompt: np.ndarray
+    max_new: int
+    eos_id: int = None
+    tokens: list = dataclasses.field(default_factory=list)
+    status: str = "pending"       # pending -> dispatched -> terminal
+    priority: int = 0
+    deadline_t: float = None      # absolute router-clock deadline
+    submit_t: float = None
+    first_token_t: float = None
+    done_t: float = None
+    replica: int = None           # owning (then completing) replica
+    replica_rid: int = None       # the replica-local request id
+    reroutes: int = 0             # failover re-dispatches survived
+    retire_reason: str = None
+    slo_ok: bool = None
+    retriable: bool = False
+
+    @property
+    def output(self):
+        """prompt + generated tokens (the generate()-shaped sequence)."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+
+# --------------------------------------------------------------------------
+# replica handles
+# --------------------------------------------------------------------------
+
+
+def _newly_terminal(engine, reported):
+    """Engine requests that reached a terminal status and have not been
+    reported to the router yet (`reported` is mutated). Includes
+    retirements that happened OUTSIDE engine.step() — watchdog load
+    shedding, engine-side deadline sheds — so the router's mirror never
+    orphans a dispatched record."""
+    out = [r for rid, r in engine.requests.items()
+           if r.status in _TERMINAL and rid not in reported]
+    reported.update(r.id for r in out)
+    return sorted(out, key=lambda r: r.id)
+
+
+class InProcessReplica:
+    """A ServingEngine behind the replica-handle surface the router
+    drives (dispatch/step/load/kill/respawn). `kill()` freezes the
+    handle the way a process death would — the engine object survives
+    for post-mortem, but every call raises ReplicaDead and the router
+    recovers from its own mirror, never from the corpse."""
+
+    def __init__(self, factory, anomaly_sink=None):
+        self._factory = factory
+        self._sink = anomaly_sink
+        self.engine = None
+        self._dead = False
+        self._reported = set()
+        self.respawn()
+
+    def respawn(self):
+        """Fresh engine, fresh jits — the respawned replica's first
+        decode trace is its own TracedOnce baseline, not a retrace."""
+        self.engine = self._factory()
+        if self._sink is not None:
+            self.engine.anomaly_sink = self._sink
+        self._dead = False
+        self._reported = set()
+
+    def alive(self):
+        return not self._dead
+
+    def kill(self):
+        self._dead = True
+
+    def _check(self):
+        if self._dead:
+            raise ReplicaDead("in-process replica killed")
+
+    def dispatch(self, specs):
+        self._check()
+        return [self.engine.adopt(
+            spec["prompt"], tokens=spec["tokens"],
+            max_new=spec["max_new"], eos_id=spec["eos_id"],
+            priority=spec["priority"], deadline_t=spec["deadline_t"],
+            submit_t=spec["submit_t"],
+            first_token_t=spec["first_token_t"],
+            origin=spec.get("origin", "fleet")) for spec in specs]
+
+    def cancel(self, rid):
+        self._check()
+        return self.engine.cancel(rid)
+
+    def step(self):
+        self._check()
+        eng = self.engine
+        if eng._queue or eng._running:
+            eng.step()
+        # report every retirement since the last round, not only this
+        # step() call's — the watchdog's shed_queued (and any other
+        # out-of-band retirement) must reach the router's mirror too
+        fin = _newly_terminal(eng, self._reported)
+        return {
+            "finished": [dict(rid=r.id, status=r.status,
+                              reason=r.retire_reason,
+                              tokens=list(r.tokens), slo_ok=r.slo_ok,
+                              first_token_t=r.first_token_t)
+                         for r in fin],
+            "inflight": eng.export_inflight(),
+            "queued": len(eng._queue),
+            "active": len(eng._running),
+        }
+
+    def queued(self):
+        return 0 if self._dead else len(self.engine._queue)
+
+    def load(self):
+        if self._dead:
+            return 0
+        return len(self.engine._queue) + len(self.engine._running)
+
+    def telemetry(self):
+        eng = self.engine
+        return dict(goodput=round(eng.goodput(), 4), slo=eng.slo_stats(),
+                    decode_traces=eng.decode_traces,
+                    recoveries=eng.recoveries, queued=self.queued(),
+                    active=0 if self._dead else len(eng._running),
+                    alive=self.alive())
+
+    def close(self):
+        if self.engine is not None:
+            self.engine.close()
+
+
+def _pack(obj):
+    return np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8).copy()
+
+
+def _unpack(arr):
+    return json.loads(bytes(np.asarray(arr, np.uint8).tolist()).decode())
+
+
+class SubprocessReplica:
+    """A replica over the host_allgather file transport: the engine runs
+    in a child process (the realistic failure domain — a replica kill is
+    a process kill), and the router drives one command/response exchange
+    per round (rank 0 = router, rank 1 = worker). `generation` (the
+    respawn count) suffixes every exchange file, so a respawned worker
+    restarting its sequence at 0 never reads its dead predecessor's
+    payloads — the stale-incarnation case host_allgather cleans up.
+
+    Wire times are relative (ages / seconds-remaining): the child's
+    perf_counter shares no epoch with the router's, so absolute router
+    times are converted at this boundary in both directions."""
+
+    def __init__(self, argv, exchange_dir, replica=0, env=None,
+                 timeout_s=60.0, clock=time.perf_counter):
+        self.argv = list(argv)
+        self.exchange_dir = exchange_dir
+        self.replica = replica
+        self.timeout_s = timeout_s
+        self._clock = clock
+        self._env = dict(env or {})
+        self.generation = -1
+        self._proc = None
+        self._seq = 0
+        self._lids = itertools.count()
+        self._outbox = []            # (lid, wire spec) awaiting next round
+        self._rid_to_lid = {}
+        self._counts = (0, 0)        # (queued, active) from last response
+        self.respawn()
+
+    def respawn(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+        self.generation += 1
+        self._seq = 0
+        self._outbox = []
+        self._rid_to_lid = {}
+        self._counts = (0, 0)
+        env = dict(os.environ)
+        env.update(self._env)
+        env.update({
+            "PT_FLEET_XDIR": self.exchange_dir,
+            "PT_FLEET_REPLICA": str(self.replica),
+            "PT_FLEET_GENERATION": str(self.generation),
+        })
+        self._proc = subprocess.Popen(self.argv, env=env)
+
+    def alive(self):
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.kill()
+
+    def dispatch(self, specs):
+        lids = []
+        now = self._clock()
+        for spec in specs:
+            lid = next(self._lids)
+            wire = dict(
+                key=lid,
+                prompt=np.asarray(spec["prompt"]).astype(int).tolist(),
+                tokens=[int(t) for t in spec["tokens"]],
+                max_new=int(spec["max_new"]),
+                eos_id=(None if spec["eos_id"] is None
+                        else int(spec["eos_id"])),
+                priority=int(spec["priority"]),
+                origin=spec.get("origin", "fleet"),
+                deadline_in_s=(None if spec["deadline_t"] is None
+                               else spec["deadline_t"] - now),
+                submit_age_s=(0.0 if spec["submit_t"] is None
+                              else now - spec["submit_t"]),
+                first_token_age_s=(None if spec["first_token_t"] is None
+                                   else now - spec["first_token_t"]))
+            self._outbox.append(wire)
+            lids.append(lid)
+        return lids
+
+    def cancel(self, rid):
+        return False                  # not plumbed over the wire (yet)
+
+    def _exchange(self, tag, payload):
+        from paddle_tpu.parallel import launch
+        gathered = launch.host_allgather(
+            payload, 0, 2, self.exchange_dir,
+            f"p{self.replica}.{tag}", timeout=self.timeout_s,
+            generation=self.generation)
+        return gathered[1]
+
+    def step(self):
+        if not self.alive():
+            raise ReplicaDead(
+                f"subprocess replica {self.replica} exited "
+                f"rc={self._proc.returncode}")
+        cmd = {"op": "round", "submit": self._outbox}
+        seq = self._seq
+        try:
+            self._exchange(f"q{seq}", _pack(cmd))
+            resp = _unpack(self._exchange(f"r{seq}", _pack({})))
+        except TimeoutError as e:
+            raise ReplicaDead(
+                f"subprocess replica {self.replica} unresponsive: "
+                f"{e}") from e
+        self._seq += 1
+        self._outbox = []
+        now = self._clock()
+
+        def abs_t(age):
+            return None if age is None else now - age
+
+        for sub in resp.get("submitted", []):
+            self._rid_to_lid[sub["rid"]] = sub["key"]
+        report = {"finished": [], "inflight": [],
+                  "queued": int(resp.get("queued", 0)),
+                  "active": int(resp.get("active", 0))}
+        for fin in resp.get("finished", []):
+            lid = self._rid_to_lid.pop(fin["rid"], None)
+            if lid is None:
+                continue
+            report["finished"].append(dict(
+                rid=lid, status=fin["status"], reason=fin["reason"],
+                tokens=fin["tokens"], slo_ok=fin["slo_ok"],
+                first_token_t=abs_t(fin.get("first_token_age_s"))))
+        for inf in resp.get("inflight", []):
+            lid = self._rid_to_lid.get(inf["rid"])
+            if lid is None:
+                continue
+            report["inflight"].append(dict(
+                rid=lid, status=inf["status"], tokens=inf["tokens"],
+                first_token_t=abs_t(inf.get("first_token_age_s"))))
+        self._counts = (report["queued"], report["active"])
+        return report
+
+    def queued(self):
+        return self._counts[0] + len(self._outbox)
+
+    def load(self):
+        return self._counts[0] + self._counts[1] + len(self._outbox)
+
+    def telemetry(self):
+        return dict(alive=self.alive(), generation=self.generation,
+                    queued=self._counts[0], active=self._counts[1])
+
+    def close(self):
+        if self.alive():
+            try:
+                self._exchange(f"q{self._seq}",
+                               _pack({"op": "stop", "submit": []}))
+                self._proc.wait(timeout=self.timeout_s)
+            except Exception:
+                self.kill()
+        self._proc = None
+
+
+def replica_worker_loop(engine, exchange_dir=None, replica=None,
+                        generation=None, timeout_s=60.0,
+                        clock=time.perf_counter):
+    """Child-process side of SubprocessReplica: gather one command per
+    round, adopt()/step() the local engine, publish the response.
+    Defaults resolve from the PT_FLEET_* env the parent set, so a
+    worker script is just `replica_worker_loop(ServingEngine(...))`."""
+    from paddle_tpu.parallel import launch
+
+    xdir = exchange_dir or os.environ["PT_FLEET_XDIR"]
+    rep = int(os.environ.get("PT_FLEET_REPLICA", 0)
+              if replica is None else replica)
+    gen = int(os.environ.get("PT_FLEET_GENERATION", 0)
+              if generation is None else generation)
+    seq = 0
+    reported = set()
+    while True:
+        gathered = launch.host_allgather(
+            _pack({}), 1, 2, xdir, f"p{rep}.q{seq}", timeout=timeout_s,
+            generation=gen)
+        cmd = _unpack(gathered[0])
+        now = clock()
+        submitted = []
+        for spec in cmd.get("submit", []):
+            rid = engine.adopt(
+                np.asarray(spec["prompt"], np.int32),
+                tokens=spec["tokens"], max_new=spec["max_new"],
+                eos_id=spec["eos_id"], priority=spec["priority"],
+                deadline_t=(None if spec["deadline_in_s"] is None
+                            else now + spec["deadline_in_s"]),
+                submit_t=now - spec["submit_age_s"],
+                first_token_t=(None if spec["first_token_age_s"] is None
+                               else now - spec["first_token_age_s"]),
+                origin=spec.get("origin", "fleet"))
+            submitted.append({"key": spec["key"], "rid": rid})
+        if engine._queue or engine._running:
+            engine.step()
+        fin = _newly_terminal(engine, reported)
+        now = clock()
+
+        def age(t):
+            return None if t is None else now - t
+
+        resp = {
+            "submitted": submitted,
+            "finished": [dict(rid=r.id, status=r.status,
+                              reason=r.retire_reason,
+                              tokens=list(r.tokens), slo_ok=r.slo_ok,
+                              first_token_age_s=age(r.first_token_t))
+                         for r in fin],
+            "inflight": [dict(rid=e["rid"], status=e["status"],
+                              tokens=e["tokens"],
+                              first_token_age_s=age(e["first_token_t"]))
+                         for e in engine.export_inflight()],
+            "queued": len(engine._queue),
+            "active": len(engine._running),
+        }
+        launch.host_allgather(_pack(resp), 1, 2, xdir,
+                              f"p{rep}.r{seq}", timeout=timeout_s,
+                              generation=gen)
+        seq += 1
+        if cmd.get("op") == "stop":
+            return
+
+
+# --------------------------------------------------------------------------
+# the router
+# --------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """submit()/step()/drain() over N engine replicas with failover."""
+
+    def __init__(self, model=None, variables=None, config=None,
+                 serve_config=None, replicas=None,
+                 clock=time.perf_counter):
+        self.cfg = (config or FleetConfig()).resolve()
+        cfg = self.cfg
+        self._clock = clock
+        from paddle_tpu.observability import catalog as _catalog
+        _catalog.preregister([
+            "fleet.replicas", "fleet.failovers", "fleet.rerouted",
+            "fleet.dispatch_depth", "fleet.respawns"])
+        if replicas is not None:
+            self._replicas = list(replicas)
+        else:
+            enforce(model is not None and variables is not None,
+                    "FleetRouter needs (model, variables) or explicit "
+                    "replica handles")
+            template = serve_config or ServeConfig()
+            self._replicas = [
+                InProcessReplica(
+                    self._engine_factory(model, variables, template),
+                    anomaly_sink=self._sink_for(i))
+                for i in range(cfg.num_replicas)]
+        n = len(self._replicas)
+        # submit() mirrors ServingEngine.submit defaults, so max_new must
+        # fall back to the replicas' OWN serve config, not a fresh one
+        self._default_max_new = int(next(
+            (h.engine.cfg.default_max_new for h in self._replicas
+             if isinstance(h, InProcessReplica)),
+            serve_config.default_max_new if serve_config is not None
+            else ServeConfig().default_max_new))
+        if cfg.replica_queue_limit <= 0:
+            slots = max((h.engine.cfg.num_slots
+                         for h in self._replicas
+                         if isinstance(h, InProcessReplica)), default=4)
+            cfg.replica_queue_limit = max(2, 2 * slots)
+        self._states = ["live"] * n
+        self._monitor = HeartBeatMonitor(
+            n, timeout_s=cfg.heartbeat_s, interval_s=cfg.heartbeat_s,
+            clock=clock)
+        for i in range(n):
+            self._monitor.update(i)
+        self._budgets = [
+            RetryBudget(RetryPolicy(max_attempts=cfg.respawn_budget + 1),
+                        "fleet.respawn") for _ in range(n)]
+        self.requests = {}            # fid -> FleetRequest
+        self._pending = collections.deque()
+        self._by_replica = {}         # (replica, replica_rid) -> fid
+        self._ids = itertools.count()
+        self._step_no = 0
+        self._draining = False
+        self.failovers = 0
+        from paddle_tpu.observability.exporter import start_metrics_server
+        self._metrics_server = start_metrics_server(cfg.metrics_port)
+        self._publish()
+
+    def _engine_factory(self, model, variables, template):
+        def build():
+            sc = dataclasses.replace(template)
+            sc.metrics_port = 0      # ONE exporter, owned by the router
+            return ServingEngine(model, variables, sc)
+        return build
+
+    def _sink_for(self, i):
+        return lambda event: self._on_replica_anomaly(i, event)
+
+    # -- client surface ---------------------------------------------------
+
+    def submit(self, prompt, max_new=None, eos_id=None, deadline_s=None,
+               priority=0):
+        """Accept a request fleet-wide; returns the fleet request id.
+        Mirrors ServingEngine.submit semantics (default deadline from
+        the serve_default_deadline_s flag, infeasible deadlines rejected
+        up front, retriable rejection hints) with the global admission
+        limit in place of the per-engine queue bound."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rec = FleetRequest(id=next(self._ids), prompt=prompt,
+                           max_new=(max_new if max_new is not None
+                                    else self._default_max_new),
+                           eos_id=eos_id, priority=int(priority))
+        rec.submit_t = self._clock()
+        self.requests[rec.id] = rec
+        _metrics.counter("serve.requests").inc(status="submitted")
+        if self._draining:
+            rec.retriable = True
+            self._retire(rec, "rejected", "draining")
+            return rec.id
+        if deadline_s is None:
+            default = float(get_flag("serve_default_deadline_s"))
+            deadline_s = default if default > 0 else None
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                rec.retriable = True
+                self._retire(rec, "rejected", "infeasible_deadline")
+                return rec.id
+            rec.deadline_t = rec.submit_t + float(deadline_s)
+        # rec already sits in self.requests as "pending", so the count
+        # includes this request: admit while count <= limit
+        if self.cfg.admission_limit and (
+                self._outstanding() > self.cfg.admission_limit):
+            rec.retriable = True
+            self._retire(rec, "rejected", "fleet_admission_limit")
+            return rec.id
+        self._pending.append(rec)
+        self._dispatch([])
+        return rec.id
+
+    def cancel(self, fid):
+        """Cancel a fleet request: pending records retire directly, a
+        dispatched in-process one cancels at its replica."""
+        rec = self.requests.get(fid)
+        if rec is None or rec.status in _TERMINAL:
+            return False
+        if rec.status == "pending":
+            self._pending.remove(rec)
+            self._retire(rec, "cancelled", "cancelled", account=False)
+            return True
+        handle = self._replicas[rec.replica]
+        if handle.cancel(rec.replica_rid):
+            self._by_replica.pop((rec.replica, rec.replica_rid), None)
+            self._retire(rec, "cancelled", "cancelled", account=False,
+                         count=False)
+            return True
+        return False
+
+    def step(self):
+        """One router round: dispatch pending work, step every live
+        replica (syncing the failover mirror), ping heartbeats, scan
+        for stalls/deaths. Returns the fleet requests that reached a
+        terminal status this round."""
+        finished = []
+        self._dispatch(finished)
+        for i, handle in enumerate(self._replicas):
+            if self._states[i] == "dead":
+                continue
+            if not handle.alive():
+                self._on_replica_failure(
+                    i, ReplicaDead(f"replica {i} process died"),
+                    finished)
+                continue
+            if handle.load() == 0 and not self._replica_outstanding(i):
+                self._ping(i)
+                continue
+            # load > 0, or the mirror still shows dispatched work the
+            # replica's load no longer does (an out-of-band retirement
+            # like watchdog shedding) — a round fetches the report
+            try:
+                report = handle.step()
+            except Exception as e:
+                self._on_replica_failure(i, e, finished)
+                continue
+            self._budgets[i].success()
+            self._ping(i)
+            self._sync(i, report, finished)
+        self._scan_heartbeats(finished)
+        self._publish()
+        self._step_no += 1
+        return finished
+
+    def drain(self, max_steps=200000):
+        """Retire every accepted request, quiescing replicas one at a
+        time: replica i stops receiving new dispatch (state `draining`)
+        and is stepped until idle while later replicas absorb the
+        backlog; once every replica is draining, leftover pending work
+        still dispatches to the least-loaded draining (alive) replica,
+        so nothing accepted is dropped. New submissions during drain
+        are rejected retriable. Bounded by fleet_drain_timeout_s."""
+        self._draining = True
+        t0 = self._clock()
+        budget = self.cfg.drain_timeout_s
+        out = []
+
+        def check(i=None):
+            if budget > 0 and self._clock() - t0 > budget:
+                left = [r.id for r in self.requests.values()
+                        if r.status not in _TERMINAL]
+                raise RuntimeError(
+                    f"fleet drain: {len(left)} requests not terminal "
+                    f"after {budget}s"
+                    + (f" (quiescing replica {i})" if i is not None
+                       else ""))
+
+        for _ in range(max_steps):
+            if all(s != "live" for s in self._states):
+                break
+            target = next(i for i, s in enumerate(self._states)
+                          if s == "live")
+            self._states[target] = "draining"
+            while (self._states[target] == "draining"
+                   and self._replica_outstanding(target)):
+                out.extend(self.step())
+                check(target)
+        while any(r.status not in _TERMINAL
+                  for r in self.requests.values()):
+            out.extend(self.step())
+            check()
+        self._publish()
+        return out
+
+    def kill_replica(self, i):
+        """Drill/test hook — simulate replica process death mid-decode.
+        The next step() discovers the corpse and runs the exact failover
+        path a real crash takes."""
+        self._replicas[i].kill()
+
+    def shed_pending(self, cause="overload"):
+        """Fleet-wide load shedding (the watchdog escalation): shed
+        every expired pending request; when none is expired, shed the
+        single lowest-priority / latest-deadline one — the fleet-level
+        mirror of ServingEngine.shed_queued."""
+        now = self._clock()
+        shed = [(r, "deadline_expired") for r in self._pending
+                if r.deadline_t is not None and now > r.deadline_t]
+        if not shed and self._pending:
+            shed = [(min(self._pending, key=self._victim_key), cause)]
+        for rec, why in shed:
+            self._pending.remove(rec)
+            _metrics.counter("serve.shed").inc(cause=cause)
+            self._retire(rec, "shed", why)
+        return [rec.id for rec, _ in shed]
+
+    def goodput(self):
+        """Fleet goodput: SLO-met fraction of accountable retirements
+        (cancellations excluded), wherever each request completed."""
+        done = [r for r in self.requests.values()
+                if r.status in _TERMINAL and r.status != "cancelled"]
+        if not done:
+            return 1.0
+        return sum(1 for r in done if r.slo_ok) / len(done)
+
+    def telemetry(self):
+        """Per-replica + fleet-level snapshot (the bench row payload)."""
+        return {
+            "replicas": [h.telemetry() for h in self._replicas],
+            "states": list(self._states),
+            "failovers": self.failovers,
+            "rerouted": int(sum(r.reroutes
+                                for r in self.requests.values())),
+            "respawn_failures": [b.failures for b in self._budgets],
+            "goodput": round(self.goodput(), 4),
+        }
+
+    def close(self):
+        for handle in self._replicas:
+            try:
+                handle.close()
+            except Exception:
+                pass
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _admission_key(self, rec):
+        dl = rec.deadline_t if rec.deadline_t is not None else float("inf")
+        return (-rec.priority, dl, rec.id)
+
+    def _victim_key(self, rec):
+        dl = rec.deadline_t if rec.deadline_t is not None else float("inf")
+        return (rec.priority, -dl, -rec.id)
+
+    def _outstanding(self):
+        return sum(1 for r in self.requests.values()
+                   if r.status in ("pending", "dispatched"))
+
+    def _replica_outstanding(self, i):
+        return sum(1 for r in self.requests.values()
+                   if r.status == "dispatched" and r.replica == i)
+
+    def _eligible_replicas(self):
+        live = [i for i, s in enumerate(self._states) if s == "live"]
+        if live:
+            return live
+        # every survivor is draining (late drain, or failover under
+        # drain): accepted work still has to land somewhere alive
+        return [i for i, s in enumerate(self._states)
+                if s == "draining" and self._replicas[i].alive()]
+
+    def _pick_replica(self):
+        best = None
+        for i in self._eligible_replicas():
+            handle = self._replicas[i]
+            if handle.queued() >= self.cfg.replica_queue_limit:
+                continue
+            load = handle.load()
+            if best is None or (load, i) < best[:2]:
+                best = (load, i, handle)
+        return best[1:] if best else None
+
+    def _dispatch(self, finished):
+        now = self._clock()
+        for rec in [r for r in self._pending
+                    if r.deadline_t is not None and now > r.deadline_t]:
+            self._pending.remove(rec)
+            _metrics.counter("serve.shed").inc(cause="deadline")
+            self._retire(rec, "shed", "deadline_expired", finished)
+        while self._pending:
+            target = self._pick_replica()
+            if target is None:
+                break
+            i, handle = target
+            rec = min(self._pending, key=self._admission_key)
+            try:
+                fault_point("fleet.dispatch")
+            except Exception:
+                break         # injected dispatch failure: the record
+                #               stays pending and retries next round
+            try:
+                rid = handle.dispatch([self._spec_of(rec)])[0]
+            except Exception as e:
+                self._on_replica_failure(i, e, finished)
+                continue
+            self._pending.remove(rec)
+            rec.status = "dispatched"
+            rec.replica = i
+            rec.replica_rid = rid
+            self._by_replica[(i, rid)] = rec.id
+
+    def _spec_of(self, rec, origin="fleet"):
+        return dict(prompt=rec.prompt, tokens=list(rec.tokens),
+                    max_new=rec.max_new, eos_id=rec.eos_id,
+                    priority=rec.priority, deadline_t=rec.deadline_t,
+                    submit_t=rec.submit_t,
+                    first_token_t=rec.first_token_t,
+                    origin=origin if not rec.reroutes else "failover")
+
+    # -- liveness + failover ----------------------------------------------
+
+    def _ping(self, i):
+        try:
+            fault_point("fleet.heartbeat")
+        except Exception:
+            return            # heartbeat publisher wedged: ping dropped,
+            #                   the monitor's age keeps growing
+        self._monitor.update(i)
+
+    def _scan_heartbeats(self, finished):
+        dead_after = self.cfg.heartbeat_s * self.cfg.heartbeat_dead_factor
+        for w, (st, age) in self._monitor.check().items():
+            if self._states[w] == "dead":
+                continue
+            if age > dead_after:
+                self._on_replica_failure(
+                    w, ReplicaDead(
+                        f"replica {w} heartbeat silent {age:.3f}s"),
+                    finished)
+            elif st == STALLED and self._states[w] == "live":
+                self._states[w] = "stalled"
+            elif st != STALLED and self._states[w] == "stalled":
+                self._states[w] = "live"
+
+    def _on_replica_failure(self, i, exc, finished):
+        """The failover path: count it, re-route the dead replica's
+        in-flight work from the router-side mirror, respawn under the
+        replica's RetryBudget, and re-dispatch immediately."""
+        self.failovers += 1
+        _metrics.counter("fleet.failovers").inc()
+        was = self._states[i]
+        self._states[i] = "dead"
+        self._replicas[i].kill()
+        victims = sorted(
+            (self.requests[fid]
+             for (rep, _), fid in list(self._by_replica.items())
+             if rep == i
+             and self.requests[fid].status == "dispatched"),
+            key=lambda r: r.id)
+        for key in [k for k in self._by_replica if k[0] == i]:
+            del self._by_replica[key]
+        for rec in victims:
+            rec.status = "pending"
+            rec.replica = None
+            rec.replica_rid = None
+            rec.reroutes += 1
+            _metrics.counter("fleet.rerouted").inc()
+            self._pending.append(rec)
+        self._respawn(i, exc, was, finished)
+        self._dispatch(finished)
+
+    def _respawn(self, i, exc, prev_state, finished):
+        budget = self._budgets[i]
+        while True:
+            try:
+                budget.failure(exc)   # backoff pacing; raises when spent
+            except Exception:
+                # budget exhausted: this replica stays dead
+                if not self._eligible_replicas():
+                    self._fail_all(exc, finished)
+                    raise
+                return False
+            try:
+                fault_point("fleet.respawn")
+                self._replicas[i].respawn()
+            except Exception as e:
+                exc = e
+                continue
+            _metrics.counter("fleet.respawns").inc(replica=str(i))
+            self._states[i] = ("draining" if prev_state == "draining"
+                               or self._draining else "live")
+            self._monitor.update(i)
+            return True
+
+    def _fail_all(self, exc, finished):
+        """No replica left alive: every outstanding request gets the
+        terminal `failed` status before the router re-raises, so no
+        client waits on a request that can never finish."""
+        doomed = [r for r in self.requests.values()
+                  if r.status in ("pending", "dispatched")]
+        self._pending.clear()
+        self._by_replica.clear()
+        for rec in doomed:
+            self._retire(rec, "failed", "fleet_dead", finished)
+
+    # -- record sync ------------------------------------------------------
+
+    def _sync(self, i, report, finished):
+        for fin in report["finished"]:
+            fid = self._by_replica.pop((i, fin["rid"]), None)
+            if fid is None:
+                continue
+            rec = self.requests[fid]
+            rec.tokens = list(fin["tokens"])
+            rec.status = fin["status"]
+            rec.retire_reason = fin["reason"]
+            rec.slo_ok = fin["slo_ok"]
+            if fin["first_token_t"] is not None:
+                rec.first_token_t = fin["first_token_t"]
+            rec.done_t = self._clock()
+            finished.append(rec)
+        for inf in report["inflight"]:
+            fid = self._by_replica.get((i, inf["rid"]))
+            if fid is None:
+                continue
+            rec = self.requests[fid]
+            rec.tokens = list(inf["tokens"])       # the failover mirror
+            if inf["first_token_t"] is not None:
+                rec.first_token_t = inf["first_token_t"]
+
+    def _on_replica_anomaly(self, replica, event):
+        if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
+            self.shed_pending(cause=event["anomaly"])
+
+    def _retire(self, rec, status, why, finished=None, account=True,
+                count=True):
+        rec.status = status
+        rec.retire_reason = why
+        rec.done_t = self._clock()
+        if account:
+            rec.slo_ok = False
+        if count:
+            _metrics.counter("serve.requests").inc(status=status)
+        if finished is not None:
+            finished.append(rec)
+
+    def _publish(self):
+        counts = collections.Counter(self._states)
+        g = _metrics.gauge("fleet.replicas")
+        for st in ("live", "stalled", "draining", "dead"):
+            g.set(counts.get(st, 0), state=st)
+        depth = _metrics.gauge("fleet.dispatch_depth")
+        for i, handle in enumerate(self._replicas):
+            depth.set(self._replica_outstanding(i)
+                      + sum(1 for r in self._pending
+                            if r.replica == i), replica=str(i))

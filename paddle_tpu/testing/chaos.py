@@ -240,6 +240,9 @@ class DirFS:
 FAULT_POINTS = {
     "checkpoint.fetch": "restore-side remote read of a checkpoint step",
     "checkpoint.mirror": "remote mirror push of a committed checkpoint",
+    "fleet.dispatch": "fleet router handing a request to a replica",
+    "fleet.heartbeat": "fleet router per-replica liveness ping",
+    "fleet.respawn": "fleet router respawning a dead replica",
     "serve.prefill": "serving admission prefill (per chunk) device call",
     "serve.step": "the jitted continuous-batching decode step",
     "trainer.ingest": "ingest-channel dequeue feeding the train step",

@@ -1,0 +1,510 @@
+"""Fleet router: fault-tolerant multi-replica serving.
+
+The acceptance contract: a replica loss degrades capacity, never
+correctness — every accepted request reaches a terminal status, requests
+re-routed after a replica death finish token-exact vs an undisturbed
+single-engine run (greedy failover replay), deadline/priority/SLO
+accounting survive the re-route and land on the completing replica, and
+`drain()` retires everything with zero `failed`. Also covers the
+host_allgather rewrite (RetryPolicy wait + generation-isolated stale
+exchange files) the subprocess replica transport rides on."""
+
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.core.flags import all_flags, set_flags
+from paddle_tpu.testing import chaos
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+@pytest.fixture
+def flags_guard():
+    saved = all_flags()
+    yield
+    set_flags(saved)
+
+
+@pytest.fixture
+def fast_retry(flags_guard):
+    """Failover/respawn backoff in microseconds, not production pacing."""
+    set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+
+
+def _tiny_decoder(seed=0):
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    cfg = GPTConfig.tiny()
+    cfg.dropout = 0.0
+    cfg.use_flash = False
+    model = GPTDecoder(cfg)
+    return model, model.init(jax.random.key(seed)), cfg
+
+
+_MODEL_CACHE = {}
+
+
+def _shared_decoder():
+    """One tiny decoder per test session — fleets build several engines,
+    and only the engine state must be fresh, not the weights."""
+    if "m" not in _MODEL_CACHE:
+        _MODEL_CACHE["m"] = _tiny_decoder()
+    return _MODEL_CACHE["m"]
+
+
+def _serve_cfg(**kw):
+    from paddle_tpu.serving import ServeConfig
+    base = dict(num_slots=2, page_size=8, max_len=64, prefill_len=16,
+                metrics_port=0)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _router(num_replicas=2, serve_kw=None, **fleet_kw):
+    from paddle_tpu.serving import FleetConfig, FleetRouter
+    model, variables, cfg = _shared_decoder()
+    fleet_kw.setdefault("heartbeat_s", 5.0)   # liveness tests override
+    fleet_kw.setdefault("metrics_port", 0)
+    router = FleetRouter(
+        model, variables,
+        FleetConfig(num_replicas=num_replicas, **fleet_kw),
+        serve_config=_serve_cfg(**(serve_kw or {})))
+    return router, model, variables, cfg
+
+
+def _fake_clock(router, t0=100.0):
+    """Swap the router + heartbeat monitor onto one settable clock and
+    re-stamp every replica's last ping at the new epoch."""
+    clk = {"t": t0}
+    router._clock = lambda: clk["t"]
+    router._monitor._clock = router._clock
+    for i in range(len(router._replicas)):
+        router._monitor.update(i)
+    return clk
+
+
+def _mixed_prompts(cfg, n, seed=0, lo=3, hi=30):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size,
+                        (int(rng.randint(lo, hi)),), np.int32)
+            for _ in range(n)]
+
+
+def _publish_raw(xdir, tag, arr):
+    """Drop an exchange file the way a (now dead) peer would have."""
+    tmp = os.path.join(xdir, "_t.npy")
+    np.save(tmp, arr)
+    os.replace(tmp, os.path.join(xdir, tag + ".npy"))
+
+
+# --------------------------------------------------------------------------
+# host_allgather: RetryPolicy wait + stale-incarnation cleanup
+# --------------------------------------------------------------------------
+
+
+class TestHostAllgather:
+    def test_delayed_writer(self, tmp_path):
+        """The gather waits out a slow peer under the RetryPolicy
+        instead of failing fast."""
+        from paddle_tpu.parallel import launch
+        xdir = str(tmp_path)
+        mine = np.arange(4, dtype=np.int32)
+        theirs = np.arange(4, 8, dtype=np.int32)
+
+        def late_publish():
+            time.sleep(0.25)
+            launch.host_allgather(theirs, 1, 2, xdir, "slow", timeout=5.0)
+
+        t = threading.Thread(target=late_publish)
+        t.start()
+        out = launch.host_allgather(mine, 0, 2, xdir, "slow", timeout=5.0)
+        t.join()
+        assert np.array_equal(out, np.stack([mine, theirs]))
+
+    def test_timeout_still_raises_timeout_error(self, tmp_path):
+        from paddle_tpu.parallel import launch
+        with pytest.raises(TimeoutError, match="rank 1 did not publish"):
+            launch.host_allgather(np.zeros(2, np.int32), 0, 2,
+                                  str(tmp_path), "alone", timeout=0.2)
+
+    def test_stale_file_collision_cleaned_by_generation(self, tmp_path):
+        """A dead incarnation's payloads under the SAME tag (earlier
+        generation) are neither read as fresh nor left on disk: the
+        respawned generation publishes suffix-isolated files and removes
+        the stale ones before waiting."""
+        from paddle_tpu.parallel import launch
+        xdir = str(tmp_path)
+        stale = np.full(3, 99, np.int32)
+        fresh = np.arange(3, dtype=np.int32)
+        # what a completed generation-0 round leaves when both ranks die
+        _publish_raw(xdir, "c0.g0_0", stale)
+        _publish_raw(xdir, "c0.g0_1", stale)
+
+        def peer():
+            launch.host_allgather(fresh + 10, 1, 2, xdir, "c0",
+                                  timeout=5.0, generation=1)
+
+        t = threading.Thread(target=peer)
+        t.start()
+        out = launch.host_allgather(fresh, 0, 2, xdir, "c0",
+                                    timeout=5.0, generation=1)
+        t.join()
+        assert np.array_equal(out[0], fresh)
+        assert np.array_equal(out[1], fresh + 10)   # not the stale 99s
+        left = sorted(f for f in os.listdir(xdir) if ".g0_" in f)
+        assert left == [], f"stale generation-0 files survived: {left}"
+
+    def test_generation_isolation_times_out_instead_of_stale_read(
+            self, tmp_path):
+        """With only a dead predecessor's file present, a new-generation
+        gather times out rather than returning the stale payload."""
+        from paddle_tpu.parallel import launch
+        xdir = str(tmp_path)
+        _publish_raw(xdir, "x0.g0_1", np.full(3, 99, np.int32))
+        with pytest.raises(TimeoutError):
+            launch.host_allgather(np.zeros(3, np.int32), 0, 2, xdir,
+                                  "x0", timeout=0.2, generation=1)
+
+
+# --------------------------------------------------------------------------
+# failover replay
+# --------------------------------------------------------------------------
+
+
+class TestFailoverReplay:
+    def test_replica_death_reroute_token_exact_vs_single_engine(
+            self, fast_retry):
+        """Kill a replica mid-decode: every re-routed request completes
+        on a healthy replica with EXACTLY the tokens an undisturbed
+        single-engine run produces."""
+        from paddle_tpu.serving import ServingEngine
+        router, model, variables, cfg = _router(num_replicas=2)
+        prompts = _mixed_prompts(cfg, 6, seed=1)
+        fids = [router.submit(p, max_new=8) for p in prompts]
+        for _ in range(2):
+            router.step()
+        victim = next(i for i in range(2)
+                      if router._replicas[i].load() > 0)
+        router.kill_replica(victim)
+        router.drain()
+
+        undisturbed = ServingEngine(model, variables, _serve_cfg())
+        rids = [undisturbed.submit(p, max_new=8) for p in prompts]
+        undisturbed.drain()
+
+        rerouted = [fid for fid in fids if router.requests[fid].reroutes]
+        assert rerouted, "kill landed on an idle replica"
+        assert router.failovers == 1
+        for fid, rid in zip(fids, rids):
+            rec = router.requests[fid]
+            assert rec.status == "done", (fid, rec.status)
+            assert np.array_equal(rec.output,
+                                  undisturbed.requests[rid].output), fid
+        undisturbed.close()
+        router.close()
+
+    def test_deadline_priority_survive_reroute(self, fast_retry):
+        """The re-routed request reaches the new replica with its
+        ORIGINAL absolute deadline, priority, and submit time — not
+        re-stamped at failover time."""
+        router, model, variables, cfg = _router(num_replicas=2)
+        p = _mixed_prompts(cfg, 1, seed=2)[0]
+        fid = router.submit(p, max_new=10, deadline_s=30.0, priority=3)
+        rec = router.requests[fid]
+        want_deadline, want_submit = rec.deadline_t, rec.submit_t
+        for _ in range(2):
+            router.step()
+        assert rec.status == "dispatched"
+        router.kill_replica(rec.replica)
+        router.drain()
+        assert rec.status == "done" and rec.reroutes >= 1
+        assert rec.deadline_t == want_deadline
+        assert rec.submit_t == want_submit
+        req = router._replicas[rec.replica].engine.requests[
+            rec.replica_rid]
+        assert req.priority == 3
+        assert req.deadline_t == want_deadline
+        assert req.submit_t == want_submit
+        router.close()
+
+    def test_slo_accounting_lands_on_completing_replica(self, fast_retry):
+        """SLO classification of a failed-over request happens at the
+        replica that completes it, against the PRESERVED submit and
+        first-token clocks — fleet goodput sees one request, not two."""
+        router, model, variables, cfg = _router(
+            num_replicas=2,
+            serve_kw=dict(slo_ttft_s=120.0, slo_token_latency_s=60.0))
+        p = _mixed_prompts(cfg, 1, seed=3)[0]
+        fid = router.submit(p, max_new=10)
+        for _ in range(2):
+            router.step()
+        rec = router.requests[fid]
+        first_token_before = rec.first_token_t
+        assert first_token_before is not None   # mirror synced it
+        dead = rec.replica
+        router.kill_replica(dead)
+        router.drain()
+        assert rec.status == "done" and rec.replica != dead
+        completing = router._replicas[rec.replica].engine
+        assert completing.slo_stats()["retired"] >= 1
+        assert rec.slo_ok is True
+        # recovery replay keeps the FIRST first-token time
+        assert completing.requests[rec.replica_rid].first_token_t == (
+            first_token_before)
+        assert router.goodput() == 1.0
+        router.close()
+
+    def test_drain_retires_everything_zero_failed(self, fast_retry):
+        """drain() under a mid-drain replica kill: every accepted
+        request terminal, none `failed`, replicas quiesced."""
+        router, model, variables, cfg = _router(num_replicas=3)
+        prompts = _mixed_prompts(cfg, 10, seed=4)
+        fids = [router.submit(p, max_new=6) for p in prompts]
+        router.step()
+        busy = next(i for i in range(3)
+                    if router._replicas[i].load() > 0)
+        router.kill_replica(busy)
+        done = router.drain()
+        statuses = [router.requests[fid].status for fid in fids]
+        assert all(s == "done" for s in statuses), statuses
+        assert len(done) >= len(fids)
+        assert not any(r.status == "failed"
+                       for r in router.requests.values())
+        assert all(h.load() == 0 for h in router._replicas if h.alive())
+        # post-drain submissions are rejected with the retriable hint
+        late = router.submit(prompts[0], max_new=4)
+        assert router.requests[late].status == "rejected"
+        assert router.requests[late].retriable
+        router.close()
+
+
+# --------------------------------------------------------------------------
+# liveness, budget, admission, shed, metrics
+# --------------------------------------------------------------------------
+
+
+class TestLivenessAndPolicy:
+    def test_heartbeat_stall_blocks_dispatch_then_recovers(
+            self, fast_retry):
+        """A dropped ping past heartbeat_s marks the replica stalled (no
+        new dispatch); the next ping returns it to live. A stall alone
+        never counts as a failover."""
+        router, model, variables, cfg = _router(
+            num_replicas=2, heartbeat_s=1.0, heartbeat_dead_factor=50.0)
+        clk = _fake_clock(router)
+        plan = chaos.FaultPlan().fail(
+            "fault_point", path=r"^fleet\.heartbeat$", times=1)
+        with chaos.active(plan):      # replica 0 pings first -> dropped
+            clk["t"] += 1.5
+            router.step()
+        assert router._states == ["stalled", "live"]
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=5)[0], max_new=4)
+        assert router.requests[fid].replica == 1   # no dispatch to 0
+        clk["t"] += 0.1
+        router.step()                 # pings flow again -> recovery
+        assert router._states[0] == "live"
+        assert router.failovers == 0
+        router.drain()
+        assert router.requests[fid].status == "done"
+        router.close()
+
+    def test_heartbeat_death_triggers_failover(self, fast_retry):
+        """A replica silent past heartbeat_dead_factor x heartbeat_s is
+        declared dead and failed over even though step() never raised."""
+        router, model, variables, cfg = _router(
+            num_replicas=2, heartbeat_s=1.0, heartbeat_dead_factor=3.0)
+        clk = _fake_clock(router)
+        plan = chaos.FaultPlan().fail(
+            "fault_point", path=r"^fleet\.heartbeat$", times=100)
+        with chaos.active(plan):      # ALL pings drop
+            clk["t"] += 4.0
+            router.step()
+        assert router.failovers >= 1
+        router.close()
+
+    def test_respawn_budget_exhaustion_fails_outstanding(
+            self, fast_retry):
+        """Respawns failing past fleet_respawn_budget leave the replica
+        dead; with no survivor the router fails every outstanding
+        request (terminal `failed`) and re-raises — nobody waits on a
+        request that can never finish."""
+        router, model, variables, cfg = _router(num_replicas=1,
+                                                respawn_budget=2)
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=6)[0], max_new=6)
+        router.step()
+        plan = chaos.FaultPlan().fail(
+            "fault_point", path=r"^fleet\.respawn$", times=100)
+        with chaos.active(plan):
+            router.kill_replica(0)
+            with pytest.raises(Exception):
+                router.step()
+        assert router.requests[fid].status == "failed"
+        assert router._budgets[0].failures <= router.cfg.respawn_budget + 1
+        assert router._states == ["dead"]
+        router.close()
+
+    def test_admission_limit_and_dispatch_fault(self, fast_retry):
+        """The global admission limit rejects (retriable) instead of
+        queueing; an injected fleet.dispatch fault delays, never loses,
+        a pending request."""
+        router, model, variables, cfg = _router(
+            num_replicas=2, admission_limit=3,
+            serve_kw=dict(num_slots=1))
+        prompts = _mixed_prompts(cfg, 4, seed=7, lo=3, hi=10)
+        plan = chaos.FaultPlan().fail(
+            "fault_point", path=r"^fleet\.dispatch$", times=2)
+        with chaos.active(plan):
+            fids = [router.submit(p, max_new=4) for p in prompts]
+            over = [fid for fid in fids
+                    if router.requests[fid].status == "rejected"]
+            assert len(over) == 1 and router.requests[over[0]].retriable
+            assert router.requests[over[0]].retire_reason == (
+                "fleet_admission_limit")
+            router.drain()
+        for fid in fids:
+            if fid not in over:
+                assert router.requests[fid].status == "done"
+        assert plan.fired("fault_point") == 2
+        router.close()
+
+    def test_watchdog_anomaly_sheds_fleet_wide(self, fast_retry):
+        """A replica watchdog anomaly propagates through anomaly_sink
+        and sheds the lowest-priority PENDING request at the router —
+        the fleet-wide mirror of the engine's own shed_queued."""
+        router, model, variables, cfg = _router(
+            num_replicas=1, serve_kw=dict(num_slots=1))
+        router.cfg.replica_queue_limit = 1   # keep work router-pending
+        prompts = _mixed_prompts(cfg, 4, seed=8, lo=3, hi=10)
+        fids = [router.submit(p, max_new=4, priority=i)
+                for i, p in enumerate(prompts)]
+        pending = [fid for fid in fids
+                   if router.requests[fid].status == "pending"]
+        assert pending, "setup: nothing stayed router-pending"
+        eng = router._replicas[0].engine
+        eng._on_anomaly({"anomaly": "goodput_collapse"})
+        shed = [fid for fid in pending
+                if router.requests[fid].status == "shed"]
+        assert shed == [min(pending)]     # the lowest-priority victim
+        router.drain()
+        assert all(router.requests[fid].status in ("done", "shed")
+                   for fid in fids)
+        router.close()
+
+    def test_single_metrics_endpoint_aggregates_replicas(
+            self, fast_retry):
+        """One /metrics endpoint over the ONE registry exports the
+        fleet.* family with per-replica labels."""
+        from paddle_tpu.observability.exporter import MetricsServer
+        router, model, variables, cfg = _router(num_replicas=2)
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=9)[0], max_new=4)
+        router.step()
+        with MetricsServer(port=0, host="127.0.0.1") as srv:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+        assert 'fleet_replicas{state="live"} 2' in body
+        assert 'fleet_dispatch_depth{replica="0"}' in body
+        assert 'fleet_dispatch_depth{replica="1"}' in body
+        assert "serve_requests" in body
+        router.drain()
+        assert router.requests[fid].status == "done"
+        router.close()
+
+
+# --------------------------------------------------------------------------
+# subprocess transport + the full drill (slow)
+# --------------------------------------------------------------------------
+
+
+_WORKER = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+import jax
+from paddle_tpu.core import flags as F
+from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+from paddle_tpu.serving import ServeConfig, ServingEngine
+from paddle_tpu.serving.fleet import replica_worker_loop
+
+F.set_flags({{'retry_backoff_base_s': 0.001, 'retry_jitter': 0.0}})
+cfg = GPTConfig.tiny(); cfg.dropout = 0.0; cfg.use_flash = False
+model = GPTDecoder(cfg)
+variables = model.init(jax.random.key(0))
+engine = ServingEngine(model, variables, ServeConfig(
+    num_slots=2, page_size=8, max_len=64, prefill_len=16,
+    metrics_port=0))
+replica_worker_loop(engine)
+"""
+
+
+@pytest.mark.slow
+def test_subprocess_replica_failover_end_to_end(tmp_path, fast_retry):
+    """A replica engine in a child process over the host_allgather
+    transport: dispatch + decode round-trips work, a kill -9 mid-stream
+    is detected, the worker respawns at generation+1 (stale exchange
+    files isolated), and re-routed requests finish token-exact."""
+    import sys as _sys
+
+    from paddle_tpu.serving import (FleetConfig, FleetRouter,
+                                    ServingEngine)
+    from paddle_tpu.serving.fleet import (InProcessReplica,
+                                          SubprocessReplica)
+    model, variables, cfg = _shared_decoder()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+    sub = SubprocessReplica(
+        [_sys.executable, str(script)], str(tmp_path / "xdir"),
+        replica=0, timeout_s=120.0)
+    spare = InProcessReplica(
+        lambda: ServingEngine(model, variables, _serve_cfg()))
+    router = FleetRouter(
+        config=FleetConfig(num_replicas=2, heartbeat_s=200.0,
+                           metrics_port=0),
+        replicas=[sub, spare])
+    try:
+        prompts = _mixed_prompts(cfg, 3, seed=11)
+        fids = [router.submit(p, max_new=6) for p in prompts]
+        on_sub = [f for f in fids if router.requests[f].replica == 0]
+        assert on_sub, "no request landed on the subprocess replica"
+        router.step()                  # at least one full wire round
+        sub.kill()                     # kill -9 the worker process
+        router.drain()
+
+        undisturbed = ServingEngine(model, variables, _serve_cfg())
+        rids = [undisturbed.submit(p, max_new=6) for p in prompts]
+        undisturbed.drain()
+        for fid, rid in zip(fids, rids):
+            rec = router.requests[fid]
+            assert rec.status == "done", (fid, rec.status)
+            assert np.array_equal(rec.output,
+                                  undisturbed.requests[rid].output)
+        assert router.failovers >= 1
+        assert any(router.requests[f].reroutes for f in on_sub)
+        assert sub.generation >= 1     # respawned incarnation
+        undisturbed.close()
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_fleet_chaos_drill_end_to_end():
+    """The full tools/chaos_drill.py --fleet scenario: 3 replicas,
+    mixed traffic, one kill mid-decode + one heartbeat stall."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drill_fleet", os.path.join(repo, "tools",
+                                          "chaos_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    summary = mod.run_fleet_drill()
+    assert summary["failovers"] == summary["injected_kills"] == 1
+    assert summary["statuses"].get("failed", 0) == 0
+    assert summary["token_exact"] == 9

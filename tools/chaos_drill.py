@@ -29,12 +29,21 @@ cancelled), that every COMPLETED greedy request is token-exact vs a
 per-request generate() reference despite the recoveries, and that each
 injected fault produced exactly one engine recovery.
 
+Fleet drill (--fleet): 3 in-process engine replicas behind a
+FleetRouter — mixed traffic, one replica killed mid-decode, one
+injected `fleet.heartbeat` stall. Verifies 100% terminal requests,
+token-exact greedy completions through the failover replay,
+`fleet.failovers` == injected kills (the stall recovers, it does not
+fail over), and every replica inside its respawn RetryBudget.
+
 Usage:
     python tools/chaos_drill.py [--steps 8] [--workdir DIR]
     python tools/chaos_drill.py --serve
+    python tools/chaos_drill.py --fleet
 
 Also exercised as tests (tests/test_chaos.py slow-marked train drill;
-tests/test_serve_resilience.py serve drill).
+tests/test_serve_resilience.py serve drill; tests/test_fleet_router.py
+fleet drill).
 """
 
 import argparse
@@ -242,6 +251,140 @@ def run_serve_drill(seed=0):
         F.set_flags(saved)
 
 
+def run_fleet_drill(seed=0):
+    """Fleet failover drill: 3 in-process replicas behind a FleetRouter,
+    mixed traffic (chunked prompts, priorities, an expiring deadline, an
+    infeasible one), one replica killed mid-decode plus one injected
+    heartbeat stall. Verifies 100% of submitted requests reach a
+    terminal status, completions that survived the failover are
+    token-exact vs per-request generate() references,
+    `fleet.failovers` == injected kills (the transient stall must NOT
+    count), no replica exceeds its respawn RetryBudget, and
+    `jit.retraces{fn=serve.decode}` stays flat across the failover."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core import flags as F
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.serving import FleetConfig, FleetRouter, ServeConfig
+    from paddle_tpu.testing import chaos
+
+    def _decode_retraces():
+        snap = _metrics.counter("jit.retraces").snapshot()
+        return sum(v for k, v in snap.items() if "serve.decode" in k)
+
+    saved = F.all_flags()
+    router = None
+    try:
+        F.set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        cfg.use_flash = False
+        model = GPTDecoder(cfg)
+        variables = model.init(jax.random.key(0))
+        router = FleetRouter(
+            model, variables,
+            FleetConfig(num_replicas=3, heartbeat_s=0.04,
+                        heartbeat_dead_factor=200.0, respawn_budget=3),
+            serve_config=ServeConfig(num_slots=2, page_size=8,
+                                     max_len=64, prefill_len=16,
+                                     step_retries=4))
+        rng = np.random.RandomState(seed)
+
+        # mixed traffic: short + chunked (> prefill_len) prompts, a
+        # priority spread, generous deadlines on two of them
+        specs = [(5, 6, 0, None), (30, 8, 1, None), (9, 5, 0, 30.0),
+                 (45, 10, 2, None), (3, 7, 0, None), (12, 6, 1, 30.0),
+                 (20, 8, 0, None), (7, 5, 0, None), (26, 9, 1, None)]
+        prompts = [rng.randint(0, cfg.vocab_size, (L,), dtype=np.int32)
+                   for L, _, _, _ in specs]
+        accepted = [router.submit(p, max_new=mn, priority=pr,
+                                  deadline_s=dl)
+                    for p, (_, mn, pr, dl) in zip(prompts, specs)]
+        expiring = router.submit(
+            rng.randint(0, cfg.vocab_size, (8,), dtype=np.int32),
+            max_new=4, deadline_s=0.004)
+        infeasible = router.submit(
+            rng.randint(0, cfg.vocab_size, (4,), dtype=np.int32),
+            max_new=4, deadline_s=0.0)
+        _time.sleep(0.02)              # let the 0.004s deadline pass
+
+        retraces0 = _decode_retraces()
+        missed0 = sum(_metrics.counter(
+            "heartbeat.missed").snapshot().values())
+        plan = chaos.FaultPlan(seed=seed)
+        # one heartbeat stall: the ping is dropped after a 0.1s wedge,
+        # so the next scan sees age > heartbeat_s and marks the replica
+        # stalled — it must recover on the following ping, NOT fail over
+        plan.fail("fault_point", path=r"^fleet\.heartbeat$", nth=4,
+                  times=1, latency_s=0.1,
+                  exc=chaos.InjectedFault("heartbeat publisher wedged"))
+        kills = 0
+        with chaos.active(plan):
+            for _ in range(4):
+                router.step()
+            stalled_seen = "stalled" in router._states
+            busy = [i for i in range(3)
+                    if router._replicas[i].load() > 0]
+            router.kill_replica(busy[-1])   # process death mid-decode
+            kills += 1
+            router.drain()
+
+        # -- verify ------------------------------------------------------
+        statuses = {fid: r.status for fid, r in router.requests.items()}
+        terminal = {"done", "rejected", "shed", "cancelled", "failed"}
+        stuck = {fid: s for fid, s in statuses.items()
+                 if s not in terminal}
+        assert not stuck, f"non-terminal requests after drain: {stuck}"
+        assert all(statuses[fid] == "done" for fid in accepted), statuses
+        assert statuses[expiring] == "shed", statuses
+        assert statuses[infeasible] == "rejected", statuses
+        assert not any(s == "failed" for s in statuses.values())
+        assert router.failovers == kills, (router.failovers, kills)
+        hb_faults = len([e for e in plan.log
+                         if e[2].startswith("raise")])
+        assert hb_faults == 1, f"expected 1 injected stall, {hb_faults}"
+        missed = sum(_metrics.counter(
+            "heartbeat.missed").snapshot().values()) - missed0
+        assert stalled_seen or missed >= 1, (
+            "the injected heartbeat stall was never observed")
+        budget = router.cfg.respawn_budget
+        over = [b.failures for b in router._budgets
+                if b.failures > budget]
+        assert not over, f"replica exceeded its RetryBudget: {over}"
+        rerouted = [fid for fid in accepted
+                    if router.requests[fid].reroutes]
+        assert rerouted, "no request actually failed over"
+        for fid, p, (_, mn, _, _) in zip(accepted, prompts, specs):
+            ref = model.apply(variables, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, mn))
+            got = router.requests[fid].output
+            assert np.array_equal(got, np.asarray(ref)[0]), (
+                f"request {fid} not token-exact after failover")
+        assert _decode_retraces() == retraces0, (
+            "serve.decode retraced across failover")
+        for h in router._replicas:
+            if h.alive() and h.engine.decode_traces:
+                assert h.engine.decode_traces == 1, h.engine.decode_traces
+        return dict(
+            submitted=len(statuses),
+            statuses={s: sum(1 for v in statuses.values() if v == s)
+                      for s in sorted(set(statuses.values()))},
+            injected_kills=kills, failovers=router.failovers,
+            heartbeat_stalls=missed, rerouted=rerouted,
+            respawn_failures=[b.failures for b in router._budgets],
+            token_exact=len(accepted))
+    finally:
+        if router is not None:
+            router.close()
+        F.set_flags(saved)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -251,10 +394,19 @@ def main():
     ap.add_argument("--serve", action="store_true",
                     help="run the serving resilience drill instead of "
                          "the train drill")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet router failover drill instead "
+                         "of the train drill")
     args = ap.parse_args()
     if args.serve:
         summary = run_serve_drill()
         print("\n=== serve chaos drill PASSED ===")
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+        return
+    if args.fleet:
+        summary = run_fleet_drill()
+        print("\n=== fleet chaos drill PASSED ===")
         for k, v in summary.items():
             print(f"  {k}: {v}")
         return

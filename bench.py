@@ -147,6 +147,18 @@ def _co(name, jitted, *args):
         with open(DUMP_HLO, "w") as f:
             f.write(compiled.as_text())
         row["hlo"] = DUMP_HLO
+        try:
+            ca = compiled.cost_analysis()
+        except Exception:
+            ca = None
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            # XLA's own pricing of the compiled module — what the
+            # MaxHloFlops/MaxHloBytes budget contracts judge against
+            with open(DUMP_HLO + ".cost.json", "w") as f:
+                json.dump({k: float(v) for k, v in ca.items()}, f)
+            row["cost"] = DUMP_HLO + ".cost.json"
     return _mesh_row(row)
 
 

@@ -12,12 +12,31 @@ serving prefill/decode steps. compile_smoke stays the thing that
 violation fixtures in tests/test_lint.py prove each judge actually
 fires.
 
-Stdlib-only: contracts see text, never jax objects, so the table is
-importable by the lint CLI without paying the jax import.
+Two judge families live here beyond the structural HLO checks:
+
+* **budget contracts** (:class:`MaxHloFlops` / :class:`MaxHloBytes`) —
+  the compiled module's XLA ``cost_analysis()`` figures may not exceed
+  what the autoplan cost model predicted times a calibrated tolerance.
+  No hand-written byte constants: retuning the cost model retunes the
+  budget.
+* **snapshot gates** (:class:`HloSnapshot`, :data:`CONTRACT_SNAPSHOTS`)
+  — the normalized opcode histogram of the compiled module must match
+  the blessed record under tests/fixtures/hlo_snapshots/; structural
+  drift fails until re-blessed with
+  ``tools/graft_lint.py --contracts --update-snapshots``.
+
+Stdlib-only: contracts see text and cost dicts, never jax objects, so
+the table is importable by the lint CLI without paying the jax import
+(the cost model and topology table it prices budgets with are loaded by
+file path and are themselves stdlib-only).
 """
 
 import dataclasses
+import hashlib
+import importlib.util
+import json
 import math
+import os
 import re
 
 # every HLO dtype token we may meet in shapes, with its bit width
@@ -60,10 +79,23 @@ class Violation:
 class ContractContext:
     """What a compile produced, as text: per-device compiled HLO
     (``.compile().as_text()``), lowered/jaxpr text when the caller has
-    it, and runtime trace counts for the TracedOnce contract."""
+    it, runtime trace counts for the TracedOnce contract, and the
+    normalized ``cost_analysis()`` dict for the budget contracts."""
     hlo_text: str = None
     jaxpr_text: str = None
     trace_counts: dict = None
+    cost: dict = None
+
+
+def normalize_cost(raw):
+    """``compiled.cost_analysis()`` returns a dict on some jax versions
+    and a per-device list of dicts on others; flatten to one
+    {metric: float} dict (None when there is nothing to judge)."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not raw:
+        return None
+    return {str(k): float(v) for k, v in raw.items()}
 
 
 class Contract:
@@ -253,6 +285,148 @@ class MaxDtypeWidth(Contract):
         return out
 
 
+class MaxHloCost(Contract):
+    """Budget contract: one XLA ``cost_analysis()`` metric of the
+    compiled module may not exceed ``predicted * tolerance``, where
+    ``predicted`` comes from the autoplan cost model (never a
+    hand-written constant). Holds vacuously when the context carries no
+    cost dict — text-only evaluations judge the structural contracts
+    only."""
+
+    metric = None   # short label ("flops" / "bytes")
+    key = None      # cost_analysis dict key
+
+    def __init__(self, predicted, tolerance, source=""):
+        self.predicted = float(predicted)
+        self.tolerance = float(tolerance)
+        self.budget = self.predicted * self.tolerance
+        self.source = source
+        self.name = f"max-hlo-{self.metric}(<={self.budget:.4g})"
+
+    def with_tolerance(self, tolerance):
+        """Clone at a different tolerance — ``with_tolerance(0)`` is the
+        positive control proving the detector trips on any real
+        compile."""
+        return type(self)(self.predicted, tolerance, source=self.source)
+
+    def check(self, ctx):
+        if ctx.cost is None:
+            return []
+        actual = ctx.cost.get(self.key)
+        if actual is None:
+            return [f"cost analysis carries no {self.key!r} metric — "
+                    "cannot judge the budget"]
+        if actual > self.budget:
+            return [f"compiled {self.metric} {actual:.4g} exceeds budget "
+                    f"{self.budget:.4g} (= {self.predicted:.4g} predicted"
+                    f" by {self.source or 'the cost model'} x "
+                    f"{self.tolerance:g} tolerance)"]
+        return []
+
+
+class MaxHloFlops(MaxHloCost):
+    metric = "flops"
+    key = "flops"
+
+
+class MaxHloBytes(MaxHloCost):
+    metric = "bytes"
+    key = "bytes accessed"
+
+
+# --- differential snapshot gate --------------------------------------
+
+# one HLO instruction: "%name = <types> opcode(operands), ..." — the
+# opcode is the first bare lowercase token followed by '(' after the '='
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[^=]*?([a-z][a-z0-9\-]*)\(")
+
+
+def hlo_op_histogram(text):
+    """Opcode -> count over every instruction in an HLO module's text.
+    Instruction *names* and shapes are ignored, so the histogram is
+    stable across recompiles; a pass-pipeline or fusion-decision change
+    shows up as a count shift."""
+    ops = {}
+    for line in text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if m:
+            ops[m.group(1)] = ops.get(m.group(1), 0) + 1
+    return ops
+
+
+def _ops_hash(ops):
+    blob = json.dumps(sorted(ops.items())).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+_SNAPSHOT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "tests", "fixtures", "hlo_snapshots")
+
+
+class HloSnapshot(Contract):
+    """Differential gate: the opcode histogram of the compiled module
+    must hash-match the blessed record for ``key``. Unexplained drift
+    (a new op, a vanished op, a count shift) is a violation until the
+    change is re-blessed with
+    ``tools/graft_lint.py --contracts --update-snapshots``."""
+
+    def __init__(self, key, snapshot_dir=None):
+        self.key = key
+        self.snapshot_dir = snapshot_dir or _SNAPSHOT_DIR
+        self.name = f"hlo-snapshot({key})"
+
+    @property
+    def path(self):
+        fname = re.sub(r"[^\w.@,-]", "_", self.key) + ".json"
+        return os.path.join(self.snapshot_dir, fname)
+
+    def load(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def bless(self, hlo_text):
+        ops = hlo_op_histogram(hlo_text)
+        rec = {"key": self.key, "hash": _ops_hash(ops),
+               "ops": dict(sorted(ops.items()))}
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump(rec, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return rec
+
+    def check(self, ctx):
+        if ctx.hlo_text is None:
+            return []
+        blessed = self.load()
+        if blessed is None:
+            return [f"no blessed snapshot at {self.path} — bless one "
+                    "with tools/graft_lint.py --contracts "
+                    "--update-snapshots"]
+        ops = hlo_op_histogram(ctx.hlo_text)
+        if _ops_hash(ops) == blessed.get("hash"):
+            return []
+        old = blessed.get("ops", {})
+        added = sorted(set(ops) - set(old))
+        removed = sorted(set(old) - set(ops))
+        changed = sorted(op for op in set(ops) & set(old)
+                         if ops[op] != old[op])
+        detail = "; ".join(p for p in (
+            added and ("new ops: " + ", ".join(added[:6])),
+            removed and ("vanished ops: " + ", ".join(removed[:6])),
+            changed and ("count drift: " + ", ".join(
+                f"{op} {old[op]}->{ops[op]}" for op in changed[:6])),
+        ) if p)
+        return ["op histogram drifted from blessed snapshot "
+                f"({detail or 'hash mismatch'}) — if the change is "
+                "intended, re-bless with --update-snapshots"]
+
+
 def evaluate(contracts, ctx):
     """Run each contract; return the flat violation list (empty = every
     contract holds)."""
@@ -272,19 +446,29 @@ def evaluate(contracts, ctx):
 
 @dataclasses.dataclass(frozen=True)
 class ShardedCase:
-    """Compile shapes for one model's dp x tp contract run."""
+    """Compile shapes for one model's dp x tp contract run. The depth
+    fields (layers/heads/intermediate/max_position) are only filled for
+    models with priced budget rows — they must mirror the tiny config
+    bench.py compiles (a drift-guard test in tests/test_lint.py pins the
+    gpt row to GPTConfig.tiny)."""
     batch: int
     seq: int
     vocab: int
     hidden: int
     loss_rows: staticmethod   # (batch, seq) -> rows entering the loss
+    layers: int = None
+    heads: int = None
+    intermediate: int = None
+    max_position: int = None
 
     def min_rows(self, dp=2):
         return self.loss_rows(self.batch, self.seq) // dp // 2
 
 
 SHARDED_TRAIN_CASES = {
-    "gpt": ShardedCase(16, 128, 512, 64, lambda b, s: b * s),
+    "gpt": ShardedCase(16, 128, 512, 64, lambda b, s: b * s,
+                       layers=2, heads=4, intermediate=128,
+                       max_position=128),
     # BERT's MLM head only scores the 15% masked positions
     "bert": ShardedCase(32, 128, 1024, 64,
                         lambda b, s: b * max(1, int(0.15 * s))),
@@ -359,12 +543,97 @@ def serve_prefill_contracts():
     return [TracedOnce(("serve.prefill",))]
 
 
+# --- cost-model-priced budgets ---------------------------------------
+#
+# Tolerances are calibrated against the measured tiny-config compiles
+# on jax-cpu (tests/test_compile_smoke.py re-measures every run):
+# measured/predicted sits at ~0.85 (train flops), ~4.3 (train bytes —
+# the traffic estimate undercounts XLA's interpret-mode and rematerial-
+# ization traffic), ~1.02 (decode flops), ~2.1 (decode bytes), so each
+# budget leaves ~1.4-1.5x headroom over today's compiles while a real
+# regression (an unfused xent materializing [rows, V] traffic, a dense
+# Tmax attention) blows through it.
+TRAIN_BUDGET_TOLERANCE = {"flops": 1.25, "bytes": 6.0}
+SERVE_BUDGET_TOLERANCE = {"flops": 1.5, "bytes": 3.0}
+SERVE_SLOTS = 2
+
+_AUTOPLAN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "parallel", "autoplan")
+_MOD_CACHE = {}
+
+
+def _load_autoplan(stem):
+    """Load a parallel/autoplan module by file path — keeps this module
+    importable without the paddle_tpu package (and without jax); the
+    cost model and topology table are themselves stdlib-only."""
+    mod = _MOD_CACHE.get(stem)
+    if mod is None:
+        path = os.path.join(_AUTOPLAN_DIR, stem + ".py")
+        spec = importlib.util.spec_from_file_location(
+            "_contracts_" + stem, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _MOD_CACHE[stem] = mod
+    return mod
+
+
+def _train_spec(model):
+    cm = _load_autoplan("costmodel")
+    c = SHARDED_TRAIN_CASES[model]
+    return cm.ModelSpec(
+        name=model, vocab=c.vocab, hidden=c.hidden, layers=c.layers,
+        heads=c.heads, intermediate=c.intermediate, seq=c.seq,
+        batch=c.batch, max_position=c.max_position)
+
+
+def _pricing():
+    """(costmodel module, topology, fixed rate) — the rate is pinned to
+    the analytic ``peak * MFU_ASSUMED`` so pricing never consults the
+    autotune cache (which would drag jax into a stdlib-only import)."""
+    cm = _load_autoplan("costmodel")
+    topo = _load_autoplan("topology").get_topology("cpu4")
+    return cm, topo, topo.peak_flops * cm.MFU_ASSUMED
+
+
+def train_budget_contracts(model="gpt", dp=2, tp=2):
+    """Budget row for one model's dp x tp train step, priced by
+    ``costmodel.predict()``."""
+    cm, topo, rate = _pricing()
+    pred = cm.predict(_train_spec(model), topo, dp=dp, tp=tp, pp=1,
+                      rate=rate)
+    src = f"costmodel.predict({model}@dp{dp},tp{tp})"
+    return [
+        MaxHloFlops(pred["flops_per_chip"],
+                    TRAIN_BUDGET_TOLERANCE["flops"], source=src),
+        MaxHloBytes(pred["hlo_bytes"],
+                    TRAIN_BUDGET_TOLERANCE["bytes"], source=src),
+    ]
+
+
+def serve_budget_contracts(slots=SERVE_SLOTS, context=SERVE_TMAX):
+    """Budget row for the paged decode step, priced by
+    ``costmodel.predict_decode()`` on the same tiny-gpt spec the serve
+    smoke compiles."""
+    cm, topo, rate = _pricing()
+    pred = cm.predict_decode(_train_spec("gpt"), topo, slots=slots,
+                             context=context, rate=rate)
+    src = f"costmodel.predict_decode(gpt, slots={slots}, Tmax={context})"
+    return [
+        MaxHloFlops(pred["flops_per_chip"],
+                    SERVE_BUDGET_TOLERANCE["flops"], source=src),
+        MaxHloBytes(pred["hlo_bytes"],
+                    SERVE_BUDGET_TOLERANCE["bytes"], source=src),
+    ]
+
+
 # name -> contract list; tools/compile_smoke.py compiles each target and
 # evaluates its row (tools/graft_lint.py --contracts is the CLI front
 # door). tests/test_lint.py proves every contract class fires on a
 # planted violation.
 CONTRACTS = {
-    "train.gpt@dp2,tp2": sharded_train_contracts("gpt"),
+    "train.gpt@dp2,tp2": (sharded_train_contracts("gpt")
+                          + train_budget_contracts("gpt")),
     # autoplan-resolved mesh (bench --mesh auto on 4 virtual devices):
     # the planner may pick any dp in {1, 2, 4}; dp=4 gives the smallest
     # per-shard row count, so this row is the strictest of the three
@@ -372,7 +641,17 @@ CONTRACTS = {
     "train.bert@dp2,tp2": sharded_train_contracts("bert"),
     "train.transformer_big@dp2,tp2":
         sharded_train_contracts("transformer_big"),
-    "serve.decode": serve_decode_contracts(),
+    "serve.decode": serve_decode_contracts() + serve_budget_contracts(),
     "serve.prefill": serve_prefill_contracts(),
     "mlp.fused": fused_mlp_contracts(),
+}
+
+# Differential snapshot gates, keyed like CONTRACTS rows but kept in a
+# separate registry: a snapshot judges the module against a blessed
+# on-disk record, so it only belongs in runs that really compiled the
+# canonical target (compile_smoke wires it in; text-only fixture
+# evaluations of CONTRACTS stay self-contained).
+CONTRACT_SNAPSHOTS = {
+    "train.gpt@dp2,tp2": HloSnapshot("train.gpt@dp2,tp2"),
+    "serve.decode": HloSnapshot("serve.decode"),
 }

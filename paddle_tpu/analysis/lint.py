@@ -42,11 +42,14 @@ DEFAULT_EXCLUDES = ("tests/fixtures", "__pycache__", ".git",
 
 @dataclasses.dataclass
 class Finding:
-    """One lint hit, anchored to a repo-relative path and 1-based line."""
+    """One lint hit, anchored to a repo-relative path and 1-based line.
+    ``severity`` is "error" (build breaker) or "warn" (advisory —
+    ``graft_lint.py --fail-on error`` reports it without failing)."""
     rule: str
     path: str
     line: int
     message: str
+    severity: str = "error"
 
     def format(self):
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
@@ -157,10 +160,13 @@ class LintContext:
 class Rule:
     """Base class: subclasses set ``name``/``help`` and implement
     ``check``. Constructor kwargs configure paths/roots so the same rule
-    instance can run against a planted-violation fixture tree."""
+    instance can run against a planted-violation fixture tree.
+    ``severity`` stamps every finding the rule yields (unless the rule
+    set one itself)."""
 
     name = None
     help = ""
+    severity = "error"
 
     def check(self, ctx):
         raise NotImplementedError
@@ -240,27 +246,65 @@ def _suppression_findings(ctx):
                         f"(known: {', '.join(sorted(_REGISTRY))})")
 
 
+# the framework's own sources (and the CLI) show the suppression syntax
+# in docstring examples; judging those as live or stale is meaningless
+_STALE_EXEMPT = ("paddle_tpu/analysis/", "tools/graft_lint.py")
+
+
+def _stale_suppression_findings(ctx, ran, used):
+    """stale-suppression findings: a reasoned disable comment whose
+    named rule RAN this pass but had nothing to swallow on that line —
+    the violation it silenced is gone, and the dead comment would mask
+    the next real finding. Only rules that actually ran are judged, so
+    a ``--rules`` subset pass never flags the others' suppressions."""
+    for sf in ctx.files:
+        if sf.relpath.startswith(_STALE_EXEMPT):
+            continue
+        for i, line in enumerate(sf.lines, 1):
+            sup = parse_suppressions(line)
+            if sup is None or not sup[1]:
+                continue
+            for r in sup[0]:
+                if (r in ran and r in _REGISTRY
+                        and (sf.relpath, i, r) not in used):
+                    yield Finding(
+                        "stale-suppression", sf.relpath, i,
+                        f"suppression of {r!r} no longer fires here — "
+                        "the silenced violation is gone; delete the "
+                        "comment", severity="warn")
+
+
 def run_lint(ctx, rules=None, paths=None):
     """Run ``rules`` (default: the full registry) over ``ctx``; apply
     per-line suppressions; return findings sorted by location. ``paths``
     (a set of repo-relative paths) post-filters findings for
     --changed-only runs — tree-wide drift rules still SEE the whole
-    tree, only the reporting narrows."""
+    tree, only the reporting narrows. Suppressions that swallowed
+    nothing surface as ``stale-suppression`` findings."""
     if rules is None:
         rules = make_rules()
     findings = list(ctx.parse_errors())
     findings.extend(_suppression_findings(ctx))
     for rule in rules:
-        findings.extend(rule.check(ctx))
+        for f in rule.check(ctx):
+            if f.severity == "error":
+                f.severity = getattr(rule, "severity", "error")
+            findings.append(f)
     kept = []
+    used = set()   # (path, line, rule) suppressions that swallowed one
     for f in findings:
         sf = ctx.file(f.path)
         if sf is not None and f.rule != "bad-suppression":
             sup = parse_suppressions(sf.line_text(f.line))
             if sup is not None and f.rule in sup[0] and sup[1]:
+                used.add((f.path, f.line, f.rule))
                 continue
         if paths is not None and f.path not in paths:
             continue
         kept.append(f)
+    ran = {r.name for r in rules if r.name}
+    for f in _stale_suppression_findings(ctx, ran, used):
+        if paths is None or f.path in paths:
+            kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return kept

@@ -1,0 +1,593 @@
+"""Shared call-graph index for the cross-function rules.
+
+``hot-path-sync`` (PR 8) grew a small call-graph: a per-module
+function/class/import index, a method-owner table, and a conservative
+call resolver (``self.m()`` to the same class; bare ``f()`` to the
+module or a from-import target; ``obj.m()`` to ``Cls.m`` when exactly
+one analyzed class defines ``m`` — ambiguous names are skipped, never
+guessed). The concurrency rules of v2 (``unguarded-shared-state``,
+``lock-order``, ``thread-unsafe-publish``) need the same machinery plus
+three extensions, so it lives here now:
+
+- nested ``def``s are indexed with dotted qualnames
+  (``Cls.method.inner``) and bare-name calls resolve through the
+  enclosing-scope chain — ``threading.Thread(target=loop)`` where
+  ``loop`` is defined inside ``start()`` is the motivating case
+  (parallel/heartbeat.py does exactly this);
+- module-alias imports (``from paddle_tpu.observability import metrics
+  as _metrics``) resolve ``_metrics.counter(...)`` into the aliased
+  module when it is part of the analyzed set;
+- thread entry-point discovery: ``Thread(target=...)`` registrations,
+  ``run()`` on Thread subclasses, ``do_*`` on HTTP handler classes, and
+  callback keywords (``action=``, ``on_stall=``, ``anomaly_sink=``)
+  whose value resolves statically.
+
+Both extensions are opt-in flags on ``call_edges`` so hot-path-sync
+keeps its PR 8 edge set byte-for-byte.
+
+The lock vocabulary lives here too: ``# graft-guard: <lockattr>``
+annotations (inline on the assignment, in a class docstring as
+``graft-guard: <attr> by <lockattr>``, or in a module-level
+``GUARDED_BY`` dict literal) parse into a per-module guard table, and
+``with self._lock:`` acquisitions parse into class-qualified lock ids —
+``(relpath, class, "self._lock")`` — so FleetRouter._lock and
+ServingEngine._lock never collide just because both spell it ``_lock``.
+"""
+
+import ast
+import re
+
+from paddle_tpu.analysis.rules._common import call_name
+
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+_THREAD_NAMES = {"threading.Thread", "Thread"}
+CALLBACK_KWARGS = ("action", "on_stall", "anomaly_sink")
+
+_LOCKY = re.compile(r"lock|mutex", re.I)
+GUARD_RE = re.compile(
+    r"#[^#\n]*graft-guard:\s*(self\.[A-Za-z_]\w*|[A-Za-z_]\w*)")
+GUARD_DOC_RE = re.compile(
+    r"graft-guard:\s*([A-Za-z_]\w*)\s+by\s+(self\.[A-Za-z_]\w*|[A-Za-z_]\w*)")
+
+
+def is_jit_call(call):
+    name = call_name(call)
+    if name in _JIT_NAMES:
+        return True
+    if name in _PARTIAL_NAMES and call.args:
+        inner = call.args[0]
+        return (isinstance(inner, (ast.Attribute, ast.Name))
+                and (ast.unparse(inner) if hasattr(ast, "unparse")
+                     else "") in _JIT_NAMES)
+    return False
+
+
+class ModuleIndex:
+    """Function/class/import index of one analyzed source file."""
+
+    def __init__(self, sf):
+        self.sf = sf
+        self.relpath = sf.relpath
+        self.functions = {}       # qualname -> FunctionDef (incl. nested)
+        self.classes = {}         # class name -> {method name: qualname}
+        self.class_nodes = {}     # class name -> ClassDef
+        self.class_bases = {}     # class name -> (dotted base names,)
+        self.jitted_attrs = {}    # class name -> {self attrs bound to jit}
+        self.imports = {}         # local name -> (module relpath, name)
+        self.module_aliases = {}  # local name -> module relpath
+        tree = sf.tree
+        if tree is None:
+            return
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+                self._index_nested(node.name, node)
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        qn = f"{node.name}.{item.name}"
+                        self.functions[qn] = item
+                        methods[item.name] = qn
+                        self._index_nested(qn, item)
+                self.classes[node.name] = methods
+                self.class_nodes[node.name] = node
+                self.class_bases[node.name] = tuple(
+                    self._dotted(b) for b in node.bases)
+                self.jitted_attrs[node.name] = self._find_jitted_attrs(node)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                rel = node.module.replace(".", "/") + ".py"
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.imports[local] = (rel, alias.name)
+                    self.module_aliases[local] = (
+                        f"{node.module}.{alias.name}".replace(".", "/")
+                        + ".py")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.module_aliases[alias.asname] = (
+                            alias.name.replace(".", "/") + ".py")
+        # function-local from-imports (the repo defers heavy imports)
+        for fn in list(self.functions.values()):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    rel = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        self.imports.setdefault(
+                            alias.asname or alias.name, (rel, alias.name))
+
+    def _index_nested(self, qual, fn):
+        for child in ast.iter_child_nodes(fn):
+            self._index_nested_in(qual, child)
+
+    def _index_nested_in(self, qual, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qn = f"{qual}.{node.name}"
+            self.functions[qn] = node
+            self._index_nested(qn, node)
+        elif not isinstance(node, (ast.ClassDef, ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                self._index_nested_in(qual, child)
+
+    @staticmethod
+    def _dotted(expr):
+        try:
+            return ast.unparse(expr)
+        except Exception:
+            return ""
+
+    @staticmethod
+    def _find_jitted_attrs(class_node):
+        """self attributes assigned a jax.jit/pjit result anywhere in
+        the class — calls through them produce device values."""
+        attrs = set()
+        for node in ast.walk(class_node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and is_jit_call(node.value)):
+                continue
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    attrs.add(t.attr)
+        return attrs
+
+
+def build_index(ctx, paths):
+    """(mods, method_owner) over one module set.
+
+    mods: relpath -> ModuleIndex. method_owner: method name ->
+    [(relpath, qualname)] across every analyzed class — the ``obj.m()``
+    resolver fires only when the list has exactly one entry.
+    """
+    mods = {}
+    for rel in paths:
+        sf = ctx.file(rel)
+        if sf is not None and sf.tree is not None:
+            mods[rel] = ModuleIndex(sf)
+    method_owner = {}
+    for rel, mod in mods.items():
+        for cls, methods in mod.classes.items():
+            for m, qn in methods.items():
+                method_owner.setdefault(m, []).append((rel, qn))
+    return mods, method_owner
+
+
+def _scope_prefixes(mod, qualname):
+    """Enclosing function scopes of a qualname, innermost first —
+    skipping the bare class level (a class body is not a call scope)."""
+    parts = qualname.split(".")
+    stop = 1 if parts and parts[0] in mod.classes else 0
+    for i in range(len(parts), stop, -1):
+        yield ".".join(parts[:i])
+
+
+def resolve_bare(mods, mod, qualname, name,
+                 resolve_nested=False):
+    """A bare-name call/reference inside (mod, qualname) ->
+    (relpath, qualname) or None."""
+    if resolve_nested:
+        for prefix in _scope_prefixes(mod, qualname):
+            qn = f"{prefix}.{name}"
+            if qn in mod.functions:
+                return mod.relpath, qn
+    if name in mod.functions:
+        return mod.relpath, name
+    if name in mod.imports:
+        tgt_rel, tgt_name = mod.imports[name]
+        tgt = mods.get(tgt_rel)
+        if tgt is not None and tgt_name in tgt.functions:
+            return tgt_rel, tgt_name
+    return None
+
+
+def resolve_callable(mods, method_owner, mod, qualname, expr,
+                     resolve_nested=True):
+    """A callable expression (Thread target, callback kwarg value) ->
+    (relpath, qualname) or None. Handles bare names (through the
+    nested-scope chain) and ``self.method``."""
+    cls = qualname.split(".")[0] if "." in qualname else None
+    if isinstance(expr, ast.Name):
+        return resolve_bare(mods, mod, qualname, expr.id,
+                            resolve_nested=resolve_nested)
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)):
+        if expr.value.id == "self" and cls is not None:
+            qn = mod.classes.get(cls, {}).get(expr.attr)
+            if qn is not None:
+                return mod.relpath, qn
+        owners = method_owner.get(expr.attr, [])
+        if len(owners) == 1:
+            return owners[0]
+    return None
+
+
+def resolve_call(mods, method_owner, mod, qualname, call,
+                 resolve_nested=False, resolve_module_aliases=False):
+    """One Call node inside (mod, qualname) -> (relpath, qualname) or
+    None. PR 8 semantics by default; ``resolve_nested`` adds the
+    enclosing-scope chain for bare names, ``resolve_module_aliases``
+    adds ``alias.f()`` into analyzed modules. A ``self.m()`` whose
+    method is unknown resolves to nothing — a dynamically-bound self
+    attribute never falls through to the owner table."""
+    f = call.func
+    cls = qualname.split(".")[0] if "." in qualname else None
+    if isinstance(f, ast.Name):
+        return resolve_bare(mods, mod, qualname, f.id,
+                            resolve_nested=resolve_nested)
+    if isinstance(f, ast.Attribute):
+        recv = f.value
+        if (isinstance(recv, ast.Name) and recv.id == "self"
+                and cls is not None):
+            qn = mod.classes.get(cls, {}).get(f.attr)
+            if qn is not None:
+                return mod.relpath, qn
+            return None
+        if (resolve_module_aliases and isinstance(recv, ast.Name)
+                and recv.id in mod.module_aliases):
+            tgt_rel = mod.module_aliases[recv.id]
+            tgt = mods.get(tgt_rel)
+            if tgt is not None and f.attr in tgt.functions:
+                return tgt_rel, f.attr
+        owners = method_owner.get(f.attr, [])
+        if len(owners) == 1:
+            return owners[0]
+    return None
+
+
+def call_edges(mods, method_owner, rel, qualname,
+               resolve_nested=False, resolve_module_aliases=False):
+    """(relpath, qualname) call targets of one function body (full
+    walk, nested defs included — PR 8 semantics)."""
+    mod = mods[rel]
+    fn = mod.functions.get(qualname)
+    if fn is None:
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            tgt = resolve_call(
+                mods, method_owner, mod, qualname, node,
+                resolve_nested=resolve_nested,
+                resolve_module_aliases=resolve_module_aliases)
+            if tgt is not None:
+                yield tgt
+
+
+# --- thread entry points ---
+
+
+def entry_points(mods, method_owner):
+    """Statically-discoverable thread entry points across a module set:
+    [(relpath, qualname, description)].
+
+    - ``threading.Thread(target=X)`` where X resolves (nested def,
+      ``self.method``, module function);
+    - ``run()`` overrides on classes whose base name ends in Thread;
+    - ``do_*`` methods on classes whose base mentions RequestHandler
+      (each request runs on a fresh server thread);
+    - callback keywords (``action=``, ``on_stall=``, ``anomaly_sink=``)
+      whose value resolves — these are invoked from watchdog/heartbeat/
+      engine contexts the registering code does not control.
+    """
+    out = []
+    seen = set()
+
+    def add(tgt, desc):
+        if tgt is not None and tgt not in seen:
+            seen.add(tgt)
+            out.append((tgt[0], tgt[1], desc))
+
+    for rel, mod in mods.items():
+        for cls, bases in mod.class_bases.items():
+            base_tail = " ".join(b.rsplit(".", 1)[-1] for b in bases)
+            if "Thread" in base_tail:
+                qn = mod.classes[cls].get("run")
+                if qn:
+                    add((rel, qn), f"{cls}.run (Thread subclass)")
+            if "RequestHandler" in base_tail:
+                for m, qn in mod.classes[cls].items():
+                    if m.startswith("do_"):
+                        add((rel, qn),
+                            f"{cls}.{m} (HTTP handler thread)")
+        for qualname, fn in list(mod.functions.items()):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                is_thread = call_name(node) in _THREAD_NAMES
+                for kw in node.keywords:
+                    if kw.arg == "target" and is_thread:
+                        add(resolve_callable(mods, method_owner, mod,
+                                             qualname, kw.value),
+                            f"Thread(target=...) registered in "
+                            f"{qualname}")
+                    elif kw.arg in CALLBACK_KWARGS:
+                        add(resolve_callable(mods, method_owner, mod,
+                                             qualname, kw.value),
+                            f"{kw.arg}= callback registered in "
+                            f"{qualname}")
+    return out
+
+
+# --- guard tables and lock identities ---
+
+
+def lock_id(expr, rel, cls):
+    """The lock identity acquired by a ``with`` context expression, or
+    None when the expression is not recognizably a lock. Identities are
+    class-qualified: (relpath, class, source text)."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and _LOCKY.search(expr.attr)):
+        return (rel, cls or "", "self." + expr.attr)
+    if isinstance(expr, ast.Name) and _LOCKY.search(expr.id):
+        return (rel, "", expr.id)
+    return None
+
+
+def with_lock_ids(with_node, rel, cls):
+    out = []
+    for item in with_node.items:
+        lid = lock_id(item.context_expr, rel, cls)
+        if lid is not None:
+            out.append(lid)
+    return out
+
+
+def lock_label(lid):
+    rel, cls, name = lid
+    return f"{cls}.{name[len('self.'):]}" if cls else name
+
+
+def _normalize_lock(value, rel, cls):
+    value = value.strip()
+    if value.startswith("self."):
+        return (rel, cls or "", value)
+    return (rel, "", value)
+
+
+def guard_table(mod):
+    """{(class name, attr): lock id} for one module, merged from the
+    three declaration forms (inline comment wins on conflict)."""
+    guards = {}
+    lines = mod.sf.lines
+    # module-level GUARDED_BY table
+    if mod.sf.tree is not None:
+        for node in mod.sf.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "GUARDED_BY"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and "." in k.value):
+                    cls, attr = k.value.rsplit(".", 1)
+                    guards[(cls, attr)] = _normalize_lock(
+                        v.value, mod.relpath, cls)
+    for cls, node in mod.class_nodes.items():
+        # class docstring "graft-guard: <attr> by <lockattr>" lines
+        doc = ast.get_docstring(node) or ""
+        for m in GUARD_DOC_RE.finditer(doc):
+            guards[(cls, m.group(1))] = _normalize_lock(
+                m.group(2), mod.relpath, cls)
+        # inline "# graft-guard: <lockattr>" on self.<attr> assignments
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (sub.targets if isinstance(sub, ast.Assign)
+                       else [sub.target])
+            attrs = [t.attr for t in targets
+                     if isinstance(t, ast.Attribute)
+                     and isinstance(t.value, ast.Name)
+                     and t.value.id == "self"]
+            if not attrs or sub.lineno > len(lines):
+                continue
+            # the marker may ride any line of the statement, or a
+            # comment line directly above it
+            lo = sub.lineno - 1
+            hi = min(getattr(sub, "end_lineno", sub.lineno), len(lines))
+            cand = lines[lo:hi]
+            if lo > 0 and lines[lo - 1].lstrip().startswith("#"):
+                cand.append(lines[lo - 1])
+            for text in cand:
+                m = GUARD_RE.search(text)
+                if m:
+                    for attr in attrs:
+                        guards[(cls, attr)] = _normalize_lock(
+                            m.group(1), mod.relpath, cls)
+                    break
+    return guards
+
+
+def build_guards(mods):
+    """{(relpath, class, attr): lock id} across a module set."""
+    out = {}
+    for rel, mod in mods.items():
+        for (cls, attr), lid in guard_table(mod).items():
+            out[(rel, cls, attr)] = lid
+    return out
+
+
+# --- lock-aware single-function scan ---
+
+_MUTATORS = frozenset((
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault",
+    "update"))
+_LAZY_WRAPPERS = frozenset(("enumerate", "zip", "reversed", "filter",
+                            "map", "iter"))
+_VIEW_METHODS = frozenset(("items", "values", "keys"))
+
+
+def _self_attr(expr):
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+def iterated_self_attr(expr):
+    """The self attribute an iteration expression walks *lazily* —
+    ``self.A``, ``self.A.items()/values()/keys()``, or either wrapped
+    in a lazy iterator (enumerate/zip/...). None when the expression
+    snapshots first (list()/sorted()/dict()/...) or is not a self
+    attribute."""
+    attr = _self_attr(expr)
+    if attr is not None:
+        return attr
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if (isinstance(f, ast.Attribute) and f.attr in _VIEW_METHODS
+                and not expr.args and not expr.keywords):
+            return _self_attr(f.value)
+        if (isinstance(f, ast.Name) and f.id in _LAZY_WRAPPERS):
+            for a in expr.args:
+                attr = iterated_self_attr(a)
+                if attr is not None:
+                    return attr
+    return None
+
+
+class FunctionScan(ast.NodeVisitor):
+    """Lock-aware scan of one function body.
+
+    Records, each with the frozenset of lock ids lexically held at the
+    site: self-attribute accesses, call sites, lock acquisitions,
+    iteration expressions (for/comprehension iterables), and container
+    mutations of self attributes. Nested defs and lambdas are NOT
+    descended into — they run on whatever thread eventually calls them
+    and are reached through their own call-graph edges.
+    """
+
+    def __init__(self, rel, cls):
+        self.rel = rel
+        self.cls = cls
+        self._active = []
+        self.accesses = []    # (Attribute node, held)
+        self.calls = []       # (Call node, held)
+        self.acquires = []    # (lock id, held-before, lineno)
+        self.iterations = []  # (iter expr, held, lineno)
+        self.mutations = []   # (attr, held, lineno)
+
+    def _held(self):
+        return frozenset(self._active)
+
+    # lock scopes
+    def visit_With(self, node):
+        added = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            lid = lock_id(item.context_expr, self.rel, self.cls)
+            if lid is not None:
+                self.acquires.append((lid, self._held(), node.lineno))
+                self._active.append(lid)
+                added += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if added:
+            del self._active[-added:]
+
+    visit_AsyncWith = visit_With
+
+    # nested defs run on the caller-of-the-callback's thread
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # sites
+    def visit_Attribute(self, node):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self.accesses.append((node, self._held()))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self.calls.append((node, self._held()))
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr(f.value)
+            if attr is not None:
+                self.mutations.append((attr, self._held(), node.lineno))
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self.iterations.append((node.iter, self._held(), node.lineno))
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self.iterations.append((gen.iter, self._held(),
+                                    node.lineno))
+        self.generic_visit(node)
+
+    visit_ListComp = visit_SetComp = visit_DictComp = \
+        visit_GeneratorExp = _visit_comp
+
+    def _mutating_targets(self, targets):
+        for t in targets:
+            attr = None
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            elif isinstance(t, ast.Attribute):
+                attr = _self_attr(t)
+            if attr is not None:
+                self.mutations.append((attr, self._held(), t.lineno))
+
+    def visit_Assign(self, node):
+        self._mutating_targets(node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._mutating_targets([node.target])
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        self._mutating_targets(node.targets)
+        self.generic_visit(node)
+
+
+def scan_function(mods, rel, qualname):
+    """FunctionScan over one indexed function's body."""
+    mod = mods[rel]
+    fn = mod.functions[qualname]
+    parts = qualname.split(".")
+    cls = parts[0] if parts[0] in mod.classes else None
+    scan = FunctionScan(rel, cls)
+    for stmt in fn.body:
+        scan.visit(stmt)
+    return scan

@@ -17,92 +17,22 @@ telemetry's trailing loss fetch) stay in the tree under
 ``# graft-lint: disable=hot-path-sync (<why>)`` — the rule's job is to
 make every *new* sync a reviewed decision, not to pretend zero exist.
 
-Call resolution, in order: ``self.m()`` to the same class; bare ``f()``
-to the module (or a ``from paddle_tpu.x import f`` target inside the
-module set); ``obj.m()`` to ``Cls.m`` when exactly one analyzed class
-defines ``m`` (ambiguous names are skipped, never guessed). Nested defs
-are analyzed as part of their enclosing function.
+Call resolution (shared with the concurrency rules via
+``rules/callgraph.py``), in order: ``self.m()`` to the same class; bare
+``f()`` to the module (or a ``from paddle_tpu.x import f`` target
+inside the module set); ``obj.m()`` to ``Cls.m`` when exactly one
+analyzed class defines ``m`` (ambiguous names are skipped, never
+guessed). Nested defs are analyzed as part of their enclosing function.
 """
 
 import ast
 
 from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules import callgraph
 from paddle_tpu.analysis.rules._common import (assign_name_targets,
                                                call_name)
 
-_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
-_PARTIAL_NAMES = {"functools.partial", "partial"}
 _NP_ROOTS = {"np", "numpy"}
-
-
-def _is_jit_call(call):
-    name = call_name(call)
-    if name in _JIT_NAMES:
-        return True
-    if name in _PARTIAL_NAMES and call.args:
-        inner = call.args[0]
-        return (isinstance(inner, (ast.Attribute, ast.Name))
-                and (ast.unparse(inner) if hasattr(ast, "unparse")
-                     else "") in _JIT_NAMES)
-    return False
-
-
-class _Module:
-    """Function/class/import index of one analyzed source file."""
-
-    def __init__(self, sf):
-        self.sf = sf
-        self.relpath = sf.relpath
-        self.functions = {}     # qualname -> FunctionDef
-        self.classes = {}       # class name -> {method name: qualname}
-        self.jitted_attrs = {}  # class name -> {self attrs bound to jit}
-        self.imports = {}       # local name -> (module relpath, name)
-        tree = sf.tree
-        if tree is None:
-            return
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.functions[node.name] = node
-            elif isinstance(node, ast.ClassDef):
-                methods = {}
-                for item in node.body:
-                    if isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                        qn = f"{node.name}.{item.name}"
-                        self.functions[qn] = item
-                        methods[item.name] = qn
-                self.classes[node.name] = methods
-                self.jitted_attrs[node.name] = self._find_jitted_attrs(node)
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                rel = node.module.replace(".", "/") + ".py"
-                for alias in node.names:
-                    self.imports[alias.asname or alias.name] = (
-                        rel, alias.name)
-        # function-local from-imports (the repo defers heavy imports)
-        for fn in list(self.functions.values()):
-            for node in ast.walk(fn):
-                if isinstance(node, ast.ImportFrom) and node.module:
-                    rel = node.module.replace(".", "/") + ".py"
-                    for alias in node.names:
-                        self.imports.setdefault(
-                            alias.asname or alias.name, (rel, alias.name))
-
-    @staticmethod
-    def _find_jitted_attrs(class_node):
-        """self attributes assigned a jax.jit/pjit result anywhere in
-        the class — calls through them produce device values."""
-        attrs = set()
-        for node in ast.walk(class_node):
-            if not (isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Call)
-                    and _is_jit_call(node.value)):
-                continue
-            for t in node.targets:
-                if (isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"):
-                    attrs.add(t.attr)
-        return attrs
 
 
 @register
@@ -130,51 +60,14 @@ class HotPathSync(Rule):
         self.module_paths = tuple(modules or self.DEFAULT_MODULES)
         self.roots = tuple(roots or self.DEFAULT_ROOTS)
 
-    # --- call graph ---
+    # --- call graph (built by rules/callgraph.py, PR 8 semantics) ---
 
     def _index(self, ctx):
-        mods = {}
-        for rel in self.module_paths:
-            sf = ctx.file(rel)
-            if sf is not None and sf.tree is not None:
-                mods[rel] = _Module(sf)
-        method_owner = {}   # method name -> [(relpath, qualname)]
-        for rel, mod in mods.items():
-            for cls, methods in mod.classes.items():
-                for m, qn in methods.items():
-                    method_owner.setdefault(m, []).append((rel, qn))
-        return mods, method_owner
+        return callgraph.build_index(ctx, self.module_paths)
 
     def _edges(self, mods, method_owner, rel, qualname):
         """(relpath, qualname) call targets of one function body."""
-        mod = mods[rel]
-        fn = mod.functions.get(qualname)
-        if fn is None:
-            return
-        cls = qualname.split(".")[0] if "." in qualname else None
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name):
-                if f.id in mod.functions:
-                    yield rel, f.id
-                elif f.id in mod.imports:
-                    tgt_rel, tgt_name = mod.imports[f.id]
-                    tgt = mods.get(tgt_rel)
-                    if tgt is not None and tgt_name in tgt.functions:
-                        yield tgt_rel, tgt_name
-            elif isinstance(f, ast.Attribute):
-                recv = f.value
-                if (isinstance(recv, ast.Name) and recv.id == "self"
-                        and cls is not None):
-                    qn = mod.classes.get(cls, {}).get(f.attr)
-                    if qn is not None:
-                        yield rel, qn
-                else:
-                    owners = method_owner.get(f.attr, [])
-                    if len(owners) == 1:
-                        yield owners[0]
+        yield from callgraph.call_edges(mods, method_owner, rel, qualname)
 
     # --- device-value taint + sync detection inside one function ---
 
@@ -209,7 +102,7 @@ class HotPathSync(Rule):
             if value is None:
                 continue
             targets = assign_name_targets(node)
-            if isinstance(value, ast.Call) and _is_jit_call(value):
+            if isinstance(value, ast.Call) and callgraph.is_jit_call(value):
                 local_jits.update(targets)
                 continue
             taint = ((isinstance(value, ast.Call) and _device_call(value))

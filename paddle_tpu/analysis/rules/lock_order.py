@@ -1,0 +1,156 @@
+"""lock-order: the cross-module lock-acquisition graph is acyclic.
+
+Two threads acquiring the same pair of locks in opposite orders is the
+classic static deadlock; the motivating surface here is the
+FleetRouter -> engine -> registry chain, where a router step holds the
+router lock while stepping engines (which take theirs while recording
+metrics) and any callback that re-enters the router from under an
+engine lock would close the loop.
+
+The rule computes, per function, its direct lock acquisitions and the
+locks transitively acquired by its resolvable callees (fixpoint over
+the shared call graph), then adds an edge L -> M whenever M is
+acquired — directly or through a call — while L is held. Any strongly
+connected component of two or more locks is a finding. Reentrant
+self-edges (L -> L) are deliberately ignored: the tree's hot locks are
+RLocks and same-lock reentry is how synchronous callbacks are allowed
+to re-enter their owner.
+
+Lock identities are class-qualified (see rules/callgraph.py), and an
+acquisition only counts when the ``with`` expression is recognizably a
+lock (``self.<attr>`` or a bare name matching /lock|mutex/i).
+"""
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules import callgraph
+
+
+@register
+class LockOrder(Rule):
+    name = "lock-order"
+    help = ("cycle in the with-lock acquisition graph across the "
+            "concurrent module set (static deadlock)")
+
+    DEFAULT_MODULES = (
+        "paddle_tpu/serving/fleet.py",
+        "paddle_tpu/serving/engine.py",
+        "paddle_tpu/observability/metrics.py",
+        "paddle_tpu/observability/watchdog.py",
+        "paddle_tpu/observability/exporter.py",
+        "paddle_tpu/parallel/heartbeat.py",
+    )
+
+    def __init__(self, modules=None):
+        self.module_paths = tuple(modules or self.DEFAULT_MODULES)
+
+    def check(self, ctx):
+        mods, method_owner = callgraph.build_index(ctx, self.module_paths)
+        scans = {}
+        resolved = {}   # (rel, qn) -> [(target key, held, lineno)]
+        for rel, mod in mods.items():
+            for qn in list(mod.functions):
+                sc = callgraph.scan_function(mods, rel, qn)
+                scans[(rel, qn)] = sc
+                calls = []
+                for call, held in sc.calls:
+                    tgt = callgraph.resolve_call(
+                        mods, method_owner, mod, qn, call,
+                        resolve_nested=True, resolve_module_aliases=True)
+                    if tgt is not None:
+                        calls.append((tgt, held, call.lineno))
+                resolved[(rel, qn)] = calls
+        # transitive acquired-lock sets, to a fixpoint
+        acq = {key: {lid for lid, _, _ in sc.acquires}
+               for key, sc in scans.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, calls in resolved.items():
+                mine = acq[key]
+                for tgt, _, _ in calls:
+                    if tgt in acq and not acq[tgt] <= mine:
+                        mine |= acq[tgt]
+                        changed = True
+        # edges: M held -> L acquired (directly or through a call)
+        edges = {}
+        for key, sc in scans.items():
+            rel, qn = key
+            for lid, held, lineno in sc.acquires:
+                for m in held:
+                    if m != lid:
+                        edges.setdefault((m, lid), (rel, lineno, qn))
+            for tgt, held, lineno in resolved[key]:
+                if tgt not in acq:
+                    continue
+                for m in held:
+                    for n in acq[tgt]:
+                        if m != n:
+                            edges.setdefault((m, n), (rel, lineno, qn))
+        for comp in self._cycles(edges):
+            comp = sorted(comp)
+            labels = " -> ".join(callgraph.lock_label(l) for l in comp)
+            labels += f" -> {callgraph.lock_label(comp[0])}"
+            sites = [edges[(a, b)] for a in comp for b in comp
+                     if (a, b) in edges]
+            rel, lineno, qn = min(sites, key=lambda s: (s[0], s[1]))
+            yield Finding(
+                self.name, rel, lineno,
+                f"lock-order cycle: {labels} (one edge acquired here, "
+                f"in {qn}) — impose a single global order or move the "
+                "inner call outside the lock")
+
+    @staticmethod
+    def _cycles(edges):
+        """Strongly connected components of size >= 2 (Tarjan)."""
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index = {}
+        low = {}
+        on_stack = {}
+        stack = []
+        counter = [0]
+        out = []
+
+        def strongconnect(v):
+            work = [(v, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack[node] = True
+                recurse = False
+                succ = adj.get(node, [])
+                for i in range(pi, len(succ)):
+                    w = succ[i]
+                    if w not in index:
+                        work[-1] = (node, i + 1)
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if on_stack.get(w):
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) >= 2:
+                        out.append(comp)
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+        return out

@@ -1,0 +1,30 @@
+"""stale-suppression: a disable comment whose rule no longer fires.
+
+A reasoned ``# graft-lint: disable=<rule>`` earns its keep by swallowing
+a real finding. Once the code changes and the violation is gone, the
+comment is dead weight — worse, it silently masks the NEXT real finding
+of that rule on the line. This rule turns every such dead suppression
+into a finding of its own.
+
+The detection cannot live in ``check()``: it needs to know which
+suppressions actually swallowed a finding during THIS pass, which only
+:func:`paddle_tpu.analysis.lint.run_lint` sees. This registration gives
+the name a ``--rules``/``--list`` entry (and keeps ``bad-suppression``
+from flagging it as unknown); the enforcement rides the run itself.
+"""
+
+from paddle_tpu.analysis.lint import Rule, register
+
+
+@register
+class StaleSuppression(Rule):
+
+    name = "stale-suppression"
+    severity = "warn"
+    help = ("reasoned `# graft-lint: disable=<rule>` comment whose rule "
+            "ran but no longer fires on that line — dead suppressions "
+            "mask the next real finding")
+
+    def check(self, ctx):
+        # enforced inside lint.run_lint — see the module docstring
+        return ()

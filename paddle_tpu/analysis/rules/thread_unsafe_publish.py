@@ -1,0 +1,85 @@
+"""thread-unsafe-publish: a container one method iterates lazily while
+another method mutates it must be copied or locked.
+
+Python dicts and lists raise (or silently skip) when mutated during
+iteration — and in a threaded process the mutator is frequently
+another thread: the watchdog polling its watched-jit table while
+``watch_jit`` registers a new function, an exporter rendering a
+registry while a request thread creates a metric. The fix is cheap and
+local — iterate a snapshot (``list(self.A)`` /
+``list(self.A.items())``) or hold a common lock at both sites — so the
+rule insists on one of the two.
+
+Fires when, within one class: a self-attribute is iterated *lazily*
+(``for x in self.A``, a comprehension over ``self.A.items()``, or
+either wrapped only in enumerate/zip/...) in one method, some *other*
+method mutates that attribute (mutator call, subscript store/delete,
+rebind) outside ``__init__``, the attribute is not ``graft-guard``-ed
+(guarded attributes belong to unguarded-shared-state), and the two
+sites share no lexically-held lock.
+"""
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules import callgraph
+
+
+@register
+class ThreadUnsafePublish(Rule):
+    name = "thread-unsafe-publish"
+    help = ("self container iterated lazily in one method and mutated "
+            "in another with no common lock — iterate a copy")
+
+    DEFAULT_MODULES = (
+        "paddle_tpu/serving/fleet.py",
+        "paddle_tpu/serving/engine.py",
+        "paddle_tpu/observability/metrics.py",
+        "paddle_tpu/observability/watchdog.py",
+        "paddle_tpu/observability/exporter.py",
+        "paddle_tpu/parallel/heartbeat.py",
+    )
+
+    def __init__(self, modules=None):
+        self.module_paths = tuple(modules or self.DEFAULT_MODULES)
+
+    def check(self, ctx):
+        mods, _ = callgraph.build_index(ctx, self.module_paths)
+        guards = callgraph.build_guards(mods)
+        for rel in sorted(mods):
+            mod = mods[rel]
+            for cls in sorted(mod.classes):
+                yield from self._check_class(mods, rel, mod, cls, guards)
+
+    def _check_class(self, mods, rel, mod, cls, guards):
+        iters = []      # (attr, method, held, lineno)
+        mutations = {}  # attr -> [(method, held, lineno)]
+        for qn in sorted(mod.functions):
+            if not qn.startswith(cls + "."):
+                continue
+            sc = callgraph.scan_function(mods, rel, qn)
+            if sc.cls != cls:
+                continue
+            for expr, held, lineno in sc.iterations:
+                attr = callgraph.iterated_self_attr(expr)
+                if attr is not None:
+                    iters.append((attr, qn, held, lineno))
+            if qn.endswith("__init__"):
+                continue
+            for attr, held, lineno in sc.mutations:
+                mutations.setdefault(attr, []).append((qn, held, lineno))
+        seen = set()
+        for attr, method, held, lineno in iters:
+            if (rel, cls, attr) in guards or (rel, lineno, attr) in seen:
+                continue
+            racing = [(m, h, ln) for m, h, ln in mutations.get(attr, [])
+                      if m != method and not (h & held)]
+            if not racing:
+                continue
+            seen.add((rel, lineno, attr))
+            racing.sort(key=lambda r: (r[0], r[2]))
+            mutator, _, mut_line = racing[0]
+            yield Finding(
+                self.name, rel, lineno,
+                f"self.{attr} iterated lazily in {method} while "
+                f"{mutator} mutates it (line {mut_line}) — a concurrent "
+                f"mutation breaks iteration; iterate a snapshot "
+                f"(list(self.{attr})) or hold a common lock")

@@ -1,0 +1,132 @@
+"""unguarded-shared-state: every access to a ``# graft-guard:``-ed
+attribute reachable from a thread entry point holds the declared lock.
+
+The serving tree is genuinely concurrent — FleetRouter clients submit
+from their own threads while a round thread steps, the ThreadingHTTP
+metrics exporter scrapes registries, HeartBeatMonitor runs a daemon
+loop, and watchdog/anomaly callbacks re-enter the engine. The locking
+discipline for all of that is declared with ``graft-guard``
+annotations (see rules/callgraph.py for the three declaration forms)
+and this rule makes the declaration enforceable: BFS the call graph
+from every thread entry point — explicit client-facing roots plus
+statically discovered ``Thread(target=...)`` registrations, ``run()``
+overrides, ``do_*`` HTTP handlers, and callback keywords — carrying
+the set of locks held across each call edge, and flag any guarded
+attribute touched at a site where its lock is not held.
+
+Lock identity is class-qualified ((module, class, attr)), so
+FleetRouter._lock never satisfies a ServingEngine guard just because
+both are spelled ``self._lock``. ``__init__`` bodies are exempt: the
+constructing thread owns the object before it is published. Nested
+defs are only analyzed when an edge actually reaches them
+(Thread targets, resolved bare calls) — with the locks held at *their*
+entry, not their lexical parent's.
+"""
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules import callgraph
+
+
+@register
+class UnguardedSharedState(Rule):
+    name = "unguarded-shared-state"
+    help = ("graft-guard'ed attribute accessed outside its declared "
+            "lock on a path reachable from a thread entry point")
+
+    DEFAULT_MODULES = (
+        "paddle_tpu/serving/fleet.py",
+        "paddle_tpu/serving/engine.py",
+        "paddle_tpu/observability/metrics.py",
+        "paddle_tpu/observability/watchdog.py",
+        "paddle_tpu/observability/exporter.py",
+        "paddle_tpu/parallel/heartbeat.py",
+    )
+    # the client-raced public surfaces: callers are free to invoke
+    # these from any thread, concurrently with the round/scraper
+    # threads the entry-point discovery finds on its own
+    DEFAULT_ROOTS = (
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.submit"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.cancel"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.step"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.drain"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.shed_pending"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.telemetry"),
+        ("paddle_tpu/serving/fleet.py", "FleetRouter.goodput"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.submit"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.adopt"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.cancel"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.step"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.drain"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.export_inflight"),
+        ("paddle_tpu/serving/engine.py", "ServingEngine.shed_queued"),
+    )
+
+    def __init__(self, modules=None, roots=None):
+        self.module_paths = tuple(modules or self.DEFAULT_MODULES)
+        self.roots = tuple(roots if roots is not None
+                           else self.DEFAULT_ROOTS)
+
+    def check(self, ctx):
+        mods, method_owner = callgraph.build_index(ctx, self.module_paths)
+        guards = callgraph.build_guards(mods)
+        roots = []
+        for rel, qn in self.roots:
+            mod = mods.get(rel)
+            if mod is None or qn not in mod.functions:
+                yield Finding(
+                    self.name, rel, 1,
+                    f"shared-state root {qn!r} not found — the rule's "
+                    "root list rotted; update "
+                    "UnguardedSharedState.DEFAULT_ROOTS")
+                continue
+            roots.append((rel, qn, f"client-facing {qn}"))
+        roots.extend(callgraph.entry_points(mods, method_owner))
+        if not guards:
+            return
+
+        scans = {}
+
+        def scan(rel, qn):
+            key = (rel, qn)
+            if key not in scans:
+                scans[key] = callgraph.scan_function(mods, rel, qn)
+            return scans[key]
+
+        findings = {}
+        seen = set()
+        queue = []
+        for rel, qn, desc in roots:
+            state = (rel, qn, frozenset())
+            if state not in seen:
+                seen.add(state)
+                queue.append((rel, qn, frozenset(), desc))
+        while queue:
+            rel, qn, held, desc = queue.pop()
+            sc = scan(rel, qn)
+            mod = mods[rel]
+            if sc.cls is not None and not qn.endswith("__init__"):
+                for node, site_locks in sc.accesses:
+                    lock = guards.get((rel, sc.cls, node.attr))
+                    if lock is None or lock in held or lock in site_locks:
+                        continue
+                    fkey = (rel, node.lineno, node.attr)
+                    if fkey not in findings:
+                        findings[fkey] = Finding(
+                            self.name, rel, node.lineno,
+                            f"self.{node.attr} (graft-guard: "
+                            f"{callgraph.lock_label(lock)}) accessed "
+                            f"without its lock in {qn} — reachable "
+                            f"from {desc}")
+            for call, site_locks in sc.calls:
+                tgt = callgraph.resolve_call(
+                    mods, method_owner, mod, qn, call,
+                    resolve_nested=True, resolve_module_aliases=True)
+                if tgt is None:
+                    continue
+                nxt = (tgt[0], tgt[1], held | site_locks)
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append((tgt[0], tgt[1], held | site_locks,
+                                  desc))
+        for key in sorted(findings):
+            yield findings[key]

@@ -47,6 +47,11 @@ CATALOG = {
     "checkpoint.torn_skips": MetricSpec(
         "counter", (),
         "Uncommitted (torn) checkpoint steps skipped at discovery."),
+    # tools/graft_lint.py
+    "contracts.violations": MetricSpec(
+        "counter", ("contract",),
+        "Compile-contract violations reported by a graft-lint "
+        "--contracts run, by CONTRACTS row name."),
     # observability/exporter.py
     "exporter.scrapes": MetricSpec(
         "counter", ("path",),
@@ -85,6 +90,11 @@ CATALOG = {
         "counter", ("fn",),
         "Traces beyond the first of a function the runtime asserts is "
         "traced once (serve decode/prefill, the Trainer step)."),
+    # tools/graft_lint.py
+    "lint.findings": MetricSpec(
+        "counter", ("rule",),
+        "Findings reported by a graft-lint run, by rule name — scraped "
+        "from CI runs to trend which detectors fire."),
     # ops/pallas
     "pallas.fallback": MetricSpec(
         "counter", ("kernel",),

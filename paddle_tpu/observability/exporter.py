@@ -146,33 +146,40 @@ class MetricsServer:
         self._bind = (host, int(port))
         self.registry = (registry if registry is not None
                          else _metrics.registry())
-        self._httpd = None
-        self._thread = None
+        # lifecycle lock: start()/stop() may race between the run loop
+        # and an atexit/close path; the scrape threads never take it
+        self._lock = threading.Lock()
+        self._httpd = None     # graft-guard: self._lock
+        self._thread = None    # graft-guard: self._lock
 
     @property
     def port(self):
-        return self._httpd.server_address[1] if self._httpd else None
+        with self._lock:
+            return (self._httpd.server_address[1]
+                    if self._httpd else None)
 
     def start(self):
-        if self._httpd is not None:
+        with self._lock:
+            if self._httpd is not None:
+                return self
+            httpd = http.server.ThreadingHTTPServer(self._bind, _Handler)
+            httpd.daemon_threads = True
+            httpd.registry = self.registry
+            self._httpd = httpd
+            self._thread = threading.Thread(
+                target=httpd.serve_forever, name="metrics-exporter",
+                daemon=True)
+            self._thread.start()
             return self
-        httpd = http.server.ThreadingHTTPServer(self._bind, _Handler)
-        httpd.daemon_threads = True
-        httpd.registry = self.registry
-        self._httpd = httpd
-        self._thread = threading.Thread(
-            target=httpd.serve_forever, name="metrics-exporter",
-            daemon=True)
-        self._thread.start()
-        return self
 
     def stop(self):
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        self._httpd = None
-        self._thread = None
+        with self._lock:
+            if self._httpd is None:
+                return
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
 
     def __enter__(self):
         return self.start()

@@ -71,7 +71,7 @@ class Counter:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._vals = {}
+        self._vals = {}  # graft-guard: self._lock
 
     def inc(self, n=1, **labels):
         k = _label_key(labels)
@@ -105,7 +105,7 @@ class Gauge:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._vals = {}
+        self._vals = {}  # graft-guard: self._lock
 
     def set(self, value, **labels):
         with self._lock:
@@ -143,8 +143,8 @@ class Histogram:
         self.help = help
         self.max_samples = max_samples
         self._lock = threading.Lock()
-        self._series = {}  # label key -> dict(count, sum, min, max,
-        #                                      reservoir, rng)
+        # label key -> dict(count, sum, min, max, reservoir, rng)
+        self._series = {}  # graft-guard: self._lock
 
     def _slot(self, k):
         s = self._series.get(k)
@@ -225,7 +225,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics = {}
+        self._metrics = {}  # graft-guard: self._lock
 
     def _get_or_make(self, cls, name, help, **kw):
         with self._lock:

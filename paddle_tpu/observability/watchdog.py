@@ -32,6 +32,7 @@ Stdlib-only: consumers on the hot path import nothing heavy.
 
 import collections
 import dataclasses
+import threading
 import time
 
 from paddle_tpu.observability import metrics as _metrics
@@ -83,11 +84,18 @@ class Watchdog:
         self._run_log = run_log
         self._clock = clock
         self._action = action       # mitigation callback: (event) -> None
-        self._steps = collections.deque(maxlen=self.cfg.window)
-        self._latched = set()
-        self._watched = {}          # fn name -> (callable, last cache size)
+        # The lock covers detector state only; mitigation callbacks fire
+        # after it is released, so the watchdog never holds its lock
+        # while re-entering the loop it protects (no watchdog->engine
+        # lock-order edge).
+        self._lock = threading.Lock()
+        self._steps = collections.deque(
+            maxlen=self.cfg.window)     # graft-guard: self._lock
+        self._latched = set()           # graft-guard: self._lock
+        # fn name -> [probe, last cache size]; graft-guard: self._lock
+        self._watched = {}
         self._retraces_seen = 0     # last-seen jit.retraces total
-        self.anomalies = []
+        self.anomalies = []             # graft-guard: self._lock
 
     # -- wiring ------------------------------------------------------------
     def watch_jit(self, name, fn):
@@ -96,7 +104,8 @@ class Watchdog:
         probe (non-jit wrappers) are ignored."""
         probe = getattr(fn, "_cache_size", None)
         if callable(probe):
-            self._watched[str(name)] = [probe, None]
+            with self._lock:
+                self._watched[str(name)] = [probe, None]
         return self
 
     # -- per-step ----------------------------------------------------------
@@ -105,28 +114,33 @@ class Watchdog:
         """One scheduling round: check every detector this loop feeds.
         Any argument left None skips its detector."""
         cfg = self.cfg
-        if wall_s is not None:
-            median = self._median()
-            if (median is not None
-                    and wall_s > cfg.slow_factor * median):
-                self._fire("slow_step", step, wall_s=wall_s,
-                           median_s=median)
-            else:
-                self._clear("slow_step")
-            self._steps.append(float(wall_s))
-        if stall_s is not None:
-            if stall_s > cfg.stall_s:
-                self._fire("ingest_stall", step, stall_s=stall_s)
-            else:
-                self._clear("ingest_stall")
-        self._poll_jit()
-        self._check_retraces(step)
-        if goodput is not None and retired >= cfg.min_retired:
-            if goodput < cfg.goodput_min:
-                self._fire("goodput_collapse", step, goodput=goodput,
-                           retired=retired)
-            else:
-                self._clear("goodput_collapse")
+        fired = []
+        with self._lock:
+            if wall_s is not None:
+                median = self._median()
+                if (median is not None
+                        and wall_s > cfg.slow_factor * median):
+                    self._fire(fired, "slow_step", step, wall_s=wall_s,
+                               median_s=median)
+                else:
+                    self._clear("slow_step")
+                self._steps.append(float(wall_s))
+            if stall_s is not None:
+                if stall_s > cfg.stall_s:
+                    self._fire(fired, "ingest_stall", step,
+                               stall_s=stall_s)
+                else:
+                    self._clear("ingest_stall")
+            self._poll_jit()
+            self._check_retraces(step, fired)
+            if goodput is not None and retired >= cfg.min_retired:
+                if goodput < cfg.goodput_min:
+                    self._fire(fired, "goodput_collapse", step,
+                               goodput=goodput, retired=retired)
+                else:
+                    self._clear("goodput_collapse")
+        for event in fired:
+            self._dispatch(event)
 
     # -- detectors ---------------------------------------------------------
     def _median(self):
@@ -149,17 +163,20 @@ class Watchdog:
                 ctr.inc(size - max(last, 1), fn=name)
             slot[1] = size
 
-    def _check_retraces(self, step):
+    def _check_retraces(self, step, fired):
         ctr = self._reg.get("jit.retraces")
         total = ctr.total() if ctr is not None else 0
         grew = total - self._retraces_seen
         self._retraces_seen = total
         if grew > 0 and step > self.cfg.warmup_steps:
             # edge-triggered: every steady-state recompile is an event
-            self._fire("retrace", step, new_retraces=grew, latch=False)
+            self._fire(fired, "retrace", step, new_retraces=grew,
+                       latch=False)
 
     # -- latch + emit ------------------------------------------------------
-    def _fire(self, kind, step, latch=True, **detail):
+    def _fire(self, fired, kind, step, latch=True, **detail):
+        """Record one anomaly (caller holds the lock) and queue it on
+        `fired` for post-release dispatch to the mitigation callback."""
         if latch:
             if kind in self._latched:
                 return
@@ -171,6 +188,9 @@ class Watchdog:
                           _help("watchdog.anomalies")).inc(kind=kind)
         if self._run_log is not None:
             self._run_log.write(event)
+        fired.append(event)
+
+    def _dispatch(self, event):
         if self._action is not None:
             # mitigation must never take down the loop it protects
             try:

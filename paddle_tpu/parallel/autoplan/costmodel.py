@@ -169,6 +169,77 @@ def chip_memory(spec, dp, tp, pp, microbatches=1, schedule="1f1b"):
             "loss_tmp": loss_tmp, "total": total}
 
 
+def train_hlo_bytes(spec, dp, tp, pp=1):
+    """Per-chip estimate of XLA cost_analysis's "bytes accessed" for one
+    train step — a TRAFFIC estimate, unlike :func:`chip_memory`'s
+    residency. Each forward intermediate is written once and re-read by
+    its consumer and its backward (~3 touches), the backward writes and
+    re-reads matching gradients (~3 more), params and Adam moments sweep
+    once in each direction, and the chunked loss touches its
+    [rows, chunk] tile on the forward and backward. Order-of-magnitude
+    only: the MaxHloBytes budget contract multiplies it by a calibrated
+    tolerance."""
+    H, I = spec.hidden, spec.intermediate
+    f32 = 4
+    local_t = max(1, spec.tokens // dp)
+    local_b = max(1, spec.batch // dp)
+    act = spec.layers * local_t * (_ACT_H * H + _ACT_I * I) / tp * f32
+    scores = spec.layers * local_b * spec.heads * spec.seq ** 2 / tp * f32
+    counts = param_counts(spec)
+    params = (counts["embedding"] / tp
+              + -(-spec.layers // pp) * counts["per_layer"] / tp
+              + counts["head"]) * spec.param_bytes
+    logits = (max(1, spec.loss_rows // dp)
+              * min(spec.vocab / tp, 8192) * f32)
+    return 6.0 * (act + scores) + 8.0 * params + 4.0 * logits
+
+
+# ------------------------------------------------------ serving (decode)
+
+def decode_flops(spec, slots, context):
+    """Matmul flops for ONE serving decode step: each live slot pushes a
+    single token through every layer (projections + FFN), attends over
+    ``context`` cached positions, and scores the full vocab."""
+    H, I = spec.hidden, spec.intermediate
+    proj = 2 * slots * (4 * H * H + 2 * H * I)
+    attn = 4 * slots * context * H
+    logits = 2 * slots * H * spec.vocab
+    return spec.layers * (proj + attn) + logits
+
+
+def decode_hlo_bytes(spec, slots, context):
+    """Traffic estimate for one decode step: every parameter is read
+    once (batch=slots is too small to amortize below one sweep) and the
+    K/V cache pages for ``context`` positions are read and written
+    back. The MaxHloBytes serve budget multiplies by a tolerance."""
+    counts = param_counts(spec)
+    params = (counts["embedding"] + spec.layers * counts["per_layer"]
+              + counts["head"]) * spec.param_bytes
+    kv = (2 * spec.layers * slots * context * spec.hidden
+          * 2 * spec.param_bytes)
+    return params + kv
+
+
+def predict_decode(spec, topology, slots, context, rate=None):
+    """Score one serving decode step the way :func:`predict` scores a
+    train step: flops + traffic estimates and a step-seconds figure.
+    ``rate=None`` prices compute at the autotune-measured achieved rate
+    (falling back to analytic); passing an explicit rate keeps the call
+    stdlib-pure — what the budget contracts do."""
+    flops = float(decode_flops(spec, slots, context))
+    if rate is None:
+        rate, rate_source = achieved_rate(topology)
+    else:
+        rate_source = "fixed"
+    return {
+        "step_s": flops / rate,
+        "flops_per_chip": flops,
+        "hlo_bytes": float(decode_hlo_bytes(spec, slots, context)),
+        "rate_source": rate_source,
+        "rate_flops_s": rate,
+    }
+
+
 # ----------------------------------------------------------- collectives
 
 def collective_bytes(spec, dp, tp, pp, microbatches=1):
@@ -227,16 +298,22 @@ def achieved_rate(topology):
     return topology.peak_flops * MFU_ASSUMED, "analytic"
 
 
-def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b"):
+def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b",
+            rate=None):
     """Score one candidate: predicted step seconds + the estimates that
     produced it. dp is the outermost axis — it crosses slice boundaries
     first on a multi-slice topology, so it prices at DCN bandwidth.
 
     Compute is priced at the achieved-flops/s rate measured by the tile
     autotuner when its cache has entries for this chip family (the
-    ``rate_source`` field says which constant priced the candidate)."""
+    ``rate_source`` field says which constant priced the candidate);
+    passing ``rate`` explicitly skips that lookup and keeps the call
+    stdlib-pure (what the budget contracts do)."""
     flops_c = train_flops(spec) / (dp * tp * pp)
-    rate, rate_source = achieved_rate(topology)
+    if rate is None:
+        rate, rate_source = achieved_rate(topology)
+    else:
+        rate_source = "fixed"
     compute_s = flops_c / rate
     bubble = (pp - 1) / max(1, microbatches) if pp > 1 else 0.0
     coll = collective_bytes(spec, dp, tp, pp, microbatches)
@@ -251,6 +328,7 @@ def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b"):
         "collective_s": coll_s,
         "bubble_fraction": bubble,
         "flops_per_chip": flops_c,
+        "hlo_bytes": float(train_hlo_bytes(spec, dp, tp, pp)),
         "mem_bytes": mem["total"],
         "mem": mem,
         "collective_bytes": coll,

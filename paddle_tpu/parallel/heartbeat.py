@@ -43,8 +43,11 @@ class HeartBeatMonitor:
         self.on_stall = on_stall
         self._clock = clock
         self._lock = threading.Lock()
-        self._last = {}          # worker -> last ping time
-        self._state = {i: UNINITED for i in range(num_workers)}
+        # worker -> last ping time; graft-guard: self._lock
+        self._last = {}
+        self._state = {
+            i: UNINITED
+            for i in range(num_workers)}  # graft-guard: self._lock
         self._thread = None
         self._stop = threading.Event()
 
@@ -63,16 +66,22 @@ class HeartBeatMonitor:
         the timeout flip to STALLED and fire on_stall."""
         now = self._clock()
         out = {}
+        stalls = []
         with self._lock:
             for w in range(self.num_workers):
                 age = now - self._last.get(w, now)
                 st = self._state.get(w, UNINITED)
                 if st == RUNNING and age > self.timeout_s:
                     st = self._state[w] = STALLED
-                    _metrics.counter("heartbeat.missed").inc(worker=w)
-                    if self.on_stall is not None:
-                        self.on_stall(w, age)
+                    stalls.append((w, age))
                 out[w] = (st, age)
+        # the stall callback runs outside the lock: it may call back
+        # into an engine/controller holding its own lock, and update()
+        # from worker threads must never wait on it
+        for w, age in stalls:
+            _metrics.counter("heartbeat.missed").inc(worker=w)
+            if self.on_stall is not None:
+                self.on_stall(w, age)
         return out
 
     def start(self):

@@ -79,6 +79,7 @@ failed):
 import collections
 import dataclasses
 import itertools
+import threading
 import time
 import typing
 import uuid
@@ -199,9 +200,14 @@ class ServingEngine:
         self._last_tokens = np.zeros(s, np.int32)
         self._free_slots = list(range(s))
         self._free_pages = collections.deque(range(cfg.num_pages))
-        self._queue = collections.deque()
-        self._running = {}
-        self.requests = {}            # id -> Request (cancel / post-mortem)
+        # One reentrant lock guards the request tables: clients may
+        # submit()/cancel() from their own threads while step()/drain()
+        # run elsewhere, and the watchdog's anomaly callback re-enters
+        # shed_queued() from under a step already holding the lock.
+        self._lock = threading.RLock()
+        self._queue = collections.deque()   # graft-guard: self._lock
+        self._running = {}                  # graft-guard: self._lock
+        self.requests = {}   # id -> Request; graft-guard: self._lock
         self._ids = itertools.count()
         self._step_no = 0
         self._base_key = jax.random.key(cfg.seed)
@@ -344,34 +350,37 @@ class ServingEngine:
         enforce(prompt.size + max_new <= cfg.max_len,
                 f"prompt {prompt.size} + max_new {max_new} exceeds "
                 f"max_len {cfg.max_len}")
-        req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
-                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
-                      priority=int(priority))
-        req.trace_id = f"{self._trace_run}/{req.id}"
-        self.requests[req.id] = req
-        extra = {}
-        if priority:
-            extra["priority"] = int(priority)
-        if deadline_s is not None:
-            extra["deadline_s"] = float(deadline_s)
-        req.submit_t = self._trace_event(req, "submitted",
-                                         prompt_len=int(prompt.size),
-                                         max_new=int(max_new), **extra)
-        _metrics.counter("serve.requests").inc(status="submitted")
-        if deadline_s is None and cfg.default_deadline_s > 0:
-            deadline_s = cfg.default_deadline_s
-        if deadline_s is not None:
-            if deadline_s <= 0:
-                self._reject(req, "infeasible_deadline")
+        with self._lock:
+            req = Request(id=next(self._ids), prompt=prompt,
+                          max_new=max_new,
+                          eos_id=eos_id if eos_id is not None
+                          else cfg.eos_id,
+                          priority=int(priority))
+            req.trace_id = f"{self._trace_run}/{req.id}"
+            self.requests[req.id] = req
+            extra = {}
+            if priority:
+                extra["priority"] = int(priority)
+            if deadline_s is not None:
+                extra["deadline_s"] = float(deadline_s)
+            req.submit_t = self._trace_event(req, "submitted",
+                                             prompt_len=int(prompt.size),
+                                             max_new=int(max_new), **extra)
+            _metrics.counter("serve.requests").inc(status="submitted")
+            if deadline_s is None and cfg.default_deadline_s > 0:
+                deadline_s = cfg.default_deadline_s
+            if deadline_s is not None:
+                if deadline_s <= 0:
+                    self._reject(req, "infeasible_deadline")
+                    return req.id
+                req.deadline_t = req.submit_t + float(deadline_s)
+            if cfg.queue_limit and len(self._queue) >= cfg.queue_limit:
+                self._reject(req, "queue_full")
                 return req.id
-            req.deadline_t = req.submit_t + float(deadline_s)
-        if cfg.queue_limit and len(self._queue) >= cfg.queue_limit:
-            self._reject(req, "queue_full")
+            req.device_prompt = self._stage_chunks(prompt)
+            self._queue.append(req)
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
             return req.id
-        req.device_prompt = self._stage_chunks(prompt)
-        self._queue.append(req)
-        _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        return req.id
 
     def adopt(self, prompt, tokens=(), max_new=None, eos_id=None,
               priority=0, deadline_t=None, submit_t=None,
@@ -398,24 +407,27 @@ class ServingEngine:
                 f"max_len {cfg.max_len}")
         enforce(len(tokens) <= max_new,
                 f"adopted with {len(tokens)} tokens > max_new {max_new}")
-        req = Request(id=next(self._ids), prompt=prompt, max_new=max_new,
-                      eos_id=eos_id if eos_id is not None else cfg.eos_id,
-                      priority=int(priority))
-        req.tokens = tokens
-        req.deadline_t = deadline_t
-        req.first_token_t = first_token_t
-        req.trace_id = f"{self._trace_run}/{req.id}"
-        self.requests[req.id] = req
-        t = self._trace_event(req, "adopted", origin=origin,
-                              prompt_len=int(prompt.size),
-                              tokens_kept=len(tokens))
-        req.submit_t = submit_t if submit_t is not None else t
-        _metrics.counter("serve.requests").inc(status="adopted")
-        req.device_prompt = self._stage_chunks(req.output if tokens
-                                               else prompt)
-        self._queue.append(req)
-        _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        return req.id
+        with self._lock:
+            req = Request(id=next(self._ids), prompt=prompt,
+                          max_new=max_new,
+                          eos_id=eos_id if eos_id is not None
+                          else cfg.eos_id,
+                          priority=int(priority))
+            req.tokens = tokens
+            req.deadline_t = deadline_t
+            req.first_token_t = first_token_t
+            req.trace_id = f"{self._trace_run}/{req.id}"
+            self.requests[req.id] = req
+            t = self._trace_event(req, "adopted", origin=origin,
+                                  prompt_len=int(prompt.size),
+                                  tokens_kept=len(tokens))
+            req.submit_t = submit_t if submit_t is not None else t
+            _metrics.counter("serve.requests").inc(status="adopted")
+            req.device_prompt = self._stage_chunks(req.output if tokens
+                                                   else prompt)
+            self._queue.append(req)
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            return req.id
 
     def export_inflight(self):
         """Replica-side export of every non-terminal request's durable
@@ -425,14 +437,17 @@ class ServingEngine:
         with the router, so entries carry ids, token mirrors, and the
         accounting clocks `adopt()` preserves."""
         out = []
-        live = list(self._queue) + sorted(self._running.values(),
-                                          key=lambda r: r.id)
-        for req in live:
-            out.append(dict(
-                rid=req.id, status=req.status, tokens=list(req.tokens),
-                prompt_len=int(req.prompt.size), priority=req.priority,
-                submit_t=req.submit_t, first_token_t=req.first_token_t,
-                deadline_t=req.deadline_t))
+        with self._lock:
+            live = list(self._queue) + sorted(self._running.values(),
+                                              key=lambda r: r.id)
+            for req in live:
+                out.append(dict(
+                    rid=req.id, status=req.status,
+                    tokens=list(req.tokens),
+                    prompt_len=int(req.prompt.size),
+                    priority=req.priority, submit_t=req.submit_t,
+                    first_token_t=req.first_token_t,
+                    deadline_t=req.deadline_t))
         return out
 
     def cancel(self, request_id):
@@ -442,21 +457,22 @@ class ServingEngine:
         id is unknown or already terminal. Cancelled requests do not
         count against goodput (the client walked away; the engine did
         not fail them)."""
-        req = self.requests.get(request_id)
-        if req is None or req.status not in ("queued", "running"):
-            return False
-        if req.status == "queued":
-            try:
-                self._queue.remove(req)
-            except ValueError:
-                pass
-        else:
-            self._free_slot_state(req)
-        self._retire_terminal(req, "cancelled", "cancelled",
-                              account=False)
-        _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        _metrics.gauge("serve.active_slots").set(len(self._running))
-        return True
+        with self._lock:
+            req = self.requests.get(request_id)
+            if req is None or req.status not in ("queued", "running"):
+                return False
+            if req.status == "queued":
+                try:
+                    self._queue.remove(req)
+                except ValueError:
+                    pass
+            else:
+                self._free_slot_state(req)
+            self._retire_terminal(req, "cancelled", "cancelled",
+                                  account=False)
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            _metrics.gauge("serve.active_slots").set(len(self._running))
+            return True
 
     def step(self):
         """One scheduling round: free finished slots happened last round;
@@ -464,80 +480,89 @@ class ServingEngine:
         page tables where the next token opens a page, run ONE jitted
         decode step over all slots, and retire requests that hit EOS or
         their token budget. Returns the requests finished this round."""
-        t0 = self._clock()
-        finished = []
-        self._shed_expired(finished)
-        self._admit(finished)
-        stalled = self._grow_pages()
-        while stalled and not self._active.any():
-            # pool deadlock: every live slot needs a fresh page and none
-            # is free. Preempt the lowest-priority / latest-deadline
-            # stalled request (free its pages, requeue it for
-            # re-prefill) so higher-value work always makes progress —
-            # with all-default requests this reduces to the youngest.
-            # Greedy decoding regenerates the dropped tokens exactly;
-            # sampled runs re-draw (recompute preemption).
-            victim = min((self._running[s] for s in stalled),
-                         key=self._victim_key)
-            self._preempt(victim)
+        with self._lock:
+            t0 = self._clock()
+            finished = []
+            self._shed_expired(finished)
+            self._admit(finished)
             stalled = self._grow_pages()
-        new_tokens = 0
-        toks = None
-        if self._active.any():
-            key = jax.random.fold_in(self._base_key, self._step_no)
-            try:
-                fault_point("serve.step")
-                toks_dev, self._caches = self._decode_jit(
-                    self._params, self._caches, self._last_tokens,
-                    self._page_table, self._lengths, self._active, key)
-                toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
-            except Exception as e:
-                self._recover("serve.step", e)
-        if toks is not None:
-            self._retry_budget.success()       # consecutive-failure reset
-            dt = self._clock() - t0
-            lat = _metrics.histogram("serve.token_latency_s")
-            for slot, req in list(self._running.items()):
-                if not self._active[slot]:
-                    continue                   # page-stalled this round
-                self._lengths[slot] += 1       # pending token now cached
-                tok = int(toks[slot])
-                req.tokens.append(tok)
-                self._last_tokens[slot] = tok
-                lat.observe(dt)
-                new_tokens += 1
-                reason = self._done_reason(req, tok)
-                if reason:
-                    self._release(req, finished, reason)
-        _metrics.counter("serve.tokens").inc(new_tokens)
-        _metrics.gauge("serve.active_slots").set(len(self._running))
-        _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        wall_s = self._clock() - t0
-        if self._run_log is not None:
-            self._run_log.write({
-                "phase": "serve", "step": self._step_no,
-                "wall_s": wall_s, "new_tokens": new_tokens,
-                "active": len(self._running),
-                "queue_depth": len(self._queue),
-                "goodput": round(self.goodput(), 4)})
-        if self._watchdog is not None:
-            self._watchdog.tick(self._step_no, wall_s=wall_s,
-                                goodput=self.goodput(),
-                                retired=self._retired)
-        self._step_no += 1
-        return finished
+            while stalled and not self._active.any():
+                # pool deadlock: every live slot needs a fresh page and
+                # none is free. Preempt the lowest-priority /
+                # latest-deadline stalled request (free its pages,
+                # requeue it for re-prefill) so higher-value work always
+                # makes progress — with all-default requests this
+                # reduces to the youngest. Greedy decoding regenerates
+                # the dropped tokens exactly; sampled runs re-draw
+                # (recompute preemption).
+                victim = min((self._running[s] for s in stalled),
+                             key=self._victim_key)
+                self._preempt(victim)
+                stalled = self._grow_pages()
+            new_tokens = 0
+            toks = None
+            if self._active.any():
+                key = jax.random.fold_in(self._base_key, self._step_no)
+                try:
+                    fault_point("serve.step")
+                    toks_dev, self._caches = self._decode_jit(
+                        self._params, self._caches, self._last_tokens,
+                        self._page_table, self._lengths, self._active,
+                        key)
+                    toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
+                except Exception as e:
+                    self._recover("serve.step", e)
+            if toks is not None:
+                self._retry_budget.success()   # consecutive-failure reset
+                dt = self._clock() - t0
+                lat = _metrics.histogram("serve.token_latency_s")
+                for slot, req in list(self._running.items()):
+                    if not self._active[slot]:
+                        continue               # page-stalled this round
+                    self._lengths[slot] += 1   # pending token now cached
+                    tok = int(toks[slot])
+                    req.tokens.append(tok)
+                    self._last_tokens[slot] = tok
+                    lat.observe(dt)
+                    new_tokens += 1
+                    reason = self._done_reason(req, tok)
+                    if reason:
+                        self._release(req, finished, reason)
+            _metrics.counter("serve.tokens").inc(new_tokens)
+            _metrics.gauge("serve.active_slots").set(len(self._running))
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            wall_s = self._clock() - t0
+            if self._run_log is not None:
+                self._run_log.write({
+                    "phase": "serve", "step": self._step_no,
+                    "wall_s": wall_s, "new_tokens": new_tokens,
+                    "active": len(self._running),
+                    "queue_depth": len(self._queue),
+                    "goodput": round(self.goodput(), 4)})
+            if self._watchdog is not None:
+                self._watchdog.tick(self._step_no, wall_s=wall_s,
+                                    goodput=self.goodput(),
+                                    retired=self._retired)
+            self._step_no += 1
+            return finished
 
     def drain(self, max_steps=100000):
         """Run step() until every submitted request finishes; returns the
         finished requests in completion order."""
         out = []
+        # the lock is released between rounds so client threads can
+        # still reach submit()/cancel() while the drain loop runs
         for _ in range(max_steps):
-            if not (self._queue or self._running):
+            with self._lock:
+                more = bool(self._queue or self._running)
+            if not more:
                 break
             out.extend(self.step())
         else:
+            with self._lock:
+                queued, running = len(self._queue), len(self._running)
             raise RuntimeError(
-                f"drain: {len(self._queue)} queued / {len(self._running)} "
+                f"drain: {queued} queued / {running} "
                 f"running requests left after {max_steps} steps")
         if self._run_log is not None:
             snap = _metrics.snapshot()
@@ -969,21 +994,23 @@ class ServingEngine:
         """Load shedding (the watchdog's mitigation action): shed every
         expired queued request; when none is expired, shed the single
         lowest-priority / latest-deadline one. Returns the shed ids."""
-        shed = []
-        now = self._clock()
-        for req in [r for r in self._queue
-                    if r.deadline_t is not None and now > r.deadline_t]:
-            self._queue.remove(req)
-            shed.append((req, "deadline_expired"))
-        if not shed and self._queue:
-            victim = min(self._queue, key=self._victim_key)
-            self._queue.remove(victim)
-            shed.append((victim, cause))
-        for req, why in shed:
-            _metrics.counter("serve.shed").inc(cause=cause)
-            self._retire_terminal(req, "shed", why)
-        _metrics.gauge("serve.queue_depth").set(len(self._queue))
-        return [req.id for req, _ in shed]
+        with self._lock:
+            shed = []
+            now = self._clock()
+            for req in [r for r in self._queue
+                        if r.deadline_t is not None
+                        and now > r.deadline_t]:
+                self._queue.remove(req)
+                shed.append((req, "deadline_expired"))
+            if not shed and self._queue:
+                victim = min(self._queue, key=self._victim_key)
+                self._queue.remove(victim)
+                shed.append((victim, cause))
+            for req, why in shed:
+                _metrics.counter("serve.shed").inc(cause=cause)
+                self._retire_terminal(req, "shed", why)
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            return [req.id for req, _ in shed]
 
     def _on_anomaly(self, event):
         """Watchdog mitigation hook: a goodput collapse or ingest stall

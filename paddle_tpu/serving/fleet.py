@@ -59,6 +59,7 @@ import itertools
 import json
 import os
 import subprocess
+import threading
 import time
 
 import numpy as np
@@ -515,7 +516,12 @@ class FleetRouter:
                          for h in self._replicas
                          if isinstance(h, InProcessReplica)), default=4)
             cfg.replica_queue_limit = max(2, 2 * slots)
-        self._states = ["live"] * n
+        # One reentrant lock guards the router mirror: submit()/cancel()
+        # arrive on client threads while step()/drain() run the round
+        # thread, and the engine watchdog's anomaly callback re-enters
+        # shed_pending() from under a step that already holds the lock.
+        self._lock = threading.RLock()
+        self._states = ["live"] * n   # graft-guard: self._lock
         self._monitor = HeartBeatMonitor(
             n, timeout_s=cfg.heartbeat_s, interval_s=cfg.heartbeat_s,
             clock=clock)
@@ -524,12 +530,12 @@ class FleetRouter:
         self._budgets = [
             RetryBudget(RetryPolicy(max_attempts=cfg.respawn_budget + 1),
                         "fleet.respawn") for _ in range(n)]
-        self.requests = {}            # fid -> FleetRequest
-        self._pending = collections.deque()
-        self._by_replica = {}         # (replica, replica_rid) -> fid
+        self.requests = {}            # fid -> FleetRequest; graft-guard: self._lock
+        self._pending = collections.deque()   # graft-guard: self._lock
+        self._by_replica = {}   # (replica, replica_rid) -> fid; graft-guard: self._lock
         self._ids = itertools.count()
         self._step_no = 0
-        self._draining = False
+        self._draining = False        # graft-guard: self._lock
         self.failovers = 0
         from paddle_tpu.observability.exporter import start_metrics_server
         self._metrics_server = start_metrics_server(cfg.metrics_port)
@@ -555,88 +561,93 @@ class FleetRouter:
         up front, retriable rejection hints) with the global admission
         limit in place of the per-engine queue bound."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        rec = FleetRequest(id=next(self._ids), prompt=prompt,
-                           max_new=(max_new if max_new is not None
-                                    else self._default_max_new),
-                           eos_id=eos_id, priority=int(priority))
-        rec.submit_t = self._clock()
-        self.requests[rec.id] = rec
-        _metrics.counter("serve.requests").inc(status="submitted")
-        if self._draining:
-            rec.retriable = True
-            self._retire(rec, "rejected", "draining")
-            return rec.id
-        if deadline_s is None:
-            default = float(get_flag("serve_default_deadline_s"))
-            deadline_s = default if default > 0 else None
-        if deadline_s is not None:
-            if deadline_s <= 0:
+        with self._lock:
+            rec = FleetRequest(id=next(self._ids), prompt=prompt,
+                               max_new=(max_new if max_new is not None
+                                        else self._default_max_new),
+                               eos_id=eos_id, priority=int(priority))
+            rec.submit_t = self._clock()
+            self.requests[rec.id] = rec
+            _metrics.counter("serve.requests").inc(status="submitted")
+            if self._draining:
                 rec.retriable = True
-                self._retire(rec, "rejected", "infeasible_deadline")
+                self._retire(rec, "rejected", "draining")
                 return rec.id
-            rec.deadline_t = rec.submit_t + float(deadline_s)
-        # rec already sits in self.requests as "pending", so the count
-        # includes this request: admit while count <= limit
-        if self.cfg.admission_limit and (
-                self._outstanding() > self.cfg.admission_limit):
-            rec.retriable = True
-            self._retire(rec, "rejected", "fleet_admission_limit")
+            if deadline_s is None:
+                default = float(get_flag("serve_default_deadline_s"))
+                deadline_s = default if default > 0 else None
+            if deadline_s is not None:
+                if deadline_s <= 0:
+                    rec.retriable = True
+                    self._retire(rec, "rejected", "infeasible_deadline")
+                    return rec.id
+                rec.deadline_t = rec.submit_t + float(deadline_s)
+            # rec already sits in self.requests as "pending", so the
+            # count includes this request: admit while count <= limit
+            if self.cfg.admission_limit and (
+                    self._outstanding() > self.cfg.admission_limit):
+                rec.retriable = True
+                self._retire(rec, "rejected", "fleet_admission_limit")
+                return rec.id
+            self._pending.append(rec)
+            self._dispatch([])
             return rec.id
-        self._pending.append(rec)
-        self._dispatch([])
-        return rec.id
 
     def cancel(self, fid):
         """Cancel a fleet request: pending records retire directly, a
         dispatched in-process one cancels at its replica."""
-        rec = self.requests.get(fid)
-        if rec is None or rec.status in _TERMINAL:
+        with self._lock:
+            rec = self.requests.get(fid)
+            if rec is None or rec.status in _TERMINAL:
+                return False
+            if rec.status == "pending":
+                self._pending.remove(rec)
+                self._retire(rec, "cancelled", "cancelled", account=False)
+                return True
+            handle = self._replicas[rec.replica]
+            if handle.cancel(rec.replica_rid):
+                self._by_replica.pop((rec.replica, rec.replica_rid), None)
+                self._retire(rec, "cancelled", "cancelled", account=False,
+                             count=False)
+                return True
             return False
-        if rec.status == "pending":
-            self._pending.remove(rec)
-            self._retire(rec, "cancelled", "cancelled", account=False)
-            return True
-        handle = self._replicas[rec.replica]
-        if handle.cancel(rec.replica_rid):
-            self._by_replica.pop((rec.replica, rec.replica_rid), None)
-            self._retire(rec, "cancelled", "cancelled", account=False,
-                         count=False)
-            return True
-        return False
 
     def step(self):
         """One router round: dispatch pending work, step every live
         replica (syncing the failover mirror), ping heartbeats, scan
         for stalls/deaths. Returns the fleet requests that reached a
         terminal status this round."""
-        finished = []
-        self._dispatch(finished)
-        for i, handle in enumerate(self._replicas):
-            if self._states[i] == "dead":
-                continue
-            if not handle.alive():
-                self._on_replica_failure(
-                    i, ReplicaDead(f"replica {i} process died"),
-                    finished)
-                continue
-            if handle.load() == 0 and not self._replica_outstanding(i):
+        with self._lock:
+            finished = []
+            self._dispatch(finished)
+            for i, handle in enumerate(self._replicas):
+                if self._states[i] == "dead":
+                    continue
+                if not handle.alive():
+                    self._on_replica_failure(
+                        i, ReplicaDead(f"replica {i} process died"),
+                        finished)
+                    continue
+                if (handle.load() == 0
+                        and not self._replica_outstanding(i)):
+                    self._ping(i)
+                    continue
+                # load > 0, or the mirror still shows dispatched work
+                # the replica's load no longer does (an out-of-band
+                # retirement like watchdog shedding) — a round fetches
+                # the report
+                try:
+                    report = handle.step()
+                except Exception as e:
+                    self._on_replica_failure(i, e, finished)
+                    continue
+                self._budgets[i].success()
                 self._ping(i)
-                continue
-            # load > 0, or the mirror still shows dispatched work the
-            # replica's load no longer does (an out-of-band retirement
-            # like watchdog shedding) — a round fetches the report
-            try:
-                report = handle.step()
-            except Exception as e:
-                self._on_replica_failure(i, e, finished)
-                continue
-            self._budgets[i].success()
-            self._ping(i)
-            self._sync(i, report, finished)
-        self._scan_heartbeats(finished)
-        self._publish()
-        self._step_no += 1
-        return finished
+                self._sync(i, report, finished)
+            self._scan_heartbeats(finished)
+            self._publish()
+            self._step_no += 1
+            return finished
 
     def drain(self, max_steps=200000):
         """Retire every accepted request, quiescing replicas one at a
@@ -646,36 +657,50 @@ class FleetRouter:
         still dispatches to the least-loaded draining (alive) replica,
         so nothing accepted is dropped. New submissions during drain
         are rejected retriable. Bounded by fleet_drain_timeout_s."""
-        self._draining = True
+        with self._lock:
+            self._draining = True
         t0 = self._clock()
         budget = self.cfg.drain_timeout_s
         out = []
 
         def check(i=None):
             if budget > 0 and self._clock() - t0 > budget:
-                left = [r.id for r in self.requests.values()
-                        if r.status not in _TERMINAL]
+                with self._lock:
+                    left = [r.id for r in self.requests.values()
+                            if r.status not in _TERMINAL]
                 raise RuntimeError(
                     f"fleet drain: {len(left)} requests not terminal "
                     f"after {budget}s"
                     + (f" (quiescing replica {i})" if i is not None
                        else ""))
 
+        # the lock is released between rounds so late client threads can
+        # still reach submit() (and get the retriable draining reject)
         for _ in range(max_steps):
-            if all(s != "live" for s in self._states):
-                break
-            target = next(i for i, s in enumerate(self._states)
-                          if s == "live")
-            self._states[target] = "draining"
-            while (self._states[target] == "draining"
-                   and self._replica_outstanding(target)):
+            with self._lock:
+                if all(s != "live" for s in self._states):
+                    break
+                target = next(i for i, s in enumerate(self._states)
+                              if s == "live")
+                self._states[target] = "draining"
+            while True:
+                with self._lock:
+                    more = (self._states[target] == "draining"
+                            and self._replica_outstanding(target))
+                if not more:
+                    break
                 out.extend(self.step())
                 check(target)
-        while any(r.status not in _TERMINAL
-                  for r in self.requests.values()):
+        while True:
+            with self._lock:
+                left = any(r.status not in _TERMINAL
+                           for r in self.requests.values())
+            if not left:
+                break
             out.extend(self.step())
             check()
-        self._publish()
+        with self._lock:
+            self._publish()
         return out
 
     def kill_replica(self, i):
@@ -689,37 +714,40 @@ class FleetRouter:
         every expired pending request; when none is expired, shed the
         single lowest-priority / latest-deadline one — the fleet-level
         mirror of ServingEngine.shed_queued."""
-        now = self._clock()
-        shed = [(r, "deadline_expired") for r in self._pending
-                if r.deadline_t is not None and now > r.deadline_t]
-        if not shed and self._pending:
-            shed = [(min(self._pending, key=self._victim_key), cause)]
-        for rec, why in shed:
-            self._pending.remove(rec)
-            _metrics.counter("serve.shed").inc(cause=cause)
-            self._retire(rec, "shed", why)
-        return [rec.id for rec, _ in shed]
+        with self._lock:
+            now = self._clock()
+            shed = [(r, "deadline_expired") for r in self._pending
+                    if r.deadline_t is not None and now > r.deadline_t]
+            if not shed and self._pending:
+                shed = [(min(self._pending, key=self._victim_key), cause)]
+            for rec, why in shed:
+                self._pending.remove(rec)
+                _metrics.counter("serve.shed").inc(cause=cause)
+                self._retire(rec, "shed", why)
+            return [rec.id for rec, _ in shed]
 
     def goodput(self):
         """Fleet goodput: SLO-met fraction of accountable retirements
         (cancellations excluded), wherever each request completed."""
-        done = [r for r in self.requests.values()
-                if r.status in _TERMINAL and r.status != "cancelled"]
-        if not done:
-            return 1.0
-        return sum(1 for r in done if r.slo_ok) / len(done)
+        with self._lock:
+            done = [r for r in self.requests.values()
+                    if r.status in _TERMINAL and r.status != "cancelled"]
+            if not done:
+                return 1.0
+            return sum(1 for r in done if r.slo_ok) / len(done)
 
     def telemetry(self):
         """Per-replica + fleet-level snapshot (the bench row payload)."""
-        return {
-            "replicas": [h.telemetry() for h in self._replicas],
-            "states": list(self._states),
-            "failovers": self.failovers,
-            "rerouted": int(sum(r.reroutes
-                                for r in self.requests.values())),
-            "respawn_failures": [b.failures for b in self._budgets],
-            "goodput": round(self.goodput(), 4),
-        }
+        with self._lock:
+            return {
+                "replicas": [h.telemetry() for h in self._replicas],
+                "states": list(self._states),
+                "failovers": self.failovers,
+                "rerouted": int(sum(r.reroutes
+                                    for r in self.requests.values())),
+                "respawn_failures": [b.failures for b in self._budgets],
+                "goodput": round(self.goodput(), 4),
+            }
 
     def close(self):
         for handle in self._replicas:

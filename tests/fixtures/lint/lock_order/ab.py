@@ -1,0 +1,36 @@
+"""Planted lock-order cycle: A._lock -> B._lock -> A._lock.
+
+A.outer steps into B while holding A's lock; B.reverse calls back into
+A while holding B's. Expected: exactly one lock-order finding naming
+both locks.
+"""
+
+import threading
+
+
+class A:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def outer(self):
+        with self._lock:
+            self.b.take()
+
+    def poke(self):
+        with self._lock:
+            return True
+
+
+class B:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def take(self):
+        with self._lock:
+            return True
+
+    def reverse(self):
+        with self._lock:
+            self.a.poke()

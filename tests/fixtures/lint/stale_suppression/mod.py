@@ -1,0 +1,21 @@
+"""Planted stale suppression.
+
+Quiet.read holds the lock, so the unguarded-shared-state suppression
+on its return line swallows nothing — that is the stale-suppression
+finding. Quiet.peek really does race, so its suppression stays live.
+"""
+
+import threading
+
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}  # graft-guard: self._lock
+
+    def read(self):
+        with self._lock:
+            return dict(self.items)  # graft-lint: disable=unguarded-shared-state (stale: the lock is held)
+
+    def peek(self):
+        return len(self.items)  # graft-lint: disable=unguarded-shared-state (deliberate racy len, telemetry only)

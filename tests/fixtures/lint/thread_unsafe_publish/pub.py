@@ -1,0 +1,33 @@
+"""Planted thread-unsafe-publish violation.
+
+Board.scan iterates self.items lazily while Board.publish mutates it;
+self.safe is iterated through a snapshot and self.locked holds a
+common lock at both sites, so only the first loop is a finding.
+"""
+
+import threading
+
+
+class Board:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+        self.safe = {}
+        self.locked = {}
+
+    def scan(self):
+        out = []
+        for key, val in self.items.items():      # VIOLATION
+            out.append((key, val))
+        for key in list(self.safe):              # snapshot: silent
+            out.append(key)
+        with self._lock:
+            for key in self.locked:              # common lock: silent
+                out.append(key)
+        return out
+
+    def publish(self, key):
+        self.items[key] = 1
+        self.safe[key] = 1
+        with self._lock:
+            self.locked[key] = 1

@@ -1,0 +1,54 @@
+"""Planted unguarded-shared-state violations.
+
+Service exercises the inline-comment and module-table declaration
+forms plus Thread-target entry discovery; DocGuarded exercises the
+class-docstring form plus callback-kwarg entry discovery. Expected
+findings: the three unlocked accesses in _loop and submit, plus the
+docstring-guarded mirror read in scan.
+"""
+
+import threading
+
+GUARDED_BY = {"Service.table": "self._lock"}
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}    # graft-guard: self._lock
+        self.done = []    # graft-guard: self._lock
+        self.table = {}
+
+    def start(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        while self.jobs:             # VIOLATION: thread entry, no lock
+            self.table.popitem()     # VIOLATION: GUARDED_BY table form
+
+    def submit(self, job):
+        with self._lock:
+            self.jobs[job] = True
+            self._drain()
+        self.done.append(job)        # VIOLATION: outside the with
+
+    def _drain(self):
+        self.jobs.clear()            # ok: only reached with lock held
+
+
+class DocGuarded:
+    """Mirror of worker state.
+
+    graft-guard: mirror by self._mu
+    """
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.mirror = {}
+
+    def hook(self, watcher):
+        watcher.configure(action=self.scan)
+
+    def scan(self):
+        return len(self.mirror)      # VIOLATION: docstring form

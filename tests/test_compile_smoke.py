@@ -36,12 +36,19 @@ def test_bench_gpt_sharded_dp_tp_hlo_contract():
     tied embedding) must compile AND its per-device HLO must contain no
     [rows, V]-scale temporary and no all-gather of the vocab-sharded
     weight; the PT_FUSED_XENT=0 reference step must TRIP the detector
-    (positive control — proves the grep sees full-vocab logits)."""
+    (positive control — proves the grep sees full-vocab logits).
+
+    The row also carries cost-model-priced budgets and the blessed
+    train.gpt@dp2,tp2 snapshot: the compiled flops/bytes must stay
+    under costmodel.predict() x tolerance (with a tolerance=0 control
+    proving the budget detector trips on a real compile) and the op
+    histogram must match the blessed record."""
     import tools.compile_smoke as cs
     out = cs.sharded_vocab_check(model="gpt", timeout=420)
-    assert out["clean"], (out["vocab_temporaries"],
-                          out["weight_all_gathers"])
+    assert out["clean"], out["violations"]
     assert out["positive_control_trips"]
+    assert out["cost"] and out["cost"]["flops"] > 0, out["cost"]
+    assert out["budget_control_trips"]
     assert out["row"]["mesh"] == {"dp": 2, "tp": 2}
 
 
@@ -53,12 +60,18 @@ def test_serve_step_traced_once_and_paged_hlo_contract():
     gathered-K/V or score temporary — the XLA gather-and-mask fallback
     (use_pallas_decode=0) is the positive control that proves the
     detector sees dense decode attention. The wave includes a
-    40-token prompt admitted through prefill_len=16 chunked prefill."""
+    40-token prompt admitted through prefill_len=16 chunked prefill.
+
+    The decode row also prices the step against
+    costmodel.predict_decode() budgets (tolerance=0 control included)
+    and gates the op histogram on the blessed serve.decode snapshot."""
     import tools.compile_smoke as cs
     out = cs.serve_smoke()
     assert out["decode_traces"] == 1 and out["prefill_traces"] == 1, out
-    assert out["clean"], out["dense_temporaries"]
+    assert out["clean"], out["violations"]
     assert out["positive_control_trips"]
+    assert out["cost"] and out["cost"]["flops"] > 0, out["cost"]
+    assert out["budget_control_trips"]
     assert out["finished"] == 7
 
 

@@ -141,12 +141,18 @@ def dense_score_temporaries(hlo_text, tmax, min_rows):
 
 
 def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
-                        positive_control=True):
+                        positive_control=True, update_snapshots=False):
     """Compile the dp x tp fused train step and evaluate the model's
     full CONTRACTS row (no [rows, V] temporary, no vocab-weight
-    all-gather, no f64, no host callback) against its per-device HLO;
-    optionally also compile the PT_FUSED_XENT=0 reference step and
-    require the NoTemporary detector to TRIP on it (positive control)."""
+    all-gather, no f64, no host callback, and — where the row carries
+    budget contracts — the XLA cost_analysis flops/bytes priced against
+    the autoplan cost model) against its per-device HLO; optionally also
+    compile the PT_FUSED_XENT=0 reference step and require the
+    NoTemporary detector to TRIP on it (positive control). The budget
+    detectors get their own positive control: at tolerance=0 every real
+    compile must exceed a zero budget. When the model has a registered
+    HloSnapshot the compiled op histogram is judged against the blessed
+    record too (``update_snapshots=True`` re-blesses instead)."""
     c = _contracts()
     case = c.SHARDED_TRAIN_CASES[model]
     vocab, hidden = case.vocab, case.hidden
@@ -160,15 +166,29 @@ def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
                   batch=case.batch, seq=case.seq, dump_hlo=fused_hlo,
                   extra_env=chunk_env)
         text = open(fused_hlo).read()
-        violations = c.evaluate(row_contracts,
-                                c.ContractContext(hlo_text=text))
-        out.update(row=row,
+        cost = None
+        try:
+            with open(fused_hlo + ".cost.json") as f:
+                cost = c.normalize_cost(json.load(f))
+        except (OSError, ValueError):
+            pass
+        ctx = c.ContractContext(hlo_text=text, cost=cost)
+        violations = c.evaluate(row_contracts, ctx)
+        snap = c.CONTRACT_SNAPSHOTS.get(f"train.{model}@{mesh}")
+        if snap is not None:
+            if update_snapshots:
+                out["snapshot_blessed"] = snap.bless(text)["hash"]
+            else:
+                violations += snap.violations(ctx)
+        out.update(row=row, cost=cost,
                    vocab_temporaries=vocab_temporaries(
                        text, vocab, 2, min_rows),
                    weight_all_gathers=weight_all_gathers(
                        text, vocab, hidden),
                    violations=[v.format() for v in violations],
                    clean=not violations)
+        budgets = [b for b in row_contracts
+                   if isinstance(b, c.MaxHloCost)]
         if positive_control:
             ref_hlo = os.path.join(td, "reference.hlo")
             run(model=model, tiny=True, timeout=timeout, mesh=mesh,
@@ -177,6 +197,9 @@ def sharded_vocab_check(model="gpt", mesh="dp2,tp2", timeout=600,
             ref_temps = vocab_temporaries(open(ref_hlo).read(), vocab, 2,
                                           min_rows)
             out["positive_control_trips"] = bool(ref_temps)
+            if budgets and cost is not None:
+                out["budget_control_trips"] = all(
+                    b.with_tolerance(0).check(ctx) for b in budgets)
     return out
 
 
@@ -236,7 +259,7 @@ def _serve_engine(num_pages=13, **cfg_kw):
     return model, variables, ServingEngine(model, variables, sc)
 
 
-def serve_smoke(positive_control=True):
+def serve_smoke(positive_control=True, update_snapshots=False):
     """Tier-1 contract for the serving fast path, in-process on CPU:
 
     1. Trace-count probe: mixed-length admission waves through a
@@ -249,6 +272,11 @@ def serve_smoke(positive_control=True):
        gather-and-mask fallback (use_pallas_decode=0) must TRIP the
        detector (positive control — proves the grep sees dense decode
        attention).
+    3. Budget + snapshot gates: the decode step's cost_analysis flops
+       and bytes stay under the costmodel.predict_decode budgets (with
+       a tolerance=0 positive control), and its op histogram matches
+       the blessed serve.decode snapshot (``update_snapshots=True``
+       re-blesses instead).
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -281,18 +309,34 @@ def serve_smoke(positive_control=True):
         out["traced_once"] = (engine.decode_traces == 1
                               and engine.prefill_traces == 1)
 
-        hlo = engine.compiled_decode().as_text()
+        compiled = engine.compiled_decode()
+        hlo = compiled.as_text()
+        try:
+            cost = c.normalize_cost(compiled.cost_analysis())
+        except Exception:
+            cost = None
         ctx = c.ContractContext(
-            hlo_text=hlo,
+            hlo_text=hlo, cost=cost,
             trace_counts={"serve.decode": engine.decode_traces,
                           "serve.prefill": engine.prefill_traces})
         violations = c.evaluate(c.CONTRACTS["serve.decode"]
                                 + c.CONTRACTS["serve.prefill"], ctx)
+        snap = c.CONTRACT_SNAPSHOTS["serve.decode"]
+        if update_snapshots:
+            out["snapshot_blessed"] = snap.bless(hlo)["hash"]
+        else:
+            violations += snap.violations(ctx)
         out["dense_temporaries"] = dense_score_temporaries(
             hlo, tmax, min_rows)
+        out["cost"] = cost
         out["violations"] = [v.format() for v in violations]
         out["clean"] = not violations
         if positive_control:
+            budgets = [b for b in c.CONTRACTS["serve.decode"]
+                       if isinstance(b, c.MaxHloCost)]
+            if budgets and cost is not None:
+                out["budget_control_trips"] = all(
+                    b.with_tolerance(0).check(ctx) for b in budgets)
             set_flags({"use_pallas_decode": False})
             _, _, ref_engine = _serve_engine()
             ref_hlo = ref_engine.compiled_decode().as_text()

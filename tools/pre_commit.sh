@@ -1,0 +1,14 @@
+#!/bin/sh
+# graft-lint pre-commit wrapper: lint only what this branch touches
+# (merge-base with main + staged/unstaged edits + untracked .py files).
+#
+# Install:  ln -s ../../tools/pre_commit.sh .git/hooks/pre-commit
+# Tune:     pass-through args, e.g. tools/pre_commit.sh --fail-on error
+#
+# The AST layer is stdlib-only and finishes in well under a second, so
+# this is cheap enough to run on every commit. The compile-contract
+# layer (--contracts) is deliberately NOT wired in here — it compiles
+# models and belongs in CI, not in the edit loop.
+set -e
+cd "$(dirname "$0")/.."
+exec python tools/graft_lint.py --changed-only "$@"

@@ -529,7 +529,12 @@ def bench_gpt_serve(steps, batch, seq):
     serve.* histograms (the PR-4 registry). Request mix: 4x slots
     requests, prompt lengths uniform in [seq//8, prefill_len],
     max_new=64 each. PT_BENCH_PAGE_SIZE overrides the page size
-    (default 64; 128 fills a TPU lane tile)."""
+    (default 64; 128 fills a TPU lane tile). PT_BENCH_PREFIX_SHARE
+    (default 0.5) is the fraction of requests opening with a common
+    full-page prefix — the prefix-cache workload; the row reports
+    prefix_hit_rate / pages_shared / prefill_tokens_skipped, and
+    serve_prefix_cache=0 in PT_FLAGS gives the uncached A/B on the
+    identical request stream."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
@@ -542,7 +547,13 @@ def bench_gpt_serve(steps, batch, seq):
 
     max_new = 32 if TINY else 64
     page = int(os.environ.get("PT_BENCH_PAGE_SIZE", "64"))
-    prefill_len = min(max(page, seq // 2), cfg.max_position - max_new)
+    share = float(os.environ.get("PT_BENCH_PREFIX_SHARE", "0.5"))
+    # the shared prefix is whole pages so cache hits skip real prefill
+    # work; max_len grows by the same amount so the suffix length
+    # distribution (and the uncached A/B shape) is unchanged
+    shared_len = page if share > 0 else 0
+    prefill_len = min(max(page, seq // 2),
+                      cfg.max_position - max_new - shared_len)
     cache_dtype = (jnp.float32
                    if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
                    else jnp.bfloat16)
@@ -551,7 +562,7 @@ def bench_gpt_serve(steps, batch, seq):
     slo_ttft = float(os.environ.get("PT_BENCH_SLO_TTFT", "2.0"))
     slo_tok = float(os.environ.get("PT_BENCH_SLO_TOKEN", "0.5"))
     sc = ServeConfig(num_slots=batch, page_size=page,
-                     max_len=prefill_len + max_new,
+                     max_len=shared_len + prefill_len + max_new,
                      prefill_len=prefill_len, cache_dtype=cache_dtype,
                      run_log=RUN_LOG, slo_ttft_s=slo_ttft,
                      slo_token_latency_s=slo_tok)
@@ -565,12 +576,18 @@ def bench_gpt_serve(steps, batch, seq):
                 "compile_s": round(time.perf_counter() - t0, 1)}
 
     rng = np.random.RandomState(0)
+    shared_prefix = (rng.randint(0, cfg.vocab_size, (shared_len,),
+                                 dtype=np.int32)
+                     if shared_len else None)
 
     def mixed_requests(n):
         for _ in range(n):
             plen = int(rng.randint(max(1, seq // 8), prefill_len + 1))
-            engine.submit(rng.randint(0, cfg.vocab_size, (plen,),
-                                      dtype=np.int32), max_new=max_new)
+            ids = rng.randint(0, cfg.vocab_size, (plen,),
+                              dtype=np.int32)
+            if shared_len and rng.random_sample() < share:
+                ids = np.concatenate([shared_prefix, ids])
+            engine.submit(ids, max_new=max_new)
 
     # warmup: compile prefill + decode and fill the latency histograms'
     # cold-start tail outside the timed window; reset_stats also zeroes
@@ -578,6 +595,9 @@ def bench_gpt_serve(steps, batch, seq):
     mixed_requests(batch)
     engine.drain()
     engine.reset_stats()
+    pc = engine._prefix_cache
+    hits0, miss0 = (pc.hits, pc.misses) if pc else (0, 0)
+    skip0 = engine.prefill_tokens_skipped
     n_req = max(4 * batch, steps)
     mixed_requests(n_req)
     t0 = time.perf_counter()
@@ -602,6 +622,13 @@ def bench_gpt_serve(steps, batch, seq):
         "slo_token_latency_s": slo_tok,
         "slo_violations": slo["violations"],
         "decode_traces": engine.decode_traces,
+        "prefix_share": share,
+        "prefix_hit_rate": (
+            round((pc.hits - hits0)
+                  / max((pc.hits - hits0) + (pc.misses - miss0), 1), 4)
+            if pc else 0.0),
+        "pages_shared": pc.pages_shared() if pc else 0,
+        "prefill_tokens_skipped": engine.prefill_tokens_skipped - skip0,
         # resilience trajectory: non-completion terminals + step crashes
         # recovered (all 0 in a healthy bench; a regression here means
         # the bench itself hit the resilience path)
@@ -623,10 +650,16 @@ def bench_gpt_serve_fleet(steps, batch, seq):
     PT_BENCH_FLEET_KILL=1 every multi-replica run also exercises the
     failover path itself — one busy replica killed mid-stream — and
     reports the recovery round's wall time (respawn + token-exact
-    re-route) against the mean healthy round as the failover overhead."""
+    re-route) against the mean healthy round as the failover overhead.
+    PT_BENCH_PREFIX_SHARE (default 0.5) mixes in requests opening with
+    a common full-page prefix; each replica-count row then reports the
+    fleet-wide prefix_hit_rate plus the router's affinity_hits (the
+    prefix-affinity dispatch steering same-prefix traffic to the
+    replica already holding the pages)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.observability import metrics as _metrics
     from paddle_tpu.serving import FleetConfig, FleetRouter, ServeConfig
 
     cfg = GPTConfig.tiny() if TINY else GPTConfig.small()
@@ -636,7 +669,10 @@ def bench_gpt_serve_fleet(steps, batch, seq):
 
     max_new = 16 if TINY else 64
     page = int(os.environ.get("PT_BENCH_PAGE_SIZE", "64"))
-    prefill_len = min(max(page, seq // 2), cfg.max_position - max_new)
+    share = float(os.environ.get("PT_BENCH_PREFIX_SHARE", "0.5"))
+    shared_len = page if share > 0 else 0
+    prefill_len = min(max(page, seq // 2),
+                      cfg.max_position - max_new - shared_len)
     cache_dtype = (jnp.float32
                    if os.environ.get("PT_BENCH_CACHE_F32", "0") == "1"
                    else jnp.bfloat16)
@@ -648,7 +684,7 @@ def bench_gpt_serve_fleet(steps, batch, seq):
 
     def serve_cfg():
         return ServeConfig(num_slots=batch, page_size=page,
-                           max_len=prefill_len + max_new,
+                           max_len=shared_len + prefill_len + max_new,
                            prefill_len=prefill_len,
                            cache_dtype=cache_dtype, slo_ttft_s=slo_ttft,
                            slo_token_latency_s=slo_tok, metrics_port=0)
@@ -680,20 +716,37 @@ def bench_gpt_serve_fleet(steps, batch, seq):
                         metrics_port=0),
             serve_config=serve_cfg())
         rng = np.random.RandomState(0)
+        shared_prefix = (rng.randint(0, cfg.vocab_size, (shared_len,),
+                                     dtype=np.int32)
+                         if shared_len else None)
 
         def submit(k, router=router, rng=rng):
             for _ in range(k):
                 plen = int(rng.randint(max(1, seq // 8),
                                        prefill_len + 1))
-                router.submit(rng.randint(0, cfg.vocab_size, (plen,),
-                                          dtype=np.int32),
-                              max_new=max_new)
+                ids = rng.randint(0, cfg.vocab_size, (plen,),
+                                  dtype=np.int32)
+                if shared_len and rng.random_sample() < share:
+                    ids = np.concatenate([shared_prefix, ids])
+                router.submit(ids, max_new=max_new)
+
+        def fleet_prefix_stats(router=router):
+            hits = miss = skipped = 0
+            for rep in router._replicas:
+                eng = getattr(rep, "engine", None)
+                pc = getattr(eng, "_prefix_cache", None)
+                if pc is not None:
+                    hits, miss = hits + pc.hits, miss + pc.misses
+                    skipped += eng.prefill_tokens_skipped
+            return hits, miss, skipped
 
         # warmup: compile every replica's prefill + decode outside the
         # timed window
         submit(n * batch)
         settle(router)
         warm = len(router.requests)
+        hits0, miss0, skip0 = fleet_prefix_stats()
+        aff0 = _metrics.counter("fleet.affinity_hits").total()
         n_req = max(4 * batch * n, steps)
         submit(n_req)
         step_times = []
@@ -715,12 +768,19 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         recs = [r for r in router.requests.values()
                 if r.id >= warm and r.status == "done"]
         tokens = sum(len(r.tokens) for r in recs)
+        hits1, miss1, skip1 = fleet_prefix_stats()
+        d_hits, d_miss = hits1 - hits0, miss1 - miss0
         entry = {
             "requests": n_req,
             "completed": len(recs),
             "tokens_per_sec": round(tokens / dt, 1),
             "goodput": round(router.goodput(), 4),
             "failovers": router.failovers,
+            "prefix_hit_rate": round(
+                d_hits / max(d_hits + d_miss, 1), 4),
+            "prefill_tokens_skipped": skip1 - skip0,
+            "affinity_hits": int(
+                _metrics.counter("fleet.affinity_hits").total() - aff0),
             "telemetry": router.telemetry(),
         }
         if failover_ms is not None:
@@ -743,6 +803,7 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         "max_new": max_new,
         "goodput": top["goodput"],
         "fleet_kill": kill,
+        "prefix_share": share,
         "by_replicas": by_replicas,
         "note": "FleetRouter over in-process engine replicas; "
                 "least-loaded dispatch, heartbeat liveness, token-exact "

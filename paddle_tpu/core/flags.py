@@ -148,6 +148,29 @@ define_flag("serve_chunked_prefill", True,
             "Admit prompts longer than prefill_len in fixed-shape "
             "prefill_len chunks (one prefill trace, page tables grown "
             "per chunk); False restores the long-prompt rejection.")
+# prefix caching + per-request sampling (serving/engine.py +
+# serving/prefix_cache.py): shared prompt prefixes map to refcounted
+# read-only KV pages (prefill skipped for the hit), copy-on-write on
+# divergence; sampling knobs ride per-slot traced arrays in the ONE
+# decode trace
+define_flag("serve_prefix_cache", True,
+            "Cache full prompt pages by rolling content hash and map "
+            "shared prefixes read-only into new slots (prefill skipped "
+            "for the matched tokens, copy-on-write on divergence); "
+            "False prefills every prompt privately.")
+define_flag("serve_prefix_pages", 0,
+            "Max refcount-zero (idle) pages the prefix cache retains "
+            "for future hits; beyond it, least-recently-released idle "
+            "entries are evicted eagerly. 0 = bounded only by the pool "
+            "(idle pages are reclaimed on demand).")
+define_flag("serve_top_k", 0,
+            "Default per-request top-k for sampled decoding (keep the k "
+            "highest logits; 0 = no top-k cut). Per-request submit() "
+            "values override; greedy requests (temperature 0) ignore it.")
+define_flag("serve_top_p", 0.0,
+            "Default per-request nucleus (top-p) mass for sampled "
+            "decoding; 0 = no nucleus cut. Per-request submit() values "
+            "override; greedy requests (temperature 0) ignore it.")
 # fleet serving (serving/fleet.py): a router in front of N ServingEngine
 # replicas — least-loaded dispatch, heartbeat liveness, failover replay
 # of in-flight requests, bounded respawn, graceful drain

@@ -317,15 +317,19 @@ class GPTDecoder(GPT):
             page_rows)
 
     def paged_prefill_chunk(self, prompt, starts, chunk_lengths, caches,
-                            page_rows):
+                            page_rows, write_floor=None):
         """Chunked admission prefill: the fixed [B, Lp] window holds
         tokens at ABSOLUTE positions starts[b] .. starts[b] +
         chunk_lengths[b] - 1 of each request, so a prompt longer than Lp
         is admitted as ceil(len / Lp) calls of one trace. First chunks
         (starts == 0) take the in-chunk causal path bit-exactly;
         continuation chunks re-attend the slot's whole cached prefix
-        through its page table. Returns (logits of each request's LAST
-        chunk token [B, V], new_caches)."""
+        through its page table. write_floor ([B] int32, optional): K/V
+        writes below that absolute position are dropped — the serving
+        engine's prefix-cache hits map shared read-only pages there, so
+        their content must not be rewritten (it is bit-identical anyway;
+        dropping the write is what keeps the pages shareable). Returns
+        (logits of each request's LAST chunk token [B, V], new_caches)."""
         b, lp = prompt.shape
         num_pages, _, page_size, _ = caches[0]["k"].shape
         p_max = page_rows.shape[1]
@@ -335,6 +339,9 @@ class GPTDecoder(GPT):
         page_ids = jnp.take_along_axis(
             page_rows, jnp.minimum(pos // page_size, p_max - 1), axis=1)
         page_ids = jnp.where(in_chunk, page_ids, num_pages)
+        if write_floor is not None:
+            page_ids = jnp.where(pos >= write_floor[:, None], page_ids,
+                                 num_pages)
         offsets = pos % page_size
         emb_pos = jnp.minimum(pos, self.cfg.max_position - 1)
         x = self.tok_emb(prompt) + self.pos_emb(emb_pos)

@@ -57,6 +57,12 @@ CATALOG = {
         "counter", ("path",),
         "HTTP requests served by the /metrics exporter."),
     # serving/fleet.py
+    "fleet.affinity_hits": MetricSpec(
+        "counter", (),
+        "Dispatches routed by prefix affinity — the chosen replica's "
+        "prefix cache already held the request's leading prompt pages "
+        "(least-loaded remains the tiebreak and the imbalance "
+        "fallback)."),
     "fleet.dispatch_depth": MetricSpec(
         "gauge", ("replica",),
         "Requests dispatched to a replica and not yet terminal, by "
@@ -108,6 +114,11 @@ CATALOG = {
     # serving/engine.py
     "serve.active_slots": MetricSpec(
         "gauge", (), "Decode slots holding a live request."),
+    "serve.cow_copies": MetricSpec(
+        "counter", (),
+        "Copy-on-write divergences: a prefix-cache-shared page "
+        "duplicated to a private page before a slot's first write "
+        "into it."),
     "serve.goodput": MetricSpec(
         "gauge", (),
         "Fraction of retired requests that met every configured SLO "
@@ -115,9 +126,21 @@ CATALOG = {
     "serve.page_stalls": MetricSpec(
         "counter", ("where",),
         "Admissions or decode growths that waited on a free KV page."),
+    "serve.pages_shared": MetricSpec(
+        "gauge", (),
+        "Prefix-cache pages currently mapped read-only by at least one "
+        "slot."),
     "serve.preemptions": MetricSpec(
         "counter", (),
         "Requests preempted (pages freed, requeued) on pool deadlock."),
+    "serve.prefix_hits": MetricSpec(
+        "counter", (),
+        "Full prompt pages served read-only from the prefix cache at "
+        "admission — prefill for those tokens is skipped entirely."),
+    "serve.prefix_misses": MetricSpec(
+        "counter", (),
+        "Full prompt pages that missed the prefix cache at admission "
+        "and were prefilled into private pages."),
     "serve.queue_depth": MetricSpec(
         "gauge", (), "Requests waiting for a decode slot."),
     "serve.recoveries": MetricSpec(

@@ -116,6 +116,18 @@ def paged_write(pool, k_t, v_t, page_ids, offsets):
     }
 
 
+def copy_pages(pool, src_ids, dst_ids):
+    """Copy whole pages src->dst within one layer's pool — the serving
+    engine's copy-on-write primitive: a slot about to write into a
+    prefix-cache-shared page first duplicates it to a private page.
+    src_ids/dst_ids: [M] int32. An out-of-range dst DROPS the copy
+    (mode="drop"), matching paged_write's inactive-slot convention."""
+    return {
+        "k": pool["k"].at[dst_ids].set(pool["k"][src_ids], mode="drop"),
+        "v": pool["v"].at[dst_ids].set(pool["v"][src_ids], mode="drop"),
+    }
+
+
 def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale):
     """Gather-and-mask reference: pull every table page densely and mask by
     length. Materializes [S, H, Pmax*ps]-scale score temporaries — the

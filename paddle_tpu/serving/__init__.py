@@ -26,7 +26,8 @@ from paddle_tpu.serving.fleet import (FleetConfig, FleetRequest,
                                       FleetRouter, InProcessReplica,
                                       SubprocessReplica,
                                       replica_worker_loop)
+from paddle_tpu.serving.prefix_cache import PrefixCache
 
 __all__ = ["Request", "ServeConfig", "ServingEngine", "FleetConfig",
            "FleetRequest", "FleetRouter", "InProcessReplica",
-           "SubprocessReplica", "replica_worker_loop"]
+           "PrefixCache", "SubprocessReplica", "replica_worker_loop"]

@@ -103,7 +103,12 @@ class ServeConfig:
     num_pages: int = None        # None -> num_slots * ceil(max_len/page)
     cache_dtype: typing.Any = jnp.float32
     temperature: float = 0.0     # 0 = greedy; >0 samples per step
+    top_k: int = None            # default per-request top-k (None -> flag)
+    top_p: float = None          # default per-request top-p (None -> flag)
     seed: int = 0
+    prefix_cache: bool = None    # None -> flag serve_prefix_cache
+    prefix_pages: int = None     # None -> flag serve_prefix_pages
+    #                              (max idle cached pages; 0 = pool-bounded)
     eos_id: int = None           # default EOS (submit() can override)
     default_max_new: int = 32
     run_log: str = None          # per-step RunLog JSONL path
@@ -135,6 +140,14 @@ class ServeConfig:
             self.step_retries = int(get_flag("serve_step_retries"))
         if self.chunked_prefill is None:
             self.chunked_prefill = bool(get_flag("serve_chunked_prefill"))
+        if self.top_k is None:
+            self.top_k = int(get_flag("serve_top_k"))
+        if self.top_p is None:
+            self.top_p = float(get_flag("serve_top_p"))
+        if self.prefix_cache is None:
+            self.prefix_cache = bool(get_flag("serve_prefix_cache"))
+        if self.prefix_pages is None:
+            self.prefix_pages = int(get_flag("serve_prefix_pages"))
         pages_per_slot = -(-self.max_len // self.page_size)
         if self.num_pages is None:
             self.num_pages = self.num_slots * pages_per_slot
@@ -159,6 +172,16 @@ class Request:
     #                               rejected | shed | cancelled | failed)
     slot: int = None
     pages: list = dataclasses.field(default_factory=list)
+    # prefix-cache pages mapped read-only into the slot's table; ALWAYS a
+    # contiguous row prefix: table row = shared_pages ++ pages
+    shared_pages: list = dataclasses.field(default_factory=list)
+    temperature: float = 0.0      # per-request sampling (set at submit)
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0                 # per-request PRNG seed: token i of this
+    #                               request samples with fold(seed, i), so
+    #                               replay after preemption / recovery /
+    #                               re-route re-draws identically
     submit_t: float = None
     first_token_t: float = None
     done_t: float = None
@@ -200,6 +223,20 @@ class ServingEngine:
         self._last_tokens = np.zeros(s, np.int32)
         self._free_slots = list(range(s))
         self._free_pages = collections.deque(range(cfg.num_pages))
+        # per-slot sampling state: traced [slots] VALUES of the one
+        # decode jit (updated on admit, never retrace axes)
+        self._temps = np.zeros(s, np.float32)
+        self._top_ks = np.zeros(s, np.int32)
+        self._top_ps = np.zeros(s, np.float32)
+        self._seeds = np.zeros(s, np.uint32)
+        self._gen_counts = np.zeros(s, np.int32)
+        from paddle_tpu.serving.prefix_cache import PrefixCache
+        # refcounted content-hash index over the page pool; None = off
+        self._prefix_cache = (      # graft-guard: self._lock
+            PrefixCache(cfg.page_size, max_idle_pages=cfg.prefix_pages)
+            if cfg.prefix_cache else None)
+        self.prefill_tokens_skipped = 0   # prompt tokens never prefilled
+        #                                   (covered by prefix-cache hits)
         # One reentrant lock guards the request tables: clients may
         # submit()/cancel() from their own threads while step()/drain()
         # run elsewhere, and the watchdog's anomaly callback re-enters
@@ -246,7 +283,8 @@ class ServingEngine:
             "serve.token_latency_s", "serve.tokens", "serve.requests",
             "serve.page_stalls", "serve.preemptions", "serve.goodput",
             "serve.slo_violations", "serve.recoveries", "serve.shed",
-            "jit.retraces"])
+            "serve.prefix_hits", "serve.prefix_misses",
+            "serve.cow_copies", "serve.pages_shared", "jit.retraces"])
         self._retired = 0
         self._retired_ok = 0
         self._viol_base = dict(
@@ -260,13 +298,46 @@ class ServingEngine:
                                         run_log=self._run_log,
                                         action=self._on_anomaly)
 
-        temp = float(cfg.temperature)
+        base_key = self._base_key
 
-        def _sample(logits, key):
-            if temp > 0.0:
-                return jax.random.categorical(
-                    key, logits / temp, -1).astype(jnp.int32)
-            return jnp.argmax(logits, -1).astype(jnp.int32)
+        def _sample(logits, temps, top_ks, top_ps, seeds, counts):
+            """Per-request masked sampling, one trace for every mix of
+            greedy / temperature / top-k / top-p rows. logits [B, V];
+            the knobs are traced [B] VALUES (batch-size-shaped, so
+            admissions never retrace). Row b's key is
+            fold(fold(base, seeds[b]), counts[b]) — counts[b] is how
+            many tokens request b has generated, so token i of a
+            request always draws with the same key, making sampled
+            replay (preemption / recovery / re-route) deterministic.
+            temperature == 0 rows take jnp.argmax, bit-exact with the
+            pre-sampling greedy path."""
+            greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+            v = logits.shape[-1]
+            scaled = (logits.astype(jnp.float32)
+                      / jnp.maximum(temps, 1e-6)[:, None])
+            desc = -jnp.sort(-scaled, axis=-1)              # descending
+            k_eff = jnp.where(top_ks > 0,
+                              jnp.minimum(top_ks, v), v).astype(jnp.int32)
+            kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=1)
+            probs = jax.nn.softmax(desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            p_eff = jnp.where((top_ps > 0.0) & (top_ps < 1.0),
+                              top_ps.astype(jnp.float32), 1.0)
+            # smallest set of top rows whose mass reaches p (the nucleus
+            # always keeps at least the argmax row)
+            n_keep = jnp.maximum(
+                jnp.sum((cum - probs) < p_eff[:, None], axis=-1), 1)
+            pth = jnp.take_along_axis(desc, (n_keep - 1)[:, None], axis=1)
+            masked = jnp.where((scaled >= kth) & (scaled >= pth),
+                               scaled, -1e30)
+
+            def row_key(s, c):
+                return jax.random.fold_in(
+                    jax.random.fold_in(base_key, s), c)
+
+            keys = jax.vmap(row_key)(seeds, counts)
+            drawn = jax.vmap(jax.random.categorical)(keys, masked)
+            return jnp.where(temps > 0.0, drawn.astype(jnp.int32), greedy)
 
         self._sample = _sample
         self._build_jits()
@@ -294,36 +365,47 @@ class ServingEngine:
                     _metrics.counter("jit.retraces").inc(fn=fn)
 
         def decode(params, caches, tokens, page_table, lengths, active,
-                   key):
+                   temps, top_ks, top_ps, seeds, counts):
             _count_trace("decode_traces", "serve.decode")
 
             def run(tok):
                 logits, new_caches = model.paged_decode_step(
                     tok, caches, page_table, lengths, active)
-                return _sample(logits, key), new_caches
+                return _sample(logits, temps, top_ks, top_ps, seeds,
+                               counts), new_caches
 
             return model.apply({"params": params, "state": {}}, tokens,
                                method=run)
 
         def prefill(params, caches, prompt, starts, lengths, page_rows,
-                    key):
+                    floors, temps, top_ks, top_ps, seeds, counts):
             _count_trace("prefill_traces", "serve.prefill")
 
             def run(pr):
                 logits, new_caches = model.paged_prefill_chunk(
-                    pr, starts, lengths, caches, page_rows)
-                return _sample(logits, key), new_caches
+                    pr, starts, lengths, caches, page_rows,
+                    write_floor=floors)
+                return _sample(logits, temps, top_ks, top_ps, seeds,
+                               counts), new_caches
 
             return model.apply({"params": params, "state": {}}, prompt,
                                method=run)
 
+        def copy_pages(caches, src, dst):
+            # copy-on-write divergence: duplicate whole pages src -> dst
+            # in every layer's pool ([1]-shaped ids -> one trace ever)
+            from paddle_tpu.ops import attention as _att
+            return [_att.copy_pages(pool, src, dst) for pool in caches]
+
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
         self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+        self._copy_jit = jax.jit(copy_pages, donate_argnums=(0,))
 
     # --- public API ---
 
     def submit(self, prompt, max_new=None, eos_id=None, deadline_s=None,
-               priority=0):
+               priority=0, temperature=None, top_k=None, top_p=None,
+               seed=None):
         """Queue a prompt; returns the request id. The padded prompt is
         staged host->device immediately (async), so admission inside a
         later step() issues no host transfer. Prompts longer than
@@ -338,7 +420,16 @@ class ServingEngine:
         preemption victim choice. When the serve_queue_limit flag bounds
         the queue, over-limit submissions get a terminal `rejected`
         status with `req.retriable = True` instead of queueing — check
-        `engine.requests[rid].status` after submit."""
+        `engine.requests[rid].status` after submit.
+
+        Per-request sampling: `temperature` / `top_k` / `top_p` default
+        to the ServeConfig values (themselves flag-resolvable) and ride
+        per-slot traced arrays of the ONE decode trace — mixing greedy
+        and sampled requests in a batch never retraces. `seed` pins the
+        request's sampling stream (None derives one from cfg.seed and
+        the request id); token i always draws with fold(seed, i), so a
+        sampled request replays deterministically after preemption,
+        recovery, or a fleet re-route."""
         cfg = self.cfg
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = max_new if max_new is not None else cfg.default_max_new
@@ -356,6 +447,7 @@ class ServingEngine:
                           eos_id=eos_id if eos_id is not None
                           else cfg.eos_id,
                           priority=int(priority))
+            self._resolve_sampling(req, temperature, top_k, top_p, seed)
             req.trace_id = f"{self._trace_run}/{req.id}"
             self.requests[req.id] = req
             extra = {}
@@ -384,7 +476,8 @@ class ServingEngine:
 
     def adopt(self, prompt, tokens=(), max_new=None, eos_id=None,
               priority=0, deadline_t=None, submit_t=None,
-              first_token_t=None, origin="fleet"):
+              first_token_t=None, origin="fleet", temperature=None,
+              top_k=None, top_p=None, seed=None):
         """Failover/dispatch entry for the fleet router: queue a request
         whose generation may already be `tokens` deep, preserving the
         caller's accounting clock — submit_t, first_token_t and the
@@ -413,6 +506,7 @@ class ServingEngine:
                           eos_id=eos_id if eos_id is not None
                           else cfg.eos_id,
                           priority=int(priority))
+            self._resolve_sampling(req, temperature, top_k, top_p, seed)
             req.tokens = tokens
             req.deadline_t = deadline_t
             req.first_token_t = first_token_t
@@ -502,13 +596,13 @@ class ServingEngine:
             new_tokens = 0
             toks = None
             if self._active.any():
-                key = jax.random.fold_in(self._base_key, self._step_no)
                 try:
                     fault_point("serve.step")
                     toks_dev, self._caches = self._decode_jit(
                         self._params, self._caches, self._last_tokens,
                         self._page_table, self._lengths, self._active,
-                        key)
+                        self._temps, self._top_ks, self._top_ps,
+                        self._seeds, self._gen_counts)
                     toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
                 except Exception as e:
                     self._recover("serve.step", e)
@@ -522,6 +616,7 @@ class ServingEngine:
                     self._lengths[slot] += 1   # pending token now cached
                     tok = int(toks[slot])
                     req.tokens.append(tok)
+                    self._gen_counts[slot] += 1  # next draw = fold(seed, i)
                     self._last_tokens[slot] = tok
                     lat.observe(dt)
                     new_tokens += 1
@@ -585,14 +680,16 @@ class ServingEngine:
         compiled executable — compile-smoke greps its HLO, bench prewarms
         with it."""
         cfg = self.cfg
-        key = jax.random.fold_in(self._base_key, 0)
+        s = cfg.num_slots
         self._aot_trace = True    # a deliberate extra trace, not a retrace
         try:
             return self._decode_jit.lower(
                 self._params, self._caches,
-                np.zeros(cfg.num_slots, np.int32), self._page_table,
-                np.zeros(cfg.num_slots, np.int32),
-                np.zeros(cfg.num_slots, bool), key).compile()
+                np.zeros(s, np.int32), self._page_table,
+                np.zeros(s, np.int32), np.zeros(s, bool),
+                np.zeros(s, np.float32), np.zeros(s, np.int32),
+                np.zeros(s, np.float32), np.zeros(s, np.uint32),
+                np.zeros(s, np.int32)).compile()
         finally:
             self._aot_trace = False
 
@@ -708,6 +805,150 @@ class ServingEngine:
         return [self._stager.place(padded[i * lp:(i + 1) * lp][None, :])
                 for i in range(n)]
 
+    # --- page allocation + prefix cache ---------------------------------
+
+    def _pages_available(self):
+        """Pages an admission could obtain right now: the free list plus
+        idle (refcount-zero) prefix-cache pages, which _alloc_page
+        reclaims LRU-first."""
+        n = len(self._free_pages)
+        if self._prefix_cache is not None:
+            n += self._prefix_cache.evictable()
+        return n
+
+    def _alloc_page(self):
+        """One free page id, evicting the least-recently-released idle
+        prefix-cache page when the free list is dry. None when nothing
+        is reclaimable (true pool famine)."""
+        if self._free_pages:
+            return self._free_pages.popleft()
+        if self._prefix_cache is not None:
+            for page in self._prefix_cache.evict(1):
+                return page
+        return None
+
+    def _return_pages(self, req):
+        """Give a request's pages back: private pages to the free list,
+        shared pages to the cache (refcount drop — they STAY cached for
+        future hits unless the serve_prefix_pages cap trims them)."""
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        if req.shared_pages:
+            if self._prefix_cache is not None:
+                self._free_pages.extend(
+                    self._prefix_cache.release(req.shared_pages))
+                _metrics.gauge("serve.pages_shared").set(
+                    self._prefix_cache.pages_shared())
+            else:
+                self._free_pages.extend(req.shared_pages)
+            req.shared_pages = []
+
+    def _map_prefix(self, req, total):
+        """Match the request's prompt against the prefix cache and map
+        the hit pages read-only into the slot's table row. Returns the
+        number of leading tokens whose K/V is already cached (prefill
+        below that position is skipped / write-masked). The match is
+        capped at total - 1 so the final position always prefills (its
+        logits produce the first token); when the cap cuts into the last
+        matched page, that page is copy-on-write duplicated up front —
+        the slot's next writes land in the private copy. Any cache
+        failure (the serve.prefix_cache fault point injects them)
+        degrades to a full miss: private pages, never corruption."""
+        if self._prefix_cache is None or total <= 1:
+            return 0
+        cache = self._prefix_cache
+        try:
+            fault_point("serve.prefix_cache")
+            shared, matched = cache.match(req.prompt, cap=total - 1)
+        except Exception:
+            shared, matched = [], 0
+        full = req.prompt.size // self.cfg.page_size
+        _metrics.counter("serve.prefix_hits").inc(len(shared))
+        _metrics.counter("serve.prefix_misses").inc(full - len(shared))
+        if not shared:
+            return 0
+        cache.acquire(shared)
+        req.shared_pages = list(shared)
+        for idx, page in enumerate(shared):
+            self._page_table[req.slot, idx] = page
+        if matched % self.cfg.page_size:
+            if not self._cow_last_shared(req):
+                # no page for the private copy: shrink the match to the
+                # page boundary and let the tail prefill normally
+                drop = req.shared_pages.pop()
+                self._free_pages.extend(cache.release([drop]))
+                matched = (matched // self.cfg.page_size) \
+                    * self.cfg.page_size
+        _metrics.gauge("serve.pages_shared").set(cache.pages_shared())
+        return matched
+
+    def _cow_last_shared(self, req):
+        """Copy-on-write divergence: duplicate the request's LAST shared
+        page into a fresh private page (device-side whole-page copy) and
+        remap the table row. Returns False when no page is allocatable —
+        the caller degrades the match instead."""
+        dst = self._alloc_page()
+        if dst is None:
+            return False
+        src = req.shared_pages.pop()     # held: refcount protects it
+        self._caches = self._copy_jit(
+            self._caches, np.asarray([src], np.int32),
+            np.asarray([dst], np.int32))
+        self._free_pages.extend(self._prefix_cache.release([src]))
+        req.pages.append(dst)
+        self._page_table[req.slot, len(req.shared_pages)] = dst
+        _metrics.counter("serve.cow_copies").inc()
+        return True
+
+    def _publish_prefix(self, req):
+        """Register a just-prefilled prompt's full pages in the cache so
+        later admissions share them. Newly-registered pages change owner
+        (private -> shared) but keep their table positions; the cache
+        skips pages already shared into this row and stops at a private
+        duplicate, so the shared run stays a contiguous row prefix."""
+        if self._prefix_cache is None:
+            return
+        row = self._page_table[req.slot]
+        full = req.prompt.size // self.cfg.page_size
+        for page in self._prefix_cache.insert(req.prompt, row[:full]):
+            req.pages.remove(page)
+            req.shared_pages.append(page)
+        _metrics.gauge("serve.pages_shared").set(
+            self._prefix_cache.pages_shared())
+
+    def prefix_lookup_depth(self, prompt):
+        """Leading full prompt pages this engine's prefix cache holds —
+        the fleet router's affinity probe (read-only, lock-held)."""
+        with self._lock:
+            if self._prefix_cache is None:
+                return 0
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            return self._prefix_cache.lookup_depth(prompt)
+
+    # --- per-request sampling -------------------------------------------
+
+    def _resolve_sampling(self, req, temperature, top_k, top_p, seed):
+        """Fill a request's sampling knobs: explicit values win, else the
+        ServeConfig defaults; a missing seed derives deterministically
+        from the engine seed and the request id."""
+        cfg = self.cfg
+        req.temperature = (cfg.temperature if temperature is None
+                           else float(temperature))
+        req.top_k = cfg.top_k if top_k is None else int(top_k)
+        req.top_p = cfg.top_p if top_p is None else float(top_p)
+        req.seed = ((cfg.seed * 1_000_003 + req.id) & 0xFFFFFFFF
+                    if seed is None else int(seed) & 0xFFFFFFFF)
+
+    def _sampling_rows(self, req):
+        """The prefill jit's [1]-shaped sampling arguments for one
+        request (count = tokens generated so far, so a replayed
+        request's next draw reuses its original key)."""
+        return (np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_k], np.int32),
+                np.asarray([req.top_p], np.float32),
+                np.asarray([req.seed], np.uint32),
+                np.asarray([len(req.tokens)], np.int32))
+
     def _admission_key(self, req):
         """Admission order: highest priority, then earliest deadline
         (None last), then FIFO — all-default traffic stays pure FIFO."""
@@ -728,7 +969,7 @@ class ServingEngine:
             req = min(self._queue, key=self._admission_key)
             total = req.prompt.size + len(req.tokens)  # recovery replays
             first = min(cfg.prefill_len, total)        # prompt + tokens
-            if -(-first // cfg.page_size) > len(self._free_pages):
+            if -(-first // cfg.page_size) > self._pages_available():
                 _metrics.counter("serve.page_stalls").inc(where="admit")
                 break                      # head-of-line waits for pages
             self._queue.remove(req)
@@ -737,12 +978,18 @@ class ServingEngine:
         _metrics.gauge("serve.queue_depth").set(len(self._queue))
 
     def _prefill_request(self, req, total, finished):
-        """Admit one request: take a slot, then for each prefill_len
-        chunk of its replay sequence grow the page table and run the ONE
-        prefill trace; only the final chunk's sampled token is consumed.
-        Returns False when admission must back off (pages ran out
-        between chunks, or a prefill failure triggered recovery)."""
+        """Admit one request: take a slot, match the prompt's leading
+        full pages against the prefix cache (hits map read-only shared
+        pages into the table — prefill for those tokens is SKIPPED),
+        then for each remaining prefill_len chunk of the replay sequence
+        grow the page table and run the ONE prefill trace; only the
+        final chunk's sampled token is consumed. On the way out the
+        prompt's own full pages are registered in the cache so later
+        admissions share them. Returns False when admission must back
+        off (pages ran out between chunks, or a prefill failure
+        triggered recovery)."""
         cfg = self.cfg
+        ps = cfg.page_size
         slot = self._free_slots.pop()
         req.slot = slot
         self._trace_event(
@@ -750,13 +997,20 @@ class ServingEngine:
             else "admitted")
         self._page_table[slot] = 0
         req.pages = []
+        req.shared_pages = []
+        matched = self._map_prefix(req, total)
         tok = None
+        skipped = 0
         for ci in range(-(-total // cfg.prefill_len)):
             start = ci * cfg.prefill_len
             clen = min(cfg.prefill_len, total - start)
-            need = -(-(start + clen) // cfg.page_size)
-            while len(req.pages) < need:
-                if not self._free_pages:
+            if start + clen <= matched:
+                skipped += clen   # fully cache-covered: no prefill call
+                continue
+            need = -(-(start + clen) // ps)
+            while len(req.shared_pages) + len(req.pages) < need:
+                page = self._alloc_page()
+                if page is None:
                     # pool drained between chunks: undo this admission
                     # (pages already written are masked by length and
                     # will be overwritten on retry) and wait
@@ -764,21 +1018,24 @@ class ServingEngine:
                         where="admit")
                     self._abort_admission(req)
                     return False
-                page = self._free_pages.popleft()
-                self._page_table[slot, len(req.pages)] = page
+                self._page_table[
+                    slot, len(req.shared_pages) + len(req.pages)] = page
                 req.pages.append(page)
-            key = jax.random.fold_in(self._base_key, 1_000_000 + req.id)
             starts = np.asarray([start], np.int32)
             lens = np.asarray([clen], np.int32)
+            floors = np.asarray([matched], np.int32)
             try:
                 fault_point("serve.prefill")
                 tok_dev, self._caches = self._prefill_jit(
                     self._params, self._caches, req.device_prompt[ci],
-                    starts, lens, self._page_table[slot][None, :], key)
+                    starts, lens, self._page_table[slot][None, :],
+                    floors, *self._sampling_rows(req))
                 tok = int(np.asarray(tok_dev)[0])  # graft-lint: disable=hot-path-sync (admission-time sync, once per prefill chunk: the slot table needs the first token before decode rounds start)
             except Exception as e:
                 self._recover("serve.prefill", e, pending=req)
                 return False
+        self.prefill_tokens_skipped += skipped
+        self._publish_prefix(req)
         self._lengths[slot] = total
         self._trace_event(req, "prefill_done")
         t = self._trace_event(req, "first_token")
@@ -788,6 +1045,11 @@ class ServingEngine:
         req.tokens.append(tok)
         req.status = "running"
         self._running[slot] = req
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = req.top_k
+        self._top_ps[slot] = req.top_p
+        self._seeds[slot] = req.seed
+        self._gen_counts[slot] = len(req.tokens)
         self._last_tokens[slot] = tok
         self._active[slot] = True
         _metrics.counter("serve.tokens").inc()
@@ -798,10 +1060,10 @@ class ServingEngine:
 
     def _abort_admission(self, req):
         """Undo a half-done admission (mid-chunk page famine): free the
-        slot and pages, requeue at the front."""
+        slot and pages (shared ones back to the cache), requeue at the
+        front."""
         slot = req.slot
-        self._free_pages.extend(req.pages)
-        req.pages = []
+        self._return_pages(req)
         self._page_table[slot] = 0
         self._lengths[slot] = 0
         self._active[slot] = False
@@ -822,10 +1084,11 @@ class ServingEngine:
         for slot, req in self._running.items():
             self._active[slot] = True
             ln = int(self._lengths[slot])
-            if ln % ps or ln // ps < len(req.pages):
+            owned = len(req.shared_pages) + len(req.pages)
+            if ln % ps or ln // ps < owned:
                 continue                   # room in the current page
-            if self._free_pages:
-                page = self._free_pages.popleft()
+            page = self._alloc_page()
+            if page is not None:
                 req.pages.append(page)
                 self._page_table[slot, ln // ps] = page
             else:
@@ -835,16 +1098,21 @@ class ServingEngine:
         return stalled
 
     def _free_slot_state(self, req):
-        """Return a request's slot and pages to the free lists and zero
-        the slot's scheduler rows. Leaves req.slot set (terminal trace
-        events carry it); requeue paths null it themselves."""
+        """Return a request's slot and pages to the free lists (shared
+        pages back to the prefix cache) and zero the slot's scheduler
+        rows. Leaves req.slot set (terminal trace events carry it);
+        requeue paths null it themselves."""
         slot = req.slot
-        self._free_pages.extend(req.pages)
-        req.pages = []
+        self._return_pages(req)
         self._page_table[slot] = 0
         self._lengths[slot] = 0
         self._active[slot] = False
         self._last_tokens[slot] = 0
+        self._temps[slot] = 0.0
+        self._top_ks[slot] = 0
+        self._top_ps[slot] = 0.0
+        self._seeds[slot] = 0
+        self._gen_counts[slot] = 0
         self._running.pop(slot, None)
         self._free_slots.append(slot)
 
@@ -906,12 +1174,23 @@ class ServingEngine:
         self._lengths[:] = 0
         self._active[:] = False
         self._last_tokens[:] = 0
+        self._temps[:] = 0.0
+        self._top_ks[:] = 0
+        self._top_ps[:] = 0.0
+        self._seeds[:] = 0
+        self._gen_counts[:] = 0
         self._free_slots = list(range(cfg.num_slots))
         self._free_pages = collections.deque(range(cfg.num_pages))
+        if self._prefix_cache is not None:
+            # every cached page id now points at zeroed pools — forget
+            # the index (sharing degrades; re-admissions re-publish)
+            self._prefix_cache.clear()
+            _metrics.gauge("serve.pages_shared").set(0)
         self._running = {}
         for req in reversed(victims):      # appendleft keeps id order
             req.slot = None
             req.pages = []
+            req.shared_pages = []
             req.status = "queued"
             req.recoveries += 1
             if req.tokens or req.device_prompt is None:
@@ -1054,15 +1333,7 @@ class ServingEngine:
         _metrics.gauge("serve.goodput").set(self.goodput())
 
     def _release(self, req, finished, reason="length"):
-        slot = req.slot
-        self._free_pages.extend(req.pages)
-        req.pages = []
-        self._page_table[slot] = 0
-        self._lengths[slot] = 0
-        self._active[slot] = False
-        self._last_tokens[slot] = 0
-        self._running.pop(slot, None)
-        self._free_slots.append(slot)
+        self._free_slot_state(req)
         req.status = "done"
         req.retire_reason = reason
         req.done_t = self._clock()

@@ -121,6 +121,10 @@ class FleetRequest:
     tokens: list = dataclasses.field(default_factory=list)
     status: str = "pending"       # pending -> dispatched -> terminal
     priority: int = 0
+    temperature: float = None     # per-request sampling; None = engine
+    top_k: int = None             #   defaults. The router pins `seed` at
+    top_p: float = None           #   submit so a failover re-route
+    seed: int = None              #   re-draws the same sample stream.
     deadline_t: float = None      # absolute router-clock deadline
     submit_t: float = None
     first_token_t: float = None
@@ -198,7 +202,10 @@ class InProcessReplica:
             priority=spec["priority"], deadline_t=spec["deadline_t"],
             submit_t=spec["submit_t"],
             first_token_t=spec["first_token_t"],
-            origin=spec.get("origin", "fleet")) for spec in specs]
+            origin=spec.get("origin", "fleet"),
+            temperature=spec.get("temperature"),
+            top_k=spec.get("top_k"), top_p=spec.get("top_p"),
+            seed=spec.get("seed")) for spec in specs]
 
     def cancel(self, rid):
         self._check()
@@ -322,6 +329,14 @@ class SubprocessReplica:
                         else int(spec["eos_id"])),
                 priority=int(spec["priority"]),
                 origin=spec.get("origin", "fleet"),
+                temperature=(None if spec.get("temperature") is None
+                             else float(spec["temperature"])),
+                top_k=(None if spec.get("top_k") is None
+                       else int(spec["top_k"])),
+                top_p=(None if spec.get("top_p") is None
+                       else float(spec["top_p"])),
+                seed=(None if spec.get("seed") is None
+                      else int(spec["seed"])),
                 deadline_in_s=(None if spec["deadline_t"] is None
                                else spec["deadline_t"] - now),
                 submit_age_s=(0.0 if spec["submit_t"] is None
@@ -441,7 +456,10 @@ def replica_worker_loop(engine, exchange_dir=None, replica=None,
                 submit_t=now - spec["submit_age_s"],
                 first_token_t=(None if spec["first_token_age_s"] is None
                                else now - spec["first_token_age_s"]),
-                origin=spec.get("origin", "fleet"))
+                origin=spec.get("origin", "fleet"),
+                temperature=spec.get("temperature"),
+                top_k=spec.get("top_k"), top_p=spec.get("top_p"),
+                seed=spec.get("seed"))
             submitted.append({"key": spec["key"], "rid": rid})
         if engine._queue or engine._running:
             engine.step()
@@ -490,7 +508,8 @@ class FleetRouter:
         from paddle_tpu.observability import catalog as _catalog
         _catalog.preregister([
             "fleet.replicas", "fleet.failovers", "fleet.rerouted",
-            "fleet.dispatch_depth", "fleet.respawns"])
+            "fleet.dispatch_depth", "fleet.respawns",
+            "fleet.affinity_hits"])
         if replicas is not None:
             self._replicas = list(replicas)
         else:
@@ -554,18 +573,28 @@ class FleetRouter:
     # -- client surface ---------------------------------------------------
 
     def submit(self, prompt, max_new=None, eos_id=None, deadline_s=None,
-               priority=0):
+               priority=0, temperature=None, top_k=None, top_p=None,
+               seed=None):
         """Accept a request fleet-wide; returns the fleet request id.
         Mirrors ServingEngine.submit semantics (default deadline from
         the serve_default_deadline_s flag, infeasible deadlines rejected
         up front, retriable rejection hints) with the global admission
-        limit in place of the per-engine queue bound."""
+        limit in place of the per-engine queue bound. Per-request
+        sampling knobs pass through to the owning engine; the SEED is
+        pinned here (derived from the fleet id when not given) so a
+        failover re-route onto another replica re-draws the same
+        sample stream."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         with self._lock:
             rec = FleetRequest(id=next(self._ids), prompt=prompt,
                                max_new=(max_new if max_new is not None
                                         else self._default_max_new),
                                eos_id=eos_id, priority=int(priority))
+            rec.temperature = temperature
+            rec.top_k = top_k
+            rec.top_p = top_p
+            rec.seed = ((1_000_003 * rec.id + 12_345) & 0xFFFFFFFF
+                        if seed is None else int(seed) & 0xFFFFFFFF)
             rec.submit_t = self._clock()
             self.requests[rec.id] = rec
             _metrics.counter("serve.requests").inc(status="submitted")
@@ -786,16 +815,47 @@ class FleetRouter:
         return [i for i, s in enumerate(self._states)
                 if s == "draining" and self._replicas[i].alive()]
 
-    def _pick_replica(self):
-        best = None
+    def _affinity_depth(self, handle, rec):
+        """Leading full prompt pages of `rec` already in a replica's
+        prefix cache — the placement signal (cf. PAPERS.md 2110.10548:
+        put the work where its data already lives). In-process replicas
+        probe the engine's cache directly; subprocess replicas return 0
+        (the probe is not plumbed over the wire)."""
+        probe = getattr(getattr(handle, "engine", None),
+                        "prefix_lookup_depth", None)
+        if probe is None:
+            return 0
+        try:
+            return probe(rec.prompt)
+        except Exception:
+            return 0
+
+    def _pick_replica(self, rec=None):
+        """Dispatch target for `rec`: the least-loaded eligible replica,
+        unless some replica's prefix cache already holds the request's
+        leading prompt pages — then the least-loaded such replica wins
+        (fleet.affinity_hits), provided it is not overloaded relative
+        to the fleet minimum (imbalance fallback: affinity never starves
+        a cold replica of its fair share)."""
+        candidates = []
         for i in self._eligible_replicas():
             handle = self._replicas[i]
             if handle.queued() >= self.cfg.replica_queue_limit:
                 continue
-            load = handle.load()
-            if best is None or (load, i) < best[:2]:
-                best = (load, i, handle)
-        return best[1:] if best else None
+            candidates.append((handle.load(), i, handle))
+        if not candidates:
+            return None
+        least = min(candidates)
+        if rec is not None:
+            affine = [c for c in candidates
+                      if self._affinity_depth(c[2], rec) > 0]
+            if affine:
+                load, i, handle = min(affine)
+                slack = max(1, self.cfg.replica_queue_limit // 2)
+                if load - least[0] <= slack:
+                    _metrics.counter("fleet.affinity_hits").inc()
+                    return i, handle
+        return least[1:]
 
     def _dispatch(self, finished):
         now = self._clock()
@@ -805,11 +865,11 @@ class FleetRouter:
             _metrics.counter("serve.shed").inc(cause="deadline")
             self._retire(rec, "shed", "deadline_expired", finished)
         while self._pending:
-            target = self._pick_replica()
+            rec = min(self._pending, key=self._admission_key)
+            target = self._pick_replica(rec)
             if target is None:
                 break
             i, handle = target
-            rec = min(self._pending, key=self._admission_key)
             try:
                 fault_point("fleet.dispatch")
             except Exception:
@@ -832,6 +892,8 @@ class FleetRouter:
                     priority=rec.priority, deadline_t=rec.deadline_t,
                     submit_t=rec.submit_t,
                     first_token_t=rec.first_token_t,
+                    temperature=rec.temperature, top_k=rec.top_k,
+                    top_p=rec.top_p, seed=rec.seed,
                     origin=origin if not rec.reroutes else "failover")
 
     # -- liveness + failover ----------------------------------------------

@@ -244,6 +244,9 @@ FAULT_POINTS = {
     "fleet.heartbeat": "fleet router per-replica liveness ping",
     "fleet.respawn": "fleet router respawning a dead replica",
     "serve.prefill": "serving admission prefill (per chunk) device call",
+    "serve.prefix_cache": "prefix-cache lookup at admission (a hash "
+                          "collision or evict-under-use injection "
+                          "degrades the match to private pages)",
     "serve.step": "the jitted continuous-batching decode step",
     "trainer.ingest": "ingest-channel dequeue feeding the train step",
     "trainer.step": "the jitted train step dispatch",

@@ -159,3 +159,31 @@ class TestMetricsServer:
         for v in ('status="qu\\"ote"', 'status="back\\\\slash"',
                   'status="new\\nline"'):
             assert v in final
+
+
+class TestPrefixCacheMetricFamily:
+    """The PR-14 prefix-cache/sampling metric family: cataloged,
+    preregisterable, and scrape-valid before any serving traffic."""
+
+    def test_prefix_family_scrapes_with_help_and_type(self):
+        from paddle_tpu.observability import catalog
+        r = M.MetricsRegistry()
+        catalog.preregister(
+            ["serve.prefix_hits", "serve.prefix_misses",
+             "serve.cow_copies", "serve.pages_shared",
+             "fleet.affinity_hits"], registry=r)
+        r.counter("serve.prefix_hits").inc(3)
+        r.counter("serve.prefix_misses").inc()
+        r.gauge("serve.pages_shared").set(2)
+        text = E.render_prometheus(r)
+        assert_valid_exposition(text)
+        for name in ("serve_prefix_hits", "serve_prefix_misses",
+                     "serve_cow_copies", "serve_pages_shared",
+                     "fleet_affinity_hits"):
+            assert f"# HELP {name} " in text
+            assert f"# TYPE {name} " in text
+        assert "serve_prefix_hits 3" in text
+        assert "serve_prefix_misses 1" in text
+        assert "serve_pages_shared 2" in text
+        # registered-but-untouched members still advertise HELP/TYPE
+        # (asserted above) even with no sample line yet

@@ -443,6 +443,108 @@ replica_worker_loop(engine)
 """
 
 
+class TestPrefixAffinity:
+    def test_affinity_routes_to_warm_replica(self, fast_retry):
+        """A prompt whose leading page sits in replica 1's prefix cache
+        dispatches there (fleet.affinity_hits), overriding the
+        least-loaded index-0 tiebreak."""
+        from paddle_tpu.observability import metrics as _metrics
+        router, model, variables, cfg = _router(num_replicas=2)
+        rng = np.random.RandomState(21)
+        shared = rng.randint(0, cfg.vocab_size, (16,), np.int32)
+        warm = np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (3,), np.int32)])
+        eng1 = router._replicas[1].engine
+        eng1.submit(warm, max_new=2)      # prime replica 1's cache
+        eng1.drain()
+        assert eng1.prefix_lookup_depth(warm) == 2
+        aff0 = _metrics.counter("fleet.affinity_hits").total()
+        probe = np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (5,), np.int32)])
+        fid = router.submit(probe, max_new=4)
+        rec = router.requests[fid]
+        assert rec.replica == 1           # affinity, not the 0-tiebreak
+        assert _metrics.counter("fleet.affinity_hits").total() == aff0 + 1
+        cold = router.submit(_mixed_prompts(cfg, 1, seed=22)[0],
+                             max_new=4)
+        assert router.requests[cold].replica == 0   # unknown prefix:
+        #                                             least-loaded
+        router.drain()
+        assert rec.status == "done"
+        router.close()
+
+    def test_affinity_yields_to_least_loaded_under_imbalance(
+            self, fast_retry):
+        """Affinity never starves a cold replica: once the warm replica
+        is loaded past the slack bound, same-prefix traffic falls back
+        to least-loaded dispatch."""
+        router, model, variables, cfg = _router(num_replicas=2)
+        rng = np.random.RandomState(23)
+        shared = rng.randint(0, cfg.vocab_size, (16,), np.int32)
+        eng1 = router._replicas[1].engine
+        eng1.submit(np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (2,), np.int32)]),
+            max_new=2)
+        eng1.drain()                      # cache warm, replica idle
+        # pile work onto replica 1 out-of-band: 2 running + 3 queued
+        # (queued=3 stays under the dispatch bound of 4, load gap 5 > 2)
+        for _ in range(5):
+            eng1.submit(rng.randint(0, cfg.vocab_size, (6,), np.int32),
+                        max_new=20)
+        eng1.step()
+        assert router._replicas[1].load() > 2
+        probe = np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (4,), np.int32)])
+        fid = router.submit(probe, max_new=4)
+        assert router.requests[fid].replica == 0
+        router.drain()
+        eng1.drain()
+        router.close()
+
+    def test_reroute_with_shared_pages_token_exact(self, fast_retry):
+        """Shared-prefix traffic concentrated by affinity on one
+        replica, killed mid-stream: every re-routed request — greedy
+        AND seeded top-p — finishes on the survivor with exactly the
+        tokens an undisturbed single engine produces (the router pins
+        the seed at submit, so the re-route re-draws the same
+        stream)."""
+        from paddle_tpu.serving import ServingEngine
+        router, model, variables, cfg = _router(num_replicas=2)
+        rng = np.random.RandomState(24)
+        shared = rng.randint(0, cfg.vocab_size, (16,), np.int32)
+        prompts = [np.concatenate(
+            [shared, rng.randint(0, cfg.vocab_size, (k,), np.int32)])
+            for k in (3, 5, 4)]
+        kws = [dict(), dict(), dict(temperature=0.8, top_p=0.9)]
+        first = router.submit(prompts[0], max_new=10, **kws[0])
+        for _ in range(3):                # prefill + publish the prefix
+            router.step()
+        victim = router.requests[first].replica
+        rest = [router.submit(p, max_new=10, **kw)
+                for p, kw in zip(prompts[1:], kws[1:])]
+        fids = [first] + rest
+        # affinity concentrates the same-prefix wave on the victim
+        assert all(router.requests[f].replica == victim for f in rest)
+        router.step()
+        router.kill_replica(victim)
+        router.drain()
+        assert router.failovers == 1
+        assert any(router.requests[f].reroutes for f in fids)
+        undisturbed = ServingEngine(model, variables, _serve_cfg())
+        rids = [undisturbed.submit(
+                    p, max_new=10,
+                    seed=router.requests[f].seed, **kw)
+                for p, f, kw in zip(prompts, fids, kws)]
+        undisturbed.drain()
+        for fid, rid in zip(fids, rids):
+            rec = router.requests[fid]
+            assert rec.status == "done", (fid, rec.status)
+            assert np.array_equal(rec.output,
+                                  undisturbed.requests[rid].output), fid
+        undisturbed.close()
+        router.close()
+
+
 @pytest.mark.slow
 def test_subprocess_replica_failover_end_to_end(tmp_path, fast_retry):
     """A replica engine in a child process over the host_allgather

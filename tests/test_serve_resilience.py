@@ -76,7 +76,7 @@ class TestChunkedPrefill:
             assert np.array_equal(req.output, _reference(
                 model, variables, p, mn)), f"request {rid} diverged"
         assert engine.prefill_traces == 1 and engine.decode_traces == 1
-        assert len(engine._free_pages) == engine.cfg.num_pages
+        assert engine._pages_available() == engine.cfg.num_pages
         engine.close()
 
     def test_chunked_off_rejects_long_prompt_at_submit(self):
@@ -253,7 +253,7 @@ class TestCancel:
         assert engine.requests[r0].status == "cancelled"
         assert engine.requests[r0].retire_reason == "cancelled"
         assert not engine._running
-        assert len(engine._free_pages) == engine.cfg.num_pages
+        assert engine._pages_available() == engine.cfg.num_pages
         assert engine.cancel(r0) is False  # already terminal
         assert engine.cancel(9999) is False
         # cancellation is the client's choice, not an engine failure
@@ -325,3 +325,8 @@ def test_serve_chaos_drill_end_to_end():
     assert summary["injected_faults"] == 3
     assert summary["recoveries"] == 3
     assert summary["statuses"].get("done") == 4
+    # the shared-prefix wave: one degraded lookup (injected fault),
+    # the rest hit, all token-exact
+    assert summary["prefix_faults"] == 1
+    assert summary["prefix_hits"] > 0
+    assert summary["wave_token_exact"] == summary["prefix_wave"] == 3

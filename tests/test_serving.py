@@ -226,9 +226,10 @@ class TestServingEngine:
                               method=lambda pr: model.generate(pr, mn))
             np.testing.assert_array_equal(done[i].output,
                                           np.asarray(ref)[0])
-        # everything returned to the allocator
+        # everything returned to the allocator (idle prefix-cache pages
+        # count: they are reclaimable on demand)
         assert sorted(eng._free_slots) == [0, 1]
-        assert len(eng._free_pages) == 10
+        assert eng._pages_available() == 10
         assert not eng._page_table.any() and not eng._lengths.any()
 
     def test_eos_terminates_early(self, rng):
@@ -265,6 +266,36 @@ class TestServingEngine:
         assert all(t < cfg.vocab_size for ts in run(7).values()
                    for t in ts)
 
+    def test_sampling_defaults_from_flags_and_per_request_override(
+            self, rng, flags_guard):
+        """ServeConfig top_k/top_p left as None resolve from the
+        serve_top_k / serve_top_p flags; per-submit kwargs win over the
+        config defaults; a missing seed derives deterministically from
+        the engine seed and request id; and a per-request top_k=1
+        override is bit-exact greedy even under a hot temperature."""
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        set_flags({"serve_top_k": 5, "serve_top_p": 0.9})
+        model, v, cfg = _tiny_decoder()
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=24, prefill_len=8,
+            temperature=0.8, seed=3))
+        assert (eng.cfg.top_k, eng.cfg.top_p) == (5, 0.9)
+        prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+        rid_default = eng.submit(prompt, max_new=5)
+        rid_override = eng.submit(prompt.copy(), max_new=5,
+                                  temperature=1.3, top_k=1, top_p=0.0,
+                                  seed=42)
+        done = {r.id: r for r in eng.drain()}
+        d = done[rid_default]
+        assert (d.temperature, d.top_k, d.top_p) == (0.8, 5, 0.9)
+        assert d.seed == (3 * 1_000_003 + rid_default) & 0xFFFFFFFF
+        o = done[rid_override]
+        assert (o.temperature, o.top_k, o.top_p, o.seed) == (
+            1.3, 1, 0.0, 42)
+        ref = model.apply(v, jnp.asarray(prompt[None, :]),
+                          method=lambda m: model.generate(m, 5))
+        np.testing.assert_array_equal(o.output, np.asarray(ref)[0])
+
     def test_page_exhaustion_stalls_then_recovers(self, rng):
         """With a pool too small for both requests' full growth, a slot
         stalls (counter fires) but decoding still completes correctly
@@ -287,7 +318,7 @@ class TestServingEngine:
                               method=lambda pr: model.generate(pr, 12))
             np.testing.assert_array_equal(done[i].output,
                                           np.asarray(ref)[0])
-        assert len(eng._free_pages) == 4
+        assert eng._pages_available() == 4
 
 
 class TestServeExport:

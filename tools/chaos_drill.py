@@ -151,7 +151,10 @@ def run_serve_drill(seed=0):
     """In-process serving resilience drill; returns a summary dict
     (raises on any verification failure). Deterministic: greedy
     decoding + a seeded FaultPlan, so completed outputs are checked
-    token-exact against per-request generate() references."""
+    token-exact against per-request generate() references. Ends with a
+    shared-prefix wave whose first admission takes an injected
+    serve.prefix_cache fault (degrade to private pages, never corrupt)
+    while the rest must still hit the cache."""
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time as _time
@@ -237,6 +240,41 @@ def run_serve_drill(seed=0):
             assert np.array_equal(got, np.asarray(ref)[0]), (
                 f"request {rid} not token-exact after recovery")
         assert engine.decode_traces == 1 and engine.prefill_traces == 1
+
+        # -- shared-prefix wave: three requests opening with the same
+        # 20-token prefix (page 8 -> two full cacheable pages). The
+        # FIRST admission's prefix-cache lookup takes an injected fault
+        # (a hash collision / evict-under-use stand-in) and must
+        # degrade to private pages; the later two hit the pages it
+        # published. All three must stay token-exact vs generate().
+        pc = engine._prefix_cache
+        hits0 = pc.hits if pc else 0
+        wave_plan = chaos.FaultPlan(seed=seed)
+        wave_plan.fail("fault_point", path=r"^serve\.prefix_cache$",
+                       nth=1, times=1)
+        shared = rng.randint(0, cfg.vocab_size, (20,), dtype=np.int32)
+        wave_prompts = [
+            np.concatenate([shared, rng.randint(0, cfg.vocab_size, (k,),
+                                                dtype=np.int32)])
+            for k in (4, 7, 5)]
+        with chaos.active(wave_plan):
+            wave_ids = [engine.submit(p, max_new=6)
+                        for p in wave_prompts]
+            engine.drain()
+        prefix_faults = wave_plan.fired("fault_point")
+        assert prefix_faults == 1, (
+            f"expected 1 injected prefix-cache fault, {prefix_faults}")
+        wave_hits = (pc.hits - hits0) if pc else 0
+        assert wave_hits > 0, "shared-prefix wave produced no cache hits"
+        for rid, p in zip(wave_ids, wave_prompts):
+            assert engine.requests[rid].status == "done"
+            ref = model.apply(variables, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, 6))
+            assert np.array_equal(engine.requests[rid].output,
+                                  np.asarray(ref)[0]), (
+                f"wave request {rid} not token-exact under the "
+                "degraded prefix cache")
+        assert engine.decode_traces == 1 and engine.prefill_traces == 1
         engine.close()
         return dict(
             submitted=len(statuses),
@@ -246,7 +284,10 @@ def run_serve_drill(seed=0):
             recovered_done=[r.id for r in recovered],
             chunked_prompts=[rid for rid in accepted
                              if engine.requests[rid].prompt.size > 16],
-            token_exact=len(accepted))
+            token_exact=len(accepted),
+            prefix_wave=len(wave_ids), prefix_hits=wave_hits,
+            prefix_faults=prefix_faults,
+            wave_token_exact=len(wave_ids))
     finally:
         F.set_flags(saved)
 
@@ -289,8 +330,14 @@ def run_fleet_drill(seed=0):
         variables = model.init(jax.random.key(0))
         router = FleetRouter(
             model, variables,
+            # dead_factor sized so a replica silent only because its
+            # SIBLINGS are cold-compiling (one router round serializes
+            # all three engines' first decode+prefill jits) is never
+            # declared dead: 0.04 x 600 = 24s of headroom on CPU. The
+            # kill below is detected by the process-died check, not
+            # this timeout, and the 0.1s stall only needs heartbeat_s.
             FleetConfig(num_replicas=3, heartbeat_s=0.04,
-                        heartbeat_dead_factor=200.0, respawn_budget=3),
+                        heartbeat_dead_factor=600.0, respawn_budget=3),
             serve_config=ServeConfig(num_slots=2, page_size=8,
                                      max_len=64, prefill_len=16,
                                      step_retries=4))

@@ -297,11 +297,18 @@ def serve_smoke(positive_control=True, update_snapshots=False):
         # admission lands in a freed slot mid-run. The 40-token prompt
         # exceeds prefill_len=16 — chunked prefill admits it as three
         # calls of the SAME prefill trace (the traced-once assertion
-        # below covers it)
-        for plen, mn in [(3, 7), (9, 5), (16, 6), (40, 6), (5, 9),
-                         (12, 4), (2, 8)]:
+        # below covers it). Sampling knobs are deliberately MIXED —
+        # greedy, temperature, top-k, top-p, and a pinned seed in one
+        # batch — because they ride as traced [slots] values, not
+        # retrace axes
+        for plen, mn, kw in [
+                (3, 7, {}), (9, 5, dict(temperature=0.8)),
+                (16, 6, dict(temperature=0.9, top_k=5)),
+                (40, 6, {}), (5, 9, dict(temperature=0.7, top_p=0.9)),
+                (12, 4, dict(temperature=1.0, top_k=8, top_p=0.95)),
+                (2, 8, dict(temperature=0.6, seed=123))]:
             engine.submit(rng.randint(0, 512, (plen,), dtype=np.int32),
-                          max_new=mn)
+                          max_new=mn, **kw)
         done = engine.drain()
         out["finished"] = len(done)
         out["decode_traces"] = engine.decode_traces
@@ -342,10 +349,35 @@ def serve_smoke(positive_control=True, update_snapshots=False):
             ref_hlo = ref_engine.compiled_decode().as_text()
             ref_temps = dense_score_temporaries(ref_hlo, tmax, min_rows)
             out["positive_control_trips"] = bool(ref_temps)
+            # retrace positive control: widening the page table by one
+            # column IS a shape leak, so calling the decode jit with it
+            # must register as a retrace and trip the TracedOnce row
+            # (proves the probe sees real retraces, including any the
+            # per-request sampling args could have introduced)
+            s = engine.cfg.num_slots
+            wide = np.concatenate(
+                [engine._page_table,
+                 np.zeros((s, 1), engine._page_table.dtype)], axis=1)
+            _, engine._caches = engine._decode_jit(
+                engine._params, engine._caches, np.zeros(s, np.int32),
+                wide, np.zeros(s, np.int32), np.zeros(s, bool),
+                np.zeros(s, np.float32), np.zeros(s, np.int32),
+                np.zeros(s, np.float32), np.zeros(s, np.uint32),
+                np.zeros(s, np.int32))
+            ctx_re = c.ContractContext(
+                hlo_text=hlo, cost=cost,
+                trace_counts={"serve.decode": engine.decode_traces,
+                              "serve.prefill": engine.prefill_traces})
+            tripped = c.evaluate(
+                [r for r in c.CONTRACTS["serve.decode"]
+                 if isinstance(r, c.TracedOnce)], ctx_re)
+            out["retrace_control_trips"] = bool(tripped)
     finally:
         set_flags(saved)
     out["ok"] = bool(out.get("traced_once") and out.get("clean")
                      and out.get("positive_control_trips",
+                                 not positive_control)
+                     and out.get("retrace_control_trips",
                                  not positive_control))
     return out
 

@@ -71,7 +71,10 @@ class LossScaler:
     def init(self):
         return {"scale": jnp.asarray(self.init_scale, jnp.float32),
                 "good_steps": jnp.zeros((), jnp.int32),
-                "bad_steps": jnp.zeros((), jnp.int32)}
+                "bad_steps": jnp.zeros((), jnp.int32),
+                # cumulative skipped-update count; ScalerObserver publishes
+                # host-side deltas as amp.skipped_steps
+                "skipped": jnp.zeros((), jnp.int32)}
 
     def scale_loss(self, loss, state):
         return loss * state["scale"]
@@ -87,8 +90,12 @@ class LossScaler:
         return finite
 
     def update(self, state, grads_finite):
+        # skip accounting runs even with static scaling (old states that
+        # predate the leaf default to 0, so restores stay compatible)
+        skipped = (state.get("skipped", jnp.zeros((), jnp.int32))
+                   + jnp.where(grads_finite, 0, 1))
         if not self.dynamic:
-            return state
+            return {**state, "skipped": skipped}
         good = jnp.where(grads_finite, state["good_steps"] + 1, 0)
         bad = jnp.where(grads_finite, 0, state["bad_steps"] + 1)
         scale = state["scale"]
@@ -99,7 +106,47 @@ class LossScaler:
                           scale)
         bad = jnp.where(bad >= self.decr_every, 0, bad)
         scale = jnp.clip(scale, 1.0, 2.0 ** 24)
-        return {"scale": scale, "good_steps": good, "bad_steps": bad}
+        return {"scale": scale, "good_steps": good, "bad_steps": bad,
+                "skipped": skipped}
+
+
+class ScalerObserver:
+    """Host-side bridge from a LossScaler state to the metrics registry:
+    the amp.loss_scale gauge and the amp.skipped_steps counter.
+
+    Feed `publish()` host values only — the training guardian hands it
+    the trailing-fetched scaler state, so publishing adds no device
+    sync. The in-state skip count is cumulative; the observer publishes
+    deltas and ignores backward jumps (a guardian rollback rewinds the
+    state's count, but the counter is monotonic)."""
+
+    def __init__(self, registry=None):
+        # lazy import: amp itself stays importable without observability
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.observability.catalog import help_for as _help
+        self._reg = registry if registry is not None else _metrics.registry()
+        self._help = _help
+        self._last_skipped = None
+
+    def publish(self, scaler_state):
+        if not scaler_state:
+            return
+        scale = scaler_state.get("scale")
+        if scale is not None:
+            self._reg.gauge("amp.loss_scale",
+                            self._help("amp.loss_scale")).set(float(scale))
+        skipped = scaler_state.get("skipped")
+        if skipped is not None:
+            cur = int(skipped)
+            if self._last_skipped is None:
+                # first sight of a resumed state: adopt, don't re-count
+                self._last_skipped = cur
+            elif cur > self._last_skipped:
+                self._reg.counter(
+                    "amp.skipped_steps",
+                    self._help("amp.skipped_steps")).inc(
+                        cur - self._last_skipped)
+                self._last_skipped = cur
 
 
 def decorate(optimizer, policy=None, scaler=None):

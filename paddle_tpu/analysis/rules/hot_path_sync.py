@@ -45,6 +45,7 @@ class HotPathSync(Rule):
     DEFAULT_MODULES = (
         "paddle_tpu/serving/engine.py",
         "paddle_tpu/static/trainer.py",
+        "paddle_tpu/static/guardian.py",
         "paddle_tpu/observability/telemetry.py",
         "paddle_tpu/observability/watchdog.py",
         "paddle_tpu/data/loader.py",

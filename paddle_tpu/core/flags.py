@@ -256,6 +256,30 @@ define_flag("watchdog_stall_s", 1.0,
 define_flag("watchdog_goodput_min", 0.5,
             "serve.goodput below this (after enough retired requests) "
             "latches a goodput_collapse anomaly.")
+# training guardian (static/guardian.py): in-trace non-finite
+# containment, host-side loss-spike detection, and the skip -> re-read ->
+# rollback mitigation ladder (GuardianConfig fields left unset resolve
+# from these)
+define_flag("trainer_rollback_budget", 3,
+            "Consecutive checkpoint rollbacks the training guardian may "
+            "perform without an intervening healthy checkpoint before it "
+            "gives up and re-raises (TrainingDiverged), mirroring "
+            "serve_step_retries exhaustion semantics.")
+define_flag("trainer_spike_factor", 10.0,
+            "A finite loss above spike_factor x the rolling median of "
+            "recent healthy losses latches a loss_spike anomaly and "
+            "advances the guardian's mitigation ladder.")
+define_flag("trainer_ingest_fail_fast", True,
+            "Abort the Trainer step loop as soon as an ingest reader "
+            "thread dies (the error still raises with full context); "
+            "False drains the surviving readers first and raises at "
+            "end of stream.")
+# checkpoint integrity (io/checkpoint.py): per-leaf crc32 manifests
+# written beside each step and checked on restore
+define_flag("checkpoint_verify", True,
+            "Verify restored checkpoint leaves against the step's crc32 "
+            "manifest; a corrupt leaf degrades to a clean mirror re-fetch "
+            "or the previous committed step instead of loading garbage.")
 # fault tolerance — checkpoint mirroring (io/checkpoint.py): False = a
 # mirror push that still fails after retries is logged and queued for the
 # next save (training continues on the durable local copy); True = raise

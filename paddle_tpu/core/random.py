@@ -24,6 +24,25 @@ def seed(s):
     _state.key = jax.random.key(s)
 
 
+def get_state():
+    """JSON-serializable snapshot of the global key stream (a list of
+    ints, or None before any seeding). Checkpoint meta carries it so a
+    resumed run continues the exact key sequence (bit-exact resume)."""
+    if not hasattr(_state, "key"):
+        return None
+    data = jax.random.key_data(_state.key)
+    return [int(x) for x in jax.numpy.ravel(data)]
+
+
+def set_state(data):
+    """Restore a get_state() snapshot into the global key stream; None
+    (never-seeded snapshot) is a no-op."""
+    if data is None:
+        return
+    arr = jax.numpy.asarray(data, dtype=jax.numpy.uint32)
+    _state.key = jax.random.wrap_key_data(arr)
+
+
 def next_key(n=None):
     """Split the global key-stream; returns one key or a list of n keys."""
     _ensure()

@@ -21,6 +21,8 @@ training continues on the durable local copy (``strict_mirror`` flag or
 ctor arg restores fail-fast).
 """
 
+import binascii
+import json
 import os
 
 import jax
@@ -31,12 +33,35 @@ from paddle_tpu.testing.chaos import fault_point
 
 # pushed last into each mirrored step dir; its presence IS the commit
 COMMIT_MARKER = "COMMIT"
+# Integrity manifest: per-leaf crc32 checksums plus caller meta (RNG key,
+# data cursor, guardian state). Locally it is a "<step>.meta.json" sidecar
+# BESIDE the step dirs — a name that never parses as a step number, so
+# every retention loop skips it and orbax never sees a foreign file inside
+# its step dir. In the remote mirror it rides INSIDE the step dir beside
+# the COMMIT marker (pruned with the step, fetched with the step).
+META_SUFFIX = ".meta.json"
+META_NAME = "INTEGRITY.json"
 
 try:
     import orbax.checkpoint as ocp
     _HAS_ORBAX = True
 except Exception:  # pragma: no cover
     _HAS_ORBAX = False
+
+
+def crc_manifest(state):
+    """Per-leaf crc32 of a pytree's raw bytes, keyed by pytree key path
+    (with dtype/shape so a reshaped corruption can't collide). Computed
+    from the in-memory state at save time and from the restored state at
+    verify time — equality means the bytes round-tripped."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    man = {}
+    for kp, leaf in flat:
+        a = np.asarray(leaf)
+        man[jax.tree_util.keystr(kp)] = {
+            "crc32": int(binascii.crc32(np.ascontiguousarray(a).tobytes())),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+    return man
 
 
 def save_persistables(state, path, step=None, async_=False):
@@ -181,6 +206,14 @@ class CheckpointManager:
         if self._fs.fs_exists(dst):
             self._fs.remove_tree(dst)
         self._fs.put_tree(os.path.join(self.path, str(step)), dst)
+        meta = self._meta_path(step)
+        if os.path.exists(meta):
+            # the integrity manifest lands beside the COMMIT marker,
+            # before it — commit covers the manifest too
+            with open(meta, "rb") as src:
+                payload = src.read()
+            with self._fs.fs_open(f"{dst}/{META_NAME}", "wb") as f:
+                f.write(payload)
         with self._fs.fs_open(f"{dst}/{COMMIT_MARKER}", "wb") as f:
             f.write(b"committed")
 
@@ -268,16 +301,78 @@ class CheckpointManager:
             marker = os.path.join(local, COMMIT_MARKER)
             if os.path.exists(marker):
                 os.remove(marker)      # staging holds orbax files only
+            fetched_meta = os.path.join(local, META_NAME)
+            if os.path.exists(fetched_meta):
+                # back to its local sidecar home beside the step dirs
+                os.replace(fetched_meta, self._meta_path(step))
             if self._mgr is not None:
                 # orbax scanned the staging dir at construction; rebuild so
                 # it sees the newly fetched step
                 self._mgr.close()
                 self._mgr = self._make_mgr()
 
-    def save(self, step, state, force=False):
+    # -- integrity manifest + caller meta ----------------------------------
+    def _meta_path(self, step):
+        return os.path.join(self.path, f"{int(step)}{META_SUFFIX}")
+
+    def _write_meta(self, step, state, meta):
+        payload = {"step": int(step), "crc32": crc_manifest(state),
+                   "meta": meta or {}}
+        tmp = self._meta_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self._meta_path(step))
+
+    def _prune_meta(self, keep_steps):
+        """Drop sidecars whose step dir is gone (retention or
+        reconciliation removed it)."""
+        keep = {int(s) for s in keep_steps}
+        try:
+            names = os.listdir(self.path)
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        for name in names:
+            if not name.endswith(META_SUFFIX):
+                continue
+            stem = name[:-len(META_SUFFIX)]
+            if stem.isdigit() and int(stem) not in keep:
+                try:
+                    os.remove(os.path.join(self.path, name))
+                except OSError:
+                    pass
+
+    def read_meta(self, step):
+        """The caller-supplied meta dict saved with `step` (the Trainer
+        stores RNG key, data cursor, and guardian state there); {} when
+        the step predates integrity manifests."""
+        try:
+            with open(self._meta_path(step)) as f:
+                return json.load(f).get("meta") or {}
+        except (OSError, ValueError):
+            return {}
+
+    def _manifest_mismatches(self, step, state):
+        """Leaf key paths whose crc32 disagrees with the step's saved
+        manifest; [] means clean — or unverifiable (no manifest: the
+        step predates integrity manifests)."""
+        try:
+            with open(self._meta_path(step)) as f:
+                manifest = json.load(f).get("crc32") or {}
+        except (OSError, ValueError):
+            return []
+        actual = crc_manifest(state)
+        return [key for key, spec in manifest.items()
+                if (actual.get(key) is None
+                    or actual[key]["crc32"] != spec["crc32"]
+                    or actual[key]["dtype"] != spec["dtype"]
+                    or actual[key]["shape"] != spec["shape"])]
+
+    def save(self, step, state, force=False, meta=None):
         """Save when the step hits the save interval; `force=True`
         bypasses the interval gate (preemption: flush the current step at
-        the boundary before exiting)."""
+        the boundary before exiting). `meta` is an arbitrary
+        JSON-serializable dict stored in the step's integrity sidecar and
+        returned by read_meta()."""
         if self._mgr is not None:
             if force and self._mgr.latest_step() == step:
                 saved = True           # boundary save already landed
@@ -286,7 +381,9 @@ class CheckpointManager:
                     step, args=ocp.args.StandardSave(state), force=force)
             if saved:
                 _metrics.counter("checkpoint.saves").inc()
+                self._write_meta(step, state, meta)
                 self._mirror_save(step)
+                self._prune_meta(self._mgr.all_steps())
             return saved
         if force or step % self.save_interval == 0:
             save_persistables(state, self.path, step)
@@ -296,9 +393,25 @@ class CheckpointManager:
                 import shutil
                 shutil.rmtree(os.path.join(self.path, str(old)))
             _metrics.counter("checkpoint.saves").inc()
+            self._write_meta(step, state, meta)
             self._mirror_save(step)
+            self._prune_meta(steps[-self.max_to_keep:])
             return True
         return False
+
+    def steps(self):
+        """Restorable step numbers, ascending: committed remote steps
+        when mirrored (the remote tree is authoritative), else the local
+        step dirs."""
+        if self._remote is not None:
+            return sorted(self._remote_steps())
+        if self._mgr is not None:
+            return sorted(int(s) for s in self._mgr.all_steps())
+        try:
+            return sorted(int(d) for d in os.listdir(self.path)
+                          if d.isdigit())
+        except (FileNotFoundError, NotADirectoryError):
+            return []
 
     def _reconcile_staging(self, committed):
         """Drop staged steps the authoritative remote doesn't know about —
@@ -312,40 +425,109 @@ class CheckpointManager:
                  if d.isdigit() and int(d) not in committed]
         for d in stale:
             shutil.rmtree(os.path.join(self.path, d), ignore_errors=True)
+        if stale:
+            self._prune_meta(committed)
         if stale and self._mgr is not None:
             self._mgr.close()
             self._mgr = self._make_mgr()
 
-    def restore(self, template, step=None):
-        if step is None and self._remote is not None:
+    def _restore_one(self, step, template):
+        """Load one step (fetching from the mirror when staged-out) with
+        no integrity judgment — exceptions propagate to the verified
+        wrapper."""
+        self._fetch_remote(step)
+        if self._mgr is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape") else x, template)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return load_persistables(self.path, template, step)
+
+    def _restore_verified(self, step, template, verify):
+        """Load `step` and (when `verify`) check it against its crc32
+        manifest. A mismatch or load failure wipes the local copy and
+        re-fetches the mirror's once; if the step is still bad it is
+        abandoned (checkpoint.integrity_fallbacks) and the caller
+        degrades to the previous committed step. Returns the state or
+        None."""
+        import shutil
+        for attempt in ("local", "refetch"):
+            if attempt == "refetch":
+                if self._remote is None:
+                    break              # nowhere cleaner to re-fetch from
+                shutil.rmtree(os.path.join(self.path, str(step)),
+                              ignore_errors=True)
+                try:
+                    os.remove(self._meta_path(step))
+                except OSError:
+                    pass
+                if self._mgr is not None:
+                    self._mgr.close()
+                    self._mgr = self._make_mgr()
+            try:
+                if verify:
+                    fault_point("checkpoint.verify")
+                state = self._restore_one(step, template)
+                bad = (self._manifest_mismatches(step, state)
+                       if verify else [])
+            except Exception as e:
+                self._last_restore_exc = e
+                print(f"[checkpoint] WARNING: restore of step {step} "
+                      f"failed ({type(e).__name__}: {e})")
+                continue
+            if not bad:
+                return state
+            _metrics.counter("checkpoint.corrupt_leaves").inc(len(bad))
+            print(f"[checkpoint] WARNING: step {step} failed integrity "
+                  f"verification on {len(bad)} leaves "
+                  f"(e.g. {bad[0]!r})")
+        _metrics.counter("checkpoint.integrity_fallbacks").inc()
+        return None
+
+    def restore(self, template, step=None, verify=None):
+        """Restore the newest healthy step (or exactly `step` when
+        given). With `verify` (default: the checkpoint_verify flag) each
+        candidate is checked against its crc32 manifest; a corrupt or
+        unreadable step degrades to a clean mirror re-fetch, then to the
+        previous committed step, instead of loading garbage."""
+        if verify is None:
+            from paddle_tpu.core import flags as F
+            verify = bool(F.get_flag("checkpoint_verify"))
+        explicit = step is not None
+        self._last_restore_exc = None
+        if explicit:
+            cand = [int(step)]
+        elif self._remote is not None:
             # the REMOTE tree is authoritative: the deterministic staging
             # dir survives across experiments on a host, and a stale local
             # step outranking a reset remote would silently resume the
             # wrong run's weights
-            cand = self._remote_steps()
+            cand = sorted(self._remote_steps(), reverse=True)
             self._reconcile_staging(set(cand))
-            step = max(cand) if cand else None
-            if step is None:
-                return None, None
-        if step is not None:
-            self._fetch_remote(step)
-        if self._mgr is not None:
-            step = step if step is not None else self._mgr.latest_step()
-            if step is None:
-                return None, None
-            abstract = jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-                if hasattr(x, "shape") else x, template)
-            state = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
-            _metrics.counter("checkpoint.restores").inc()
-            return state, step
-        step = step if step is not None else latest_step(self.path)
-        if step is None:
-            return None, None
-        state = load_persistables(self.path, template, step)
-        _metrics.counter("checkpoint.restores").inc()
-        return state, step
+        elif self._mgr is not None:
+            cand = sorted((int(s) for s in self._mgr.all_steps()),
+                          reverse=True)
+        else:
+            last = latest_step(self.path)
+            cand = (sorted((int(d) for d in os.listdir(self.path)
+                            if d.isdigit()), reverse=True)
+                    if last is not None else [])
+        for s in cand:
+            state = self._restore_verified(s, template, verify)
+            if state is not None:
+                _metrics.counter("checkpoint.restores").inc()
+                return state, s
+        if cand:
+            if explicit and self._last_restore_exc is not None:
+                # the caller named this exact step: surface WHY it is
+                # unloadable (torn mirror, missing files) rather than a
+                # generic verification verdict
+                raise self._last_restore_exc
+            raise RuntimeError(
+                f"no checkpoint step under {self._remote or self.path} "
+                f"survived integrity verification (tried {cand})")
+        return None, None
 
     def wait(self):
         if self._mgr is not None:

@@ -32,10 +32,27 @@ CATALOG = {
         "counter", ("kernel",),
         "Tile-shape sweeps run by the Pallas autotuner (first eager "
         "contact with a kernel/shape/chip triple)."),
+    # amp.py (published host-side by the guardian's ScalerObserver bridge)
+    "amp.loss_scale": MetricSpec(
+        "gauge", (), "Current dynamic loss scale of the amp.LossScaler."),
+    "amp.skipped_steps": MetricSpec(
+        "counter", (),
+        "Optimizer updates the loss scaler skipped on non-finite "
+        "gradients (delta-published from the scaler state's cumulative "
+        "skip count)."),
     # bench.py
     "bench.step_time_s": MetricSpec(
         "histogram", (), "Per-step wall time of a timed bench window."),
     # io/checkpoint.py
+    "checkpoint.corrupt_leaves": MetricSpec(
+        "counter", (),
+        "Restored checkpoint leaves whose crc32 disagreed with the "
+        "step's integrity manifest."),
+    "checkpoint.integrity_fallbacks": MetricSpec(
+        "counter", (),
+        "Checkpoint steps abandoned at restore (corrupt or unreadable "
+        "even after a mirror re-fetch), degrading to the previous "
+        "committed step."),
     "checkpoint.mirror_degraded": MetricSpec(
         "counter", (),
         "Checkpoint mirror pushes that failed after retries and degraded "
@@ -174,18 +191,36 @@ CATALOG = {
     # static/trainer.py + observability/telemetry.py
     "trainer.channel_depth": MetricSpec(
         "gauge", (), "Ingest channel occupancy sampled at each dequeue."),
+    "trainer.ingest_errors": MetricSpec(
+        "counter", ("reason",),
+        "Ingest reader threads that died, by exception type."),
     "trainer.ingest_stall_s": MetricSpec(
         "counter", (),
         "Wall time the device loop spent blocked on the ingest channel."),
+    "trainer.loss_spikes": MetricSpec(
+        "counter", (),
+        "Loss-spike episodes latched by the training guardian (a finite "
+        "loss above spike_factor x the rolling median; counted once per "
+        "episode, watchdog-style)."),
+    "trainer.nonfinite_skips": MetricSpec(
+        "counter", (),
+        "Train steps whose update was skipped in-trace because the loss "
+        "or global update norm was non-finite (state kept bit-identical; "
+        "counted from the trailing fetch)."),
     "trainer.preempted": MetricSpec(
         "counter", (), "Preemption signals honored at a step boundary."),
+    "trainer.rollbacks": MetricSpec(
+        "counter", (),
+        "Guardian rollbacks: restore the last good checkpoint and replay "
+        "the data stream to the same cursor."),
     "trainer.step_s": MetricSpec(
         "histogram", (), "Per-step wall time seen by the Trainer."),
     # observability/watchdog.py
     "watchdog.anomalies": MetricSpec(
         "counter", ("kind",),
         "Anomalies latched by the runtime watchdog (kind: slow_step | "
-        "ingest_stall | retrace | goodput_collapse)."),
+        "ingest_stall | retrace | goodput_collapse | ingest_error | "
+        "loss_spike)."),
 }
 
 

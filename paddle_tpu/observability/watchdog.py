@@ -17,6 +17,11 @@ and the RunLog:
   goodput_collapse  serve.goodput < goodput_min once enough requests
                     retired
 
+Two further kinds are fed externally through `alert()` by components
+that detect their own conditions but want the same latch + counter +
+RunLog + mitigation-dispatch path: ingest_error (a Trainer reader thread
+died) and loss_spike (the training guardian's rolling-median detector).
+
 Latch semantics: a level-triggered kind (slow_step, ingest_stall,
 goodput_collapse) fires ONCE when the condition appears and re-arms when
 it clears, so a 500-step stall is one event, not 500. retrace is
@@ -38,7 +43,8 @@ import time
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability.catalog import help_for as _help
 
-KINDS = ("slow_step", "ingest_stall", "retrace", "goodput_collapse")
+KINDS = ("slow_step", "ingest_stall", "retrace", "goodput_collapse",
+         "ingest_error", "loss_spike")
 
 
 @dataclasses.dataclass
@@ -141,6 +147,25 @@ class Watchdog:
                     self._clear("goodput_collapse")
         for event in fired:
             self._dispatch(event)
+
+    # -- external anomalies ------------------------------------------------
+    def alert(self, kind, step, latch=True, **detail):
+        """Latch an externally-detected anomaly (ingest_error from the
+        Trainer's reader threads, loss_spike from the guardian) through
+        the same counter/RunLog/dispatch path as the built-in detectors.
+        Returns True when a new event fired (False = already latched)."""
+        fired = []
+        with self._lock:
+            self._fire(fired, str(kind), step, latch=latch, **detail)
+        for event in fired:
+            self._dispatch(event)
+        return bool(fired)
+
+    def resolve(self, kind):
+        """Re-arm a latched externally-fed anomaly kind (the guardian
+        calls this when losses return to the healthy band)."""
+        with self._lock:
+            self._clear(str(kind))
 
     # -- detectors ---------------------------------------------------------
     def _median(self):

@@ -13,6 +13,8 @@ from paddle_tpu.static.program import (
     program_from_fn,
 )
 from paddle_tpu.static.desc import OpDesc, ProgramDesc, program_desc
+from paddle_tpu.static.guardian import (GuardianConfig, TrainGuardian,
+                                        TrainingDiverged)
 from paddle_tpu.static.trainer import (PREEMPTED_EXIT_CODE, Preempted,
                                        Trainer, TrainerConfig,
                                        train_from_dataset)

@@ -1,0 +1,309 @@
+"""Training guardian — numerical fault containment for long runs.
+
+Ref: the reference framework's production trainers shipped checkpoint
+notify RPCs and pserver recovery, but a NaN loss, a poisoned batch, or a
+corrupted checkpoint silently wrecked the run — fault handling stopped
+at process death. The serving stack here already self-heals (retry
+budgets, quarantine + replay, fleet failover); this module gives the
+Trainer the same anomaly -> mitigate -> rollback machinery, built on
+the identical primitives (core/retry.py RetryBudget, the watchdog latch,
+the chaos fault points):
+
+  in-trace containment   wrap_step() gates the parameter/optimizer
+                         update on isfinite(loss) & isfinite(global
+                         update norm): a non-finite step applies NOTHING
+                         (jnp.where picks every old buffer, so state
+                         stays bit-identical) and the `applied` flag
+                         rides the step's outputs — counted host-side
+                         from the trailing fetch, zero new sync.
+  loss-spike detector    observe_step() keeps a rolling window of
+                         healthy losses; a finite loss above
+                         spike_factor x the rolling median latches a
+                         loss_spike anomaly (watchdog-style: once per
+                         episode, re-armed by a healthy step).
+  mitigation ladder      consecutive anomalous steps escalate:
+                         1 tolerate/skip -> 2 re-read the batch ->
+                         3+ roll back to the last good checkpoint and
+                         replay the data stream to the same cursor.
+                         Rollbacks are bounded by a RetryBudget
+                         (trainer_rollback_budget flag); exhaustion
+                         re-raises TrainingDiverged into the train loop,
+                         exactly like serve_step_retries exhaustion.
+
+Trailing-fetch discipline (PR-4): observe_step(step, ...) parks the
+device scalars and processes the tuple parked at step-1, which finished
+long ago — jax.device_get returns without stalling the in-flight step.
+The hot-path-sync lint analyzes this module from the Trainer.train root;
+the flush-spy test (tests/test_guardian.py) proves the discipline at
+runtime.
+
+Everything observable flows through the shared plumbing: counters
+(trainer.nonfinite_skips / loss_spikes / rollbacks), the watchdog
+(loss_spike anomalies), the RunLog ("guardian" records that
+tools/run_report.py --train-health reconstructs), and amp.ScalerObserver
+(amp.loss_scale / amp.skipped_steps from the scaler state riding the
+train state tree).
+"""
+
+import collections
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.catalog import help_for as _help
+from paddle_tpu.testing.chaos import fault_point
+
+
+class TrainingDiverged(RuntimeError):
+    """The mitigation ladder exhausted its rollback budget: the run is
+    re-diverging faster than checkpoint rollbacks can heal it."""
+
+
+@dataclasses.dataclass
+class GuardianConfig:
+    """None fields resolve from the trainer_* flags, so a run can arm
+    the guardian with env vars alone (PT_FLAGS_trainer_spike_factor=5)."""
+
+    spike_factor: float = None   # None -> flag trainer_spike_factor
+    spike_window: int = 64       # rolling-median window (healthy losses)
+    min_samples: int = 8         # median needs this many healthy losses
+    rollback_budget: int = None  # None -> flag trainer_rollback_budget
+    check_update_norm: bool = True  # gate on the global update norm too
+    # optional selector: train state -> amp.LossScaler state dict, e.g.
+    # lambda st: st["opt"]["scaler"]; enables the amp.* metrics bridge
+    scaler_state_fn: object = None
+
+    def resolve(self):
+        from paddle_tpu.core import flags as F
+        c = dataclasses.replace(self)
+        if c.spike_factor is None:
+            c.spike_factor = float(F.get_flag("trainer_spike_factor"))
+        if c.rollback_budget is None:
+            c.rollback_budget = int(F.get_flag("trainer_rollback_budget"))
+        c.spike_window = max(2, int(c.spike_window))
+        c.min_samples = max(2, int(c.min_samples))
+        return c
+
+
+class TrainGuardian:
+    """One instance per training run. The Trainer wraps its step through
+    `wrap_step`, feeds `observe_step` once per completed step, and acts
+    on the returned mitigation ("reread" / "rollback" / None)."""
+
+    def __init__(self, config=None):
+        from paddle_tpu.core.retry import RetryBudget, RetryPolicy
+        self.cfg = (config or GuardianConfig()).resolve()
+        # consecutive-rollback accountant: success() on a healthy
+        # checkpoint resets it; exhaustion re-raises TrainingDiverged
+        self._budget = RetryBudget(
+            RetryPolicy(max_attempts=self.cfg.rollback_budget + 1),
+            "trainer.rollback")
+        self._run_log = None
+        self._wd = None
+        self._scaler = None
+        self._window = collections.deque(maxlen=self.cfg.spike_window)
+        self._pending = None        # (step, loss, applied, scaler) devrefs
+        self._spike_latched = False
+        self.episode = 0            # consecutive anomalous steps
+        self.episode_start = None   # step of the episode's first anomaly
+        self.skips = 0              # non-finite skip-applies seen
+        self.spikes = 0             # loss-spike episodes latched
+        self.rollbacks = 0          # rollbacks performed
+
+    def attach(self, run_log=None, watchdog=None, registry=None):
+        """Wire the run's observability plane (the Trainer calls this
+        once telemetry/watchdog exist)."""
+        self._run_log = run_log
+        self._wd = watchdog
+        if self.cfg.scaler_state_fn is not None:
+            from paddle_tpu.amp import ScalerObserver
+            self._scaler = ScalerObserver(registry=registry)
+        return self
+
+    # -- in-trace containment ----------------------------------------------
+    def wrap_step(self, step_fn):
+        """Wrap a (state, *batch) -> (loss, new_state) step so the update
+        only applies when loss AND the global update norm are finite.
+
+        The wrapper is jitted; a user step that is itself jitted simply
+        inlines (nested jit), and the returned callable keeps the
+        _cache_size probe so Watchdog.watch_jit still sees retraces. On a
+        healthy step jnp.where(True, new, old) selects every new buffer
+        unchanged, so arming the guardian does not perturb a healthy
+        run's trajectory — the bit-exact-resume reference runs share one
+        config."""
+        check_norm = self.cfg.check_update_norm
+
+        def guarded(state, *batch):
+            loss, new_state = step_fn(state, *batch)
+            ok = jnp.isfinite(loss)
+            if check_norm:
+                sq = [jnp.sum(jnp.square((n - o).astype(jnp.float32)))
+                      for n, o in zip(jax.tree_util.tree_leaves(new_state),
+                                      jax.tree_util.tree_leaves(state))
+                      if (hasattr(n, "dtype")
+                          and jnp.issubdtype(n.dtype, jnp.inexact))]
+                if sq:
+                    ok = ok & jnp.isfinite(sum(sq))
+
+            def gate(n, o):
+                return jnp.where(ok, n, o) if hasattr(n, "dtype") else n
+
+            gated = jax.tree_util.tree_map(gate, new_state, state)
+            return loss, gated, ok
+
+        return jax.jit(guarded)
+
+    # -- per-step host logic (trailing) ------------------------------------
+    def observe_step(self, step, loss, applied, state):
+        """Park this step's device scalars and process the tuple parked
+        one step ago (trailing-fetch: those values are a full step old,
+        so the fetch cannot stall the in-flight step). Returns the
+        mitigation for the PROCESSED step: None (healthy or tolerate),
+        "reread", or "rollback"."""
+        prev = self._pending
+        scaler = (self.cfg.scaler_state_fn(state)
+                  if self.cfg.scaler_state_fn is not None else None)
+        self._pending = (int(step), loss, applied, scaler)
+        if prev is None:
+            return None
+        return self._process(*prev)
+
+    def flush_trailing(self):
+        """Drain the last parked step at end of run (its mitigation, if
+        any, is moot — there is no next step to act on)."""
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._process(*prev)
+
+    def _process(self, step, loss, applied, scaler):
+        host = jax.device_get((loss, applied))  # graft-lint: disable=hot-path-sync (trailing fetch: these scalars are >= one full step old, so device_get returns without stalling the in-flight step)
+        if scaler is not None and self._scaler is not None:
+            self._scaler.publish(jax.device_get(scaler))  # graft-lint: disable=hot-path-sync (trailing fetch: scaler state parked at the previous step is already retired)
+        return self._classify(step, float(host[0]), bool(host[1]))
+
+    def _classify(self, step, loss_v, applied_v):
+        """Pure-host anomaly triage for one processed step (unit-testable
+        without device values)."""
+        if not applied_v:
+            self.skips += 1
+            _metrics.counter("trainer.nonfinite_skips",
+                             _help("trainer.nonfinite_skips")).inc()
+            kind = "nonfinite"
+        elif self._is_spike(loss_v):
+            if not self._spike_latched:
+                self._spike_latched = True
+                self.spikes += 1
+                _metrics.counter("trainer.loss_spikes",
+                                 _help("trainer.loss_spikes")).inc()
+                if self._wd is not None:
+                    self._wd.alert("loss_spike", step, loss=loss_v,
+                                   median=self._median())
+            kind = "spike"
+        else:
+            if math.isfinite(loss_v):
+                self._window.append(loss_v)
+            if self.episode:
+                self.episode = 0
+                self.episode_start = None
+            if self._spike_latched:
+                self._spike_latched = False
+                if self._wd is not None:
+                    self._wd.resolve("loss_spike")
+            return None
+        self.episode += 1
+        if self.episode == 1:
+            self.episode_start = step
+        action = (None if self.episode == 1
+                  else "reread" if self.episode == 2 else "rollback")
+        self._log({"guardian": kind, "step": step, "loss": loss_v,
+                   "episode": self.episode, "action": action or "skip"})
+        return action
+
+    def _median(self):
+        if len(self._window) < self.cfg.min_samples:
+            return None
+        vals = sorted(self._window)
+        n = len(vals)
+        mid = n // 2
+        return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+    def _is_spike(self, loss_v):
+        if not math.isfinite(loss_v):
+            return True     # a non-finite loss whose update still applied
+        med = self._median()
+        return (med is not None and med > 0
+                and loss_v > self.cfg.spike_factor * med)
+
+    # -- mitigation ladder: rollback ---------------------------------------
+    @property
+    def rollback_bound(self):
+        """Newest checkpoint step that is safe to roll back to: strictly
+        before the episode's first anomalous step (that step's update may
+        already be poisoned — a boundary save at it would re-diverge)."""
+        if self.episode_start is None:
+            return None
+        return int(self.episode_start) - 1
+
+    def begin_rollback(self, at_step, **detail):
+        """Charge one rollback against the budget. Raises
+        TrainingDiverged through RetryBudget exhaustion semantics
+        (retry.attempts / retry.giveups {op=trainer.rollback}) when
+        budget+1 consecutive rollbacks happen without an intervening
+        healthy checkpoint."""
+        exc = TrainingDiverged(
+            f"loss diverged at step {at_step} and the rollback budget "
+            f"({self.cfg.rollback_budget}) is exhausted")
+        self._budget.failure(exc)
+        fault_point("trainer.rollback")
+        self.rollbacks += 1
+        _metrics.counter("trainer.rollbacks",
+                         _help("trainer.rollbacks")).inc()
+        self._log({"guardian": "rollback", "step": int(at_step), **detail})
+        # the episode ends here; the pre-anomaly window stays valid (the
+        # replay re-walks the same healthy trajectory), and KEEPING it is
+        # what lets a persistent divergence re-trip the detector instead
+        # of poisoning a fresh median with its own spikes
+        self.episode = 0
+        self.episode_start = None
+        self._pending = None
+
+    def note_rollback_done(self, restored_step):
+        self._log({"guardian": "rollback_done",
+                   "restored_step": int(restored_step)})
+
+    def note_checkpoint(self, step):
+        """A checkpoint landed while healthy: training has durably
+        progressed, so the consecutive-rollback streak resets."""
+        if self.healthy():
+            self._budget.success()
+
+    def healthy(self):
+        """No open anomaly episode — interval checkpoint saves are gated
+        on this so the newest checkpoint is always a good one."""
+        return self.episode == 0
+
+    # -- bit-exact resume --------------------------------------------------
+    def state_dict(self):
+        """JSON-serializable detector state carried in checkpoint meta,
+        covering every step processed before the save (the step saved AT
+        is still parked; it re-parks identically after resume)."""
+        return {"skips": self.skips, "spikes": self.spikes,
+                "rollbacks": self.rollbacks,
+                "window": [float(x) for x in self._window]}
+
+    def load_state(self, sd):
+        if not sd:
+            return
+        self.skips = int(sd.get("skips", 0))
+        self.spikes = int(sd.get("spikes", 0))
+        self.rollbacks = int(sd.get("rollbacks", 0))
+        self._window.clear()
+        self._window.extend(float(x) for x in sd.get("window", []))
+
+    def _log(self, record):
+        if self._run_log is not None:
+            self._run_log.write(record)

@@ -105,6 +105,17 @@ class TrainerConfig:
     # over it, so a step_fn jitted against the plan's shardings consumes
     # Trainer batches with no per-call placement code
     mesh_plan: object = None
+    # training guardian (static/guardian.py): True or a GuardianConfig;
+    # None/False = off. Arms in-trace non-finite containment (skip-apply
+    # keeps state bit-identical), the host-side loss-spike detector, and
+    # the skip -> re-read -> rollback mitigation ladder. Rollback requires
+    # checkpointing plus a seekable dataset (the stream is replayed to the
+    # restored cursor).
+    guardian: object = None
+    # abort the step loop as soon as an ingest reader thread dies instead
+    # of quietly training on fewer readers until drain; None honors the
+    # trainer_ingest_fail_fast flag (default on)
+    ingest_fail_fast: bool = None
 
 
 class _EndOfData:
@@ -133,9 +144,12 @@ class Trainer:
         self.history = []
         self.telemetry = None    # StepTelemetry after train() when enabled
         self.watchdog = None     # Watchdog after train() when enabled
+        self.guardian = None     # TrainGuardian after train() when enabled
+        self._guarded = None     # guardian-wrapped step_fn (jitted once)
+        self._ingest_threads = []
 
     # -- DataFeed channel (ref data_feed.cc multi-threaded file->channel) --
-    def _start_ingest(self, readers):
+    def _start_ingest(self, readers, on_error=None):
         chan = queue.Queue(maxsize=self.cfg.channel_capacity)
         counts = {"live": len(readers)}
         lock = threading.Lock()
@@ -157,8 +171,17 @@ class Trainer:
                     fault_point("trainer.ingest")
                     if not put(item):
                         return  # trainer stopped early (max_steps)
-            except BaseException as e:  # surfaced by train() at drain
+            except BaseException as e:
+                # a dead reader is never silent: counted + surfaced to the
+                # watchdog immediately, raised by train() (at once under
+                # trainer_ingest_fail_fast, else at drain)
                 errors.append(e)
+                _metrics.counter(
+                    "trainer.ingest_errors",
+                    "Ingest reader threads that died, by exception "
+                    "type.").inc(reason=type(e).__name__)
+                if on_error is not None:
+                    on_error(e)
             finally:
                 with lock:
                     counts["live"] -= 1
@@ -169,6 +192,7 @@ class Trainer:
                    for r in readers]
         for t in threads:
             t.start()
+        self._ingest_threads = threads
         return chan, stop, errors
 
     def _split_readers(self, dataset):
@@ -325,15 +349,19 @@ class Trainer:
         self.telemetry = tele
         return tele if tele.enabled else None
 
-    def _start_watchdog(self, tele):
+    def _start_watchdog(self, tele, step_callable=None):
         """Watchdog when TrainerConfig.watchdog (or the global flag) is
         set; anomaly events ride the telemetry RunLog when one exists.
-        The jitted step function is polled for steady-state retraces."""
+        The jitted step function — the guardian-wrapped one when armed,
+        since that is the jit the loop dispatches — is polled for
+        steady-state retraces."""
         from paddle_tpu.observability.watchdog import maybe_watchdog
         wd = maybe_watchdog(self.cfg.watchdog,
                             run_log=getattr(tele, "_log", None))
         if wd is not None:
-            wd.watch_jit("trainer.step", self.step_fn)
+            wd.watch_jit("trainer.step",
+                         step_callable if step_callable is not None
+                         else self.step_fn)
         self.watchdog = wd
         return wd
 
@@ -347,7 +375,28 @@ class Trainer:
         are not lost, matching the reference's shared DataFeed channel.
         Without it, readers must yield ready batches."""
         cfg = self.cfg
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.core import random as _random
         step = 0
+        guard = None
+        step_call = self.step_fn
+        if cfg.guardian:
+            from paddle_tpu.static.guardian import (GuardianConfig,
+                                                    TrainGuardian)
+            enforce(not self.sparse_tables,
+                    "TrainerConfig.guardian does not support "
+                    "sparse_tables (the sparse step's pull/push cycle "
+                    "has host-side state the skip-apply gate cannot "
+                    "contain)")
+            guard = TrainGuardian(
+                cfg.guardian if isinstance(cfg.guardian, GuardianConfig)
+                else None)
+            self.guardian = guard
+            if self._guarded is None:
+                # jitted once per Trainer; repeated train() calls (and
+                # in-run rollbacks) reuse the compiled guarded step
+                self._guarded = guard.wrap_step(self.step_fn)
+            step_call = self._guarded
         ckpt_mgr = None
         if cfg.checkpoint_dir and cfg.checkpoint_every:
             from paddle_tpu.io.checkpoint import CheckpointManager
@@ -357,6 +406,14 @@ class Trainer:
                 restored, at = ckpt_mgr.restore(state)
                 if restored is not None:
                     state, step = restored, int(at)
+                    # bit-exact resume: the step's meta sidecar carries
+                    # the global RNG key, the data cursor, and the
+                    # guardian's detector state
+                    meta = ckpt_mgr.read_meta(step)
+                    if meta:
+                        _random.set_state(meta.get("rng"))
+                        if guard is not None:
+                            guard.load_state(meta.get("guardian"))
                     # datasets that support seek(step) continue mid-stream;
                     # plain generator factories restart from the beginning
                     # (epoch semantics — the reference trainer's
@@ -366,11 +423,30 @@ class Trainer:
                     print(f"[trainer] resumed from step {step}")
         start_step = step
         preempt, restore_signals = self._install_preemption_handler()
-        chan, stop, errors = self._start_ingest(
-            self._split_readers(dataset))
-        hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
         tele = self._start_telemetry()
-        wd = self._start_watchdog(tele)
+        wd = self._start_watchdog(tele, step_call)
+        if guard is not None:
+            guard.attach(run_log=getattr(tele, "_log", None), watchdog=wd)
+
+        def on_ingest_error(e):
+            # edge-triggered: every dead reader is its own anomaly
+            if wd is not None:
+                wd.alert("ingest_error", step, latch=False,
+                         error=f"{type(e).__name__}: {e}"[:200])
+
+        chan, stop, errors = self._start_ingest(
+            self._split_readers(dataset), on_error=on_ingest_error)
+        hb_ping, hb_finish = self._start_heartbeat(num_workers, worker_id)
+        fail_fast = (cfg.ingest_fail_fast
+                     if cfg.ingest_fail_fast is not None
+                     else bool(F.get_flag("trainer_ingest_fail_fast")))
+
+        def ckpt_meta():
+            m = {"cursor": int(step), "rng": _random.get_state()}
+            if guard is not None:
+                m["guardian"] = guard.state_dict()
+            return m
+
         t0 = time.perf_counter()
         loss = None
         stall_ctr = _metrics.counter(
@@ -430,6 +506,53 @@ class Trainer:
                 buf.append(item)
             return _collate(buf)
 
+        def do_rollback():
+            # mitigation-ladder escalation: restore the newest checkpoint
+            # strictly BEFORE the anomaly episode (its update may already
+            # be poisoned) and replay the stream to the same cursor
+            nonlocal state, step, chan, stop, errors
+            enforce(ckpt_mgr is not None,
+                    "guardian rollback requires checkpointing "
+                    "(TrainerConfig.checkpoint_dir + checkpoint_every)")
+            enforce(hasattr(dataset, "seek"),
+                    "guardian rollback requires a seekable dataset "
+                    "(dataset.seek(step)) to replay the stream")
+            bound = guard.rollback_bound
+            guard.begin_rollback(step, bound=bound)  # budget; may re-raise
+            # halt the in-flight readers; the replay gets a fresh channel
+            stop.set()
+            for t in self._ingest_threads:
+                t.join(timeout=10)
+            cands = [s for s in ckpt_mgr.steps()
+                     if bound is None or s <= bound]
+            restored = at = None
+            while cands:
+                target = cands.pop()        # newest safe step first
+                try:
+                    restored, at = ckpt_mgr.restore(state, step=target)
+                    break
+                except Exception as e:
+                    print(f"[trainer] rollback: step {target} "
+                          f"unrestorable ({type(e).__name__}: {e}); "
+                          "degrading to the previous step")
+            enforce(restored is not None,
+                    "guardian rollback found no restorable checkpoint at "
+                    "or before the anomaly")
+            state, step = restored, int(at)
+            meta = ckpt_mgr.read_meta(step)
+            if meta:
+                # rewind the RNG stream with the state; the guardian's
+                # live window/counters are NOT rewound — the replay walks
+                # the same healthy trajectory the window already holds,
+                # and a persistent divergence must re-trip the detector
+                _random.set_state(meta.get("rng"))
+            guard.note_rollback_done(step)
+            dataset.seek(step)
+            chan, stop, errors = self._start_ingest(
+                self._split_readers(dataset), on_error=on_ingest_error)
+            print(f"[trainer] guardian rollback: restored step {step}, "
+                  "stream replayed")
+
         clean = False
         preempted_sig = None
         mesh_scope = contextlib.ExitStack()
@@ -443,6 +566,8 @@ class Trainer:
             while nxt is not None:
                 if cfg.max_steps is not None and step >= cfg.max_steps:
                     break
+                if fail_fast and errors:
+                    break    # a reader died: stop now, raise below
                 with span("stage"):
                     staged = stage(nxt)
                 # prefetch the following batch while this step runs
@@ -455,8 +580,11 @@ class Trainer:
 
                 with span("step"):
                     fault_point("trainer.step")
+                    applied = None
                     if self.sparse_tables:
                         state, loss = self._sparse_step(state, staged)
+                    elif guard is not None:
+                        loss, state, applied = step_call(state, *staged)
                     else:
                         loss, state = self.step_fn(state, *staged)
                 step += 1
@@ -472,20 +600,48 @@ class Trainer:
                             stall_s=stall_acc["t"])
                     stall_acc["t"] = 0.0
                 it_t = now
+                if guard is not None:
+                    # parks this step's device scalars, processes the
+                    # previous step's (trailing — no sync on the step
+                    # just dispatched), returns its mitigation
+                    act = guard.observe_step(step, loss, applied, state)
+                    if act == "reread":
+                        # drop the suspect batch at the cursor, take the
+                        # following one instead
+                        with span("ingest"):
+                            fresh = next_batch()
+                            if cfg.prefetch:
+                                nxt = fresh
+                            elif fresh is None:
+                                nxt = None  # stream ended under the drop
+                    elif act == "rollback":
+                        do_rollback()
+                        with span("ingest"):
+                            nxt = next_batch()
+                        it_t = time.perf_counter()
+                        continue
                 hb_ping()
                 if preempt["signum"] is not None:
                     # step boundary after a preemption notice: flush a
                     # final checkpoint (interval gate bypassed) and stop —
                     # the supervisor resumes at exactly this step
                     if ckpt_mgr is not None:
-                        ckpt_mgr.save(step, state, force=True)
+                        ckpt_mgr.save(step, state, force=True,
+                                      meta=ckpt_meta())
                     preempted_sig = preempt["signum"]
                     _metrics.counter("trainer.preempted").inc()
                     print(f"[trainer] preemption signal {preempted_sig}: "
                           f"checkpointed step {step}, exiting for resume")
                     break
-                if ckpt_mgr is not None:
-                    ckpt_mgr.save(step, state)  # manager gates the interval
+                if ckpt_mgr is not None and (guard is None
+                                             or guard.healthy()):
+                    # interval saves are skipped while an anomaly episode
+                    # is open, so the newest checkpoint is always a good
+                    # rollback target; a healthy save resets the
+                    # consecutive-rollback budget
+                    if ckpt_mgr.save(step, state,  # gates the interval
+                                     meta=ckpt_meta()) and guard is not None:
+                        guard.note_checkpoint(step)
                 if cfg.log_every and step % cfg.log_every == 0:
                     lv = float(loss)
                     self.history.append((step, lv))
@@ -503,9 +659,16 @@ class Trainer:
             hb_finish(clean)
             if ckpt_mgr is not None:
                 ckpt_mgr.close()
+            if guard is not None:
+                guard.flush_trailing()
             if tele is not None:
-                tele.finish({"steps": step, "preempted":
-                             preempted_sig is not None})
+                extra = {"steps": step, "preempted":
+                         preempted_sig is not None}
+                if guard is not None:
+                    extra.update(nonfinite_skips=guard.skips,
+                                 loss_spikes=guard.spikes,
+                                 rollbacks=guard.rollbacks)
+                tele.finish(extra)
         if preempted_sig is not None:
             raise Preempted(step, preempted_sig)
         run_steps = step - start_step

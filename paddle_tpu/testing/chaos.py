@@ -240,6 +240,8 @@ class DirFS:
 FAULT_POINTS = {
     "checkpoint.fetch": "restore-side remote read of a checkpoint step",
     "checkpoint.mirror": "remote mirror push of a committed checkpoint",
+    "checkpoint.verify": "restore-side crc32 integrity check of a "
+                         "checkpoint step against its manifest",
     "fleet.dispatch": "fleet router handing a request to a replica",
     "fleet.heartbeat": "fleet router per-replica liveness ping",
     "fleet.respawn": "fleet router respawning a dead replica",
@@ -249,6 +251,8 @@ FAULT_POINTS = {
                           "degrades the match to private pages)",
     "serve.step": "the jitted continuous-batching decode step",
     "trainer.ingest": "ingest-channel dequeue feeding the train step",
+    "trainer.rollback": "guardian rollback restoring the last good "
+                        "checkpoint after mitigation-ladder escalation",
     "trainer.step": "the jitted train step dispatch",
 }
 

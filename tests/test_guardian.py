@@ -1,0 +1,512 @@
+"""Training guardian: in-trace non-finite containment, the loss-spike
+mitigation ladder, checkpoint integrity verification, and verified
+bit-exact resume (static/guardian.py + io/checkpoint.py + amp.py)."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import flags as F
+from paddle_tpu.io import checkpoint as ckpt_mod
+from paddle_tpu.io.checkpoint import CheckpointManager, crc_manifest
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.telemetry import TelemetryConfig
+from paddle_tpu.static import (GuardianConfig, Trainer, TrainerConfig,
+                               TrainingDiverged)
+from paddle_tpu.static.guardian import TrainGuardian
+
+
+@pytest.fixture
+def fast_retries():
+    saved = F.all_flags()
+    F.set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+    yield
+    F.set_flags(saved)
+
+
+def _csum(name):
+    return sum(_metrics.counter(name).snapshot().values())
+
+
+def _linreg_step(lr=0.05):
+    def step(state, x, y):
+        pred = state["w"] * x + state["b"]
+        loss = jnp.mean((pred - y) ** 2)
+        gw = jnp.mean(2.0 * (pred - y) * x)
+        gb = jnp.mean(2.0 * (pred - y))
+        return loss, {"w": state["w"] - lr * gw, "b": state["b"] - lr * gb}
+    return step
+
+
+def _batch(i, poison=None):
+    rng = np.random.RandomState(1000 + i)
+    x = rng.randn(8).astype(np.float32)
+    y = (3.0 * x).astype(np.float32)
+    if poison == "nan":
+        x = np.full_like(x, np.nan)
+    elif poison == "spike":
+        x, y = x * 1e4, y * 1e4
+    return x, y
+
+
+class _SeekableDS:
+    """Index-keyed deterministic stream; `faults` maps index -> poison
+    kind (persistent, unlike the drill's one-shot markers)."""
+
+    def __init__(self, n, faults=None):
+        self.n = n
+        self.pos = 0
+        self.faults = dict(faults or {})
+
+    def seek(self, step):
+        self.pos = int(step)
+
+    def reader(self):
+        def feed():
+            i = self.pos
+            while i < self.n:
+                yield _batch(i, self.faults.get(i))
+                i += 1
+        return feed
+
+
+def _state0():
+    return {"w": jnp.zeros(()), "b": jnp.zeros(())}
+
+
+# -- in-trace containment --------------------------------------------------
+
+class TestWrapStep:
+    def test_nonfinite_skip_is_bit_identical(self):
+        guard = TrainGuardian(GuardianConfig())
+        guarded = guard.wrap_step(jax.jit(_linreg_step()))
+        st0 = {"w": jnp.float32(0.3), "b": jnp.float32(-0.1)}
+        loss, st1, ok = guarded(st0, *_batch(0, "nan"))
+        assert not bool(ok)
+        for k in ("w", "b"):
+            assert (np.asarray(st1[k]).tobytes()
+                    == np.asarray(st0[k]).tobytes())
+
+        loss, st2, ok = guarded(st0, *_batch(0))
+        assert bool(ok) and math.isfinite(float(loss))
+        assert float(st2["w"]) != float(st0["w"])   # healthy step applies
+
+    def test_healthy_step_unperturbed_by_wrapping(self):
+        # jnp.where(True, new, old) must select the new buffers bit-for-
+        # bit, so arming the guardian can't fork a healthy trajectory
+        step = _linreg_step()
+        guard = TrainGuardian(GuardianConfig())
+        guarded = guard.wrap_step(step)
+        st = _state0()
+        ref_loss, ref_st = jax.jit(step)(st, *_batch(3))
+        loss, got_st, ok = guarded(st, *_batch(3))
+        assert bool(ok)
+        assert np.asarray(loss).tobytes() == np.asarray(ref_loss).tobytes()
+        for k in ("w", "b"):
+            assert (np.asarray(got_st[k]).tobytes()
+                    == np.asarray(ref_st[k]).tobytes())
+
+    def test_gates_on_update_norm(self):
+        # finite loss, non-finite update: the norm check must refuse it
+        def bad_step(state, x, y):
+            return jnp.float32(1.0), {"w": state["w"] + jnp.inf,
+                                      "b": state["b"]}
+        guard = TrainGuardian(GuardianConfig())
+        loss, st, ok = guard.wrap_step(bad_step)(_state0(), *_batch(0))
+        assert not bool(ok)
+        assert float(st["w"]) == 0.0
+
+
+# -- host-side triage ------------------------------------------------------
+
+class TestClassify:
+    def _guard(self, **kw):
+        kw.setdefault("min_samples", 4)
+        kw.setdefault("spike_factor", 10.0)
+        return TrainGuardian(GuardianConfig(**kw))
+
+    def test_ladder_escalates_and_relatches(self):
+        g = self._guard()
+        for i in range(6):
+            assert g._classify(i + 1, 1.0 + 0.01 * i, True) is None
+        assert g._classify(7, 500.0, True) is None       # tolerate
+        assert g._classify(8, 500.0, True) == "reread"
+        assert g._classify(9, 500.0, True) == "rollback"
+        assert g.spikes == 1                             # latched once
+        assert g.rollback_bound == 6                     # first anomaly - 1
+        assert g._classify(10, 1.0, True) is None        # healthy resets
+        assert g.healthy() and g.episode == 0
+        assert g._classify(11, 500.0, True) is None      # re-latched
+        assert g.spikes == 2
+
+    def test_spike_needs_min_samples(self):
+        g = self._guard()
+        assert g._classify(1, 1.0, True) is None
+        assert g._classify(2, 500.0, True) is None       # median not ready
+        assert g.spikes == 0 and g.episode == 0
+
+    def test_nonfinite_skip_counts_even_without_median(self):
+        g = self._guard()
+        before = _csum("trainer.nonfinite_skips")
+        assert g._classify(1, float("nan"), False) is None
+        assert g.skips == 1
+        assert _csum("trainer.nonfinite_skips") == before + 1
+
+    def test_state_dict_roundtrip(self):
+        g = self._guard()
+        for i in range(5):
+            g._classify(i + 1, 1.0, True)
+        g.skips, g.spikes, g.rollbacks = 2, 1, 1
+        g2 = self._guard()
+        g2.load_state(g.state_dict())
+        assert (g2.skips, g2.spikes, g2.rollbacks) == (2, 1, 1)
+        assert list(g2._window) == list(g._window)
+
+
+def test_trainer_nonfinite_skip_end_to_end():
+    ds = _SeekableDS(10, faults={4: "nan"})
+    cfg = TrainerConfig(num_ingest_threads=1, prefetch=False, max_steps=10,
+                        guardian=True)
+    tr = Trainer(_linreg_step(), cfg)
+    state, stats = tr.train(_state0(), ds)
+    assert stats["steps"] == 10
+    assert tr.guardian.skips == 1
+    assert math.isfinite(float(state["w"]))
+
+
+def test_rollback_budget_exhaustion_raises(tmp_path, fast_retries):
+    # every batch from index 4 on is poisoned: each rollback replays
+    # straight back into the divergence with no healthy checkpoint in
+    # between, so the budget must exhaust into TrainingDiverged
+    ds = _SeekableDS(100, faults={i: "spike" for i in range(4, 100)})
+    cfg = TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=50,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        guardian=GuardianConfig(min_samples=4, rollback_budget=1))
+    tr = Trainer(_linreg_step(), cfg)
+    with pytest.raises(TrainingDiverged, match="rollback budget"):
+        tr.train(_state0(), ds)
+    assert tr.guardian.rollbacks == 1      # the budgeted one happened
+    # the replayed divergence is the SAME latched episode, not a new one
+    assert tr.guardian.spikes == 1
+
+
+def test_rollback_requires_seekable_dataset(tmp_path, fast_retries):
+    def unseekable():
+        for i in range(100):
+            yield _batch(i, "spike" if i >= 4 else None)
+    cfg = TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=50,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+        guardian=GuardianConfig(min_samples=4))
+    with pytest.raises(Exception, match="seekable"):
+        Trainer(_linreg_step(), cfg).train(_state0(), lambda: unseekable())
+
+
+# -- checkpoint integrity --------------------------------------------------
+
+class TestCheckpointIntegrity:
+    def test_manifest_and_meta_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "ck"), save_interval_steps=2)
+        state = {"w": jnp.arange(4.0), "b": jnp.float32(1.5)}
+        assert mgr.save(2, state, meta={"cursor": 2, "rng": [1, 2]})
+        assert not mgr.save(3, state)              # interval gate
+        assert mgr.read_meta(2) == {"cursor": 2, "rng": [1, 2]}
+        assert mgr.read_meta(99) == {}
+        assert mgr.steps() == [2]
+        restored, at = mgr.restore(state)
+        assert at == 2
+        assert crc_manifest(restored) == crc_manifest(state)
+        mgr.close()
+
+    def test_corrupt_leaf_degrades_to_previous_step(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setattr(ckpt_mod, "_HAS_ORBAX", False)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        s1 = {"w": jnp.full((4,), 1.0), "b": jnp.float32(1.0)}
+        s2 = {"w": jnp.full((4,), 2.0), "b": jnp.float32(2.0)}
+        mgr.save(1, s1)
+        mgr.save(2, s2)
+        # silent bit rot: valid npz, plausible values, wrong bytes
+        p = tmp_path / "ck" / "2" / "state.npz"
+        data = dict(np.load(p))
+        data["0"] = data["0"] + np.float32(0.5)
+        np.savez(p, **data)
+
+        before = (_csum("checkpoint.corrupt_leaves"),
+                  _csum("checkpoint.integrity_fallbacks"))
+        restored, at = mgr.restore(s1)
+        assert at == 1
+        assert float(restored["b"]) == 1.0
+        assert _csum("checkpoint.corrupt_leaves") - before[0] >= 1
+        assert _csum("checkpoint.integrity_fallbacks") - before[1] == 1
+
+    def test_verify_off_loads_the_corrupt_step(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(ckpt_mod, "_HAS_ORBAX", False)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        s2 = {"w": jnp.full((4,), 2.0)}
+        mgr.save(2, s2)
+        p = tmp_path / "ck" / "2" / "state.npz"
+        data = dict(np.load(p))
+        data["0"] = data["0"] + np.float32(0.5)
+        np.savez(p, **data)
+        restored, at = mgr.restore(s2, verify=False)
+        assert at == 2 and float(restored["w"][0]) == 2.5
+
+    def test_every_candidate_corrupt_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(ckpt_mod, "_HAS_ORBAX", False)
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        s = {"w": jnp.full((4,), 1.0)}
+        mgr.save(1, s)
+        p = tmp_path / "ck" / "1" / "state.npz"
+        data = dict(np.load(p))
+        data["0"] = data["0"] * np.float32(3.0)
+        np.savez(p, **data)
+        with pytest.raises(RuntimeError, match="integrity"):
+            mgr.restore(s)
+
+
+# -- bit-exact resume ------------------------------------------------------
+
+def _telemetry_cfg():
+    return TelemetryConfig(enabled=True, every_n_steps=1)
+
+
+def _step_losses(tele):
+    return {r["step"]: r["loss"] for r in tele.records
+            if "step" in r and not r.get("final")}
+
+
+def test_bit_exact_resume(tmp_path):
+    """Kill-free form of the drill's phase 2: run 5 steps + resume to 10
+    must reproduce the undisturbed 10-step run's losses exactly."""
+    ref_tr = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=10,
+        guardian=True, telemetry=_telemetry_cfg()))
+    ref_tr.train(_state0(), _SeekableDS(50))
+    ref = _step_losses(ref_tr.telemetry)
+    assert sorted(ref) == list(range(1, 11))
+
+    ck = str(tmp_path / "ck")
+    tr1 = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=5,
+        checkpoint_dir=ck, checkpoint_every=5, guardian=True,
+        telemetry=_telemetry_cfg()))
+    tr1.train(_state0(), _SeekableDS(50))
+
+    tr2 = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=10,
+        checkpoint_dir=ck, checkpoint_every=5, guardian=True,
+        telemetry=_telemetry_cfg()))
+    _, stats = tr2.train(_state0(), _SeekableDS(50))
+    assert stats["run_steps"] == 5                  # resumed at 5
+    got = _step_losses(tr2.telemetry)
+    assert sorted(got) == list(range(6, 11))
+    for s, v in got.items():
+        assert v == ref[s], (s, v, ref[s])          # bitwise: json-exact
+    first = _step_losses(tr1.telemetry)
+    for s, v in first.items():
+        assert v == ref[s], (s, v, ref[s])
+
+
+def test_rng_state_rides_checkpoint_meta(tmp_path):
+    from paddle_tpu.core import random as _random
+    _random.seed(1234)
+    saved = _random.get_state()
+    assert saved is not None
+
+    ck = str(tmp_path / "ck")
+    tr = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=4,
+        checkpoint_dir=ck, checkpoint_every=4, guardian=True))
+    tr.train(_state0(), _SeekableDS(10))
+
+    # a different process (or a later experiment) has a different key...
+    _random.seed(999)
+    assert _random.get_state() != saved
+    # ...resume rewinds it to the key saved with the step
+    tr2 = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=6,
+        checkpoint_dir=ck, checkpoint_every=4, guardian=True))
+    tr2.train(_state0(), _SeekableDS(10))
+    assert _random.get_state() == saved
+
+    mgr = CheckpointManager(ck, save_interval_steps=4)
+    meta = mgr.read_meta(4)
+    assert meta.get("rng") == saved
+    assert meta.get("cursor") == 4
+    assert "guardian" in meta
+    mgr.close()
+
+
+# -- ingest fail-fast ------------------------------------------------------
+
+class _SplitReaders:
+    """One reader dies after a single item; the other supplies plenty."""
+
+    def __init__(self, good_items=60):
+        self.good_items = good_items
+
+    def readers(self, n):
+        def bad():
+            yield _batch(0)
+            raise ValueError("reader exploded")
+
+        def good():
+            for i in range(self.good_items):
+                yield _batch(i)
+        return [bad, good]
+
+
+def test_ingest_fail_fast_aborts_promptly():
+    steps_run = []
+
+    def step(state, x, y):
+        steps_run.append(1)
+        return jnp.mean(x * 0.0), state
+
+    before = sum(_metrics.counter("trainer.ingest_errors")
+                 .snapshot().values())
+    wd_before = sum(_metrics.counter("watchdog.anomalies")
+                    .snapshot().values())
+    cfg = TrainerConfig(num_ingest_threads=2, prefetch=False,
+                        ingest_fail_fast=True, watchdog=True)
+    with pytest.raises(RuntimeError, match="ingestion thread failed"):
+        Trainer(step, cfg).train(_state0(), _SplitReaders())
+    assert len(steps_run) < 30       # aborted, didn't drain 61 items
+    errs = _metrics.counter("trainer.ingest_errors").snapshot()
+    assert sum(errs.values()) == before + 1
+    assert any("ValueError" in k for k in errs)
+    wd = _metrics.counter("watchdog.anomalies").snapshot()
+    assert sum(v for k, v in wd.items() if "ingest_error" in k) >= 1
+    assert sum(wd.values()) > wd_before
+
+
+def test_ingest_fail_fast_off_drains_survivors():
+    steps_run = []
+
+    def step(state, x, y):
+        steps_run.append(1)
+        return jnp.mean(x * 0.0), state
+
+    cfg = TrainerConfig(num_ingest_threads=2, prefetch=False,
+                        ingest_fail_fast=False)
+    with pytest.raises(RuntimeError, match="ingestion thread failed"):
+        Trainer(step, cfg).train(_state0(), _SplitReaders(good_items=40))
+    assert len(steps_run) == 41      # every surviving item trained on
+
+
+# -- hot-path discipline ---------------------------------------------------
+
+def test_guardian_fetches_are_trailing(monkeypatch):
+    """Flush-spy: no block_until_ready anywhere, and every guardian
+    device_get happens for a step strictly older than the one just
+    dispatched."""
+    def no_sync(*a, **kw):
+        raise AssertionError("block_until_ready on the guardian hot path")
+    monkeypatch.setattr(jax, "block_until_ready", no_sync)
+
+    processed = []
+    orig = TrainGuardian._process
+
+    def spy(self, step, loss, applied, scaler):
+        current = self._pending[0] if self._pending else None
+        processed.append((step, current))
+        return orig(self, step, loss, applied, scaler)
+
+    monkeypatch.setattr(TrainGuardian, "_process", spy)
+
+    tr = Trainer(_linreg_step(), TrainerConfig(
+        num_ingest_threads=1, prefetch=False, max_steps=6, guardian=True))
+    tr.train(_state0(), _SeekableDS(10))
+    mid_run = [(p, c) for p, c in processed if c is not None]
+    assert mid_run, "no trailing processing observed"
+    for fetched, parked in mid_run:
+        assert fetched < parked      # fetch is >= one full step behind
+    assert processed[-1][1] is None  # flush_trailing drained the last one
+
+
+# -- amp bridge ------------------------------------------------------------
+
+class TestScalerObserver:
+    def test_skipped_leaf_counts_overflows(self):
+        from paddle_tpu.amp import LossScaler
+        sc = LossScaler()
+        st = sc.init()
+        st = jax.jit(sc.update)(st, jnp.bool_(False))
+        st = jax.jit(sc.update)(st, jnp.bool_(True))
+        st = jax.jit(sc.update)(st, jnp.bool_(False))
+        assert int(st["skipped"]) == 2
+        # static scaling keeps the accounting
+        stat = LossScaler(dynamic=False)
+        st2 = stat.update(stat.init(), jnp.bool_(False))
+        assert int(st2["skipped"]) == 1
+        # pre-leaf states (old checkpoints) adopt the default
+        legacy = {k: v for k, v in sc.init().items() if k != "skipped"}
+        st3 = sc.update(legacy, jnp.bool_(False))
+        assert int(st3["skipped"]) == 1
+
+    def test_observer_publishes_deltas_monotonically(self):
+        from paddle_tpu.amp import ScalerObserver
+        from paddle_tpu.observability.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        obs = ScalerObserver(registry=reg)
+        obs.publish({"scale": 1024.0, "skipped": 5})   # resumed: adopt
+        assert reg.gauge("amp.loss_scale").snapshot()[""] == 1024.0
+        assert not reg.counter("amp.skipped_steps").snapshot()
+        obs.publish({"scale": 512.0, "skipped": 7})
+        assert reg.gauge("amp.loss_scale").snapshot()[""] == 512.0
+        assert sum(reg.counter("amp.skipped_steps")
+                   .snapshot().values()) == 2
+        obs.publish({"scale": 512.0, "skipped": 3})    # rollback rewound
+        assert sum(reg.counter("amp.skipped_steps")
+                   .snapshot().values()) == 2          # monotonic
+
+    def test_guardian_bridges_scaler_state(self):
+        # scaler state riding the train state reaches the metrics plane
+        # through the trailing fetch
+        from paddle_tpu.observability.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        guard = TrainGuardian(GuardianConfig(
+            scaler_state_fn=lambda st: st["scaler"]))
+        guard.attach(registry=None)
+        guard._scaler._reg = reg       # isolate from the global registry
+
+        def step(state, x, y):
+            loss = jnp.mean((state["w"] * x - y) ** 2)
+            return loss, {"w": state["w"] - 0.05 * jnp.mean(
+                2.0 * (state["w"] * x - y) * x),
+                "scaler": {"scale": state["scaler"]["scale"],
+                           "skipped": state["scaler"]["skipped"] + 1}}
+        guarded = guard.wrap_step(step)
+        st = {"w": jnp.zeros(()),
+              "scaler": {"scale": jnp.float32(2048.0),
+                         "skipped": jnp.zeros((), jnp.int32)}}
+        for i in range(4):
+            loss, st, ok = guarded(st, *_batch(i))
+            guard.observe_step(i + 1, loss, ok, st)
+        guard.flush_trailing()
+        assert reg.gauge("amp.loss_scale").snapshot()[""] == 2048.0
+        # first sight adopted skipped=1; three more steps counted 3
+        assert sum(reg.counter("amp.skipped_steps")
+                   .snapshot().values()) == 3
+
+
+# -- the full drill (slow) -------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_guardian_chaos_drill(tmp_path, fast_retries):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import chaos_drill
+    summary = chaos_drill.run_train_drill(str(tmp_path / "drill"))
+    assert summary["containment"]["rollbacks"] == 1
+    assert summary["containment"]["integrity_fallbacks"] == 1
+    assert summary["resume"]["restarts"] == [1]

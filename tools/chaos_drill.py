@@ -36,14 +36,27 @@ token-exact greedy completions through the failover replay,
 `fleet.failovers` == injected kills (the stall recovers, it does not
 fail over), and every replica inside its respawn RetryBudget.
 
+Guardian drill (--train): training-side numerical resilience, two
+phases. Containment (in-process): a 16-step run eats a NaN batch
+(skip-apply leaves state bit-identical), then a mis-scaled spike batch
+whose applied update wrecks the weights — the guardian ladder escalates
+tolerate -> re-read -> rollback, the rollback finds its newest safe
+checkpoint silently corrupted (crc32 manifest catches it, restore
+degrades to the previous step), and the run still finishes converged.
+Bit-exact resume (subprocess): an ElasticRunner-supervised worker is
+SIGKILLed from its reader thread mid-run; the respawn resumes from the
+checkpoint + meta and every per-step loss either generation recorded is
+bit-identical to an undisturbed reference run.
+
 Usage:
     python tools/chaos_drill.py [--steps 8] [--workdir DIR]
     python tools/chaos_drill.py --serve
     python tools/chaos_drill.py --fleet
+    python tools/chaos_drill.py --train
 
 Also exercised as tests (tests/test_chaos.py slow-marked train drill;
 tests/test_serve_resilience.py serve drill; tests/test_fleet_router.py
-fleet drill).
+fleet drill; tests/test_guardian.py slow-marked guardian drill).
 """
 
 import argparse
@@ -100,6 +113,314 @@ with open({out!r}, 'a') as f:
             % (gen, stats['steps'], stats['run_steps']))
 print('[drill worker] generation', gen, 'finished', stats)
 """
+
+
+# -- guardian train drill (--train) ----------------------------------------
+
+_TRAIN_WORKER = """\
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.core import flags as F
+from paddle_tpu.io import checkpoint as ckpt_mod
+ckpt_mod._HAS_ORBAX = False   # synchronous numpy saves: durable under kill -9
+from paddle_tpu.observability.telemetry import TelemetryConfig
+from paddle_tpu.static import GuardianConfig, Trainer, TrainerConfig
+
+gen = int(os.environ['PT_ELASTIC_GENERATION'])
+F.set_flags({{'retry_backoff_base_s': 0.001, 'retry_jitter': 0.0}})
+max_steps = {steps}
+
+def batch(i):
+    rng = np.random.RandomState(1000 + i)
+    x = rng.randn(8).astype(np.float32)
+    return (x, (3.0 * x).astype(np.float32))
+
+class DS:
+    def __init__(self):
+        self.pos = 0
+    def seek(self, step):
+        self.pos = int(step)
+    def reader(self):
+        def feed():
+            i = self.pos
+            while i < 1000:
+                if gen == 0 and i == {kill_index}:
+                    # the kill must come from host code that still runs
+                    # per batch — the READER thread; python inside the
+                    # jitted step only executes at trace time. The pause
+                    # lets the buffered steps retire and their interval
+                    # checkpoint land before the lights go out.
+                    time.sleep(1.0)
+                    os.kill(os.getpid(), signal.SIGKILL)
+                yield batch(i)
+                i += 1
+        return feed
+
+def step(state, x, y):
+    pred = state['w'] * x + state['b']
+    loss = jnp.mean((pred - y) ** 2)
+    gw = jnp.mean(2.0 * (pred - y) * x)
+    gb = jnp.mean(2.0 * (pred - y))
+    return loss, {{'w': state['w'] - 0.05 * gw,
+                  'b': state['b'] - 0.05 * gb}}
+
+cfg = TrainerConfig(
+    num_ingest_threads=1, prefetch=False, channel_capacity=2,
+    max_steps=max_steps, checkpoint_dir={ck!r}, checkpoint_every=2,
+    guardian=GuardianConfig(min_samples=4),
+    telemetry=TelemetryConfig(enabled=True, every_n_steps=1,
+                              run_log={runlog!r}.format(gen=gen)))
+state, stats = Trainer(step, cfg).train(
+    {{'w': jnp.zeros(()), 'b': jnp.zeros(())}}, DS())
+assert stats['steps'] == max_steps, stats
+print('[train drill worker] generation', gen, 'finished', stats)
+"""
+
+
+def _train_batch(i, poison=None):
+    """Deterministic linear-regression batch keyed by stream index: the
+    drill's seekable dataset re-derives the exact same bytes on replay."""
+    import numpy as np
+    rng = np.random.RandomState(1000 + i)
+    x = rng.randn(8).astype(np.float32)
+    y = (3.0 * x).astype(np.float32)
+    if poison == "nan":
+        x = np.full_like(x, np.nan)
+    elif poison == "spike":
+        x, y = x * 1e4, y * 1e4   # mis-scaled batch: finite, wrecks w
+    return x, y
+
+
+class _DrillDataset:
+    """Seekable index-keyed stream with ONE-SHOT fault injections: each
+    poisoned index and side-effect hook fires once (marker files), so the
+    replay after a guardian rollback reads clean data — exactly a
+    transient bad-batch incident."""
+
+    def __init__(self, n, marker_dir, faults=None, hooks=None):
+        self.n = n
+        self.pos = 0
+        self.marker_dir = marker_dir
+        self.faults = dict(faults or {})   # index -> "nan" | "spike"
+        self.hooks = dict(hooks or {})     # index -> callable (fired once)
+
+    def seek(self, step):
+        self.pos = int(step)
+
+    def _first_time(self, tag):
+        path = os.path.join(self.marker_dir, tag)
+        if os.path.exists(path):
+            return False
+        open(path, "w").close()
+        return True
+
+    def reader(self):
+        def feed():
+            i = self.pos
+            while i < self.n:
+                hook = self.hooks.get(i)
+                if hook is not None and self._first_time(f"hook{i}"):
+                    hook()
+                poison = self.faults.get(i)
+                if poison is not None and not self._first_time(f"fault{i}"):
+                    poison = None
+                yield _train_batch(i, poison)
+                i += 1
+        return feed
+
+
+def run_train_drill(workdir, timeout=600):
+    """Guardian end-to-end drill under `workdir`; returns a summary dict
+    (raises on any verification failure). Two phases:
+
+    containment (in-process): a 16-step run eats a NaN batch (skip-apply
+    keeps state bit-identical), then a mis-scaled spike batch whose
+    applied update wrecks the weights — the ladder escalates tolerate ->
+    re-read -> rollback; the newest safe checkpoint has meanwhile been
+    silently corrupted, so the verified restore counts the bad leaves and
+    degrades to the previous step. The run still finishes all 16 steps
+    with a converged loss, and the RunLog renders through
+    run_report.py --train-health.
+
+    bit-exact resume (subprocess): an ElasticRunner-supervised worker is
+    SIGKILLed mid-run from its reader thread; the respawned generation
+    resumes from the checkpoint (+ RNG/guardian meta) and every per-step
+    loss either generation recorded is bit-identical to an undisturbed
+    in-process reference run."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core import flags as F
+    from paddle_tpu.io import checkpoint as ckpt_mod
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.observability.runlog import read_records
+    from paddle_tpu.observability.telemetry import TelemetryConfig
+    from paddle_tpu.parallel.elastic import ElasticRunner
+    from paddle_tpu.static import GuardianConfig, Trainer, TrainerConfig
+
+    workdir = os.path.abspath(workdir)
+    os.makedirs(workdir, exist_ok=True)
+
+    def csum(name):
+        return sum(_metrics.counter(name).snapshot().values())
+
+    def train_step(state, x, y):
+        pred = state["w"] * x + state["b"]
+        loss = jnp.mean((pred - y) ** 2)
+        gw = jnp.mean(2.0 * (pred - y) * x)
+        gb = jnp.mean(2.0 * (pred - y))
+        return loss, {"w": state["w"] - 0.05 * gw,
+                      "b": state["b"] - 0.05 * gb}
+
+    saved_flags = F.all_flags()
+    had_orbax = ckpt_mod._HAS_ORBAX
+    try:
+        F.set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+        # numpy checkpoint mode: saves are synchronous files the drill can
+        # corrupt deterministically (and kill -9 can't catch half-async)
+        ckpt_mod._HAS_ORBAX = False
+
+        # -- phase 1: containment (NaN skip -> spike ladder -> rollback
+        # through a corrupted checkpoint) --------------------------------
+        ckdir = os.path.join(workdir, "ck_containment")
+        markers = os.path.join(workdir, "markers")
+        os.makedirs(markers, exist_ok=True)
+        run_log = os.path.join(workdir, "train_drill.jsonl")
+
+        def corrupt_step8():
+            # silent bit rot on the newest safe rollback target: valid
+            # npz, plausible values, wrong bytes — only the crc32
+            # manifest can tell
+            p = os.path.join(ckdir, "8", "state.npz")
+            data = dict(np.load(p))
+            key = sorted(data)[0]
+            data[key] = data[key] + np.float32(1.0)
+            np.savez(p, **data)
+
+        ds = _DrillDataset(
+            40, markers,
+            faults={4: "nan",     # consumed at step 5: skip-apply
+                    9: "spike"},  # consumed at step 10: applied, wrecks w
+            # fires once the reader reaches index 11 — after step 8's
+            # interval save landed, before the ladder's rollback restores
+            hooks={11: corrupt_step8})
+        before = {n: csum(n) for n in
+                  ("checkpoint.corrupt_leaves",
+                   "checkpoint.integrity_fallbacks")}
+        cfg = TrainerConfig(
+            num_ingest_threads=1, prefetch=False, channel_capacity=2,
+            max_steps=16, checkpoint_dir=ckdir, checkpoint_every=2,
+            guardian=GuardianConfig(min_samples=4), watchdog=True,
+            telemetry=TelemetryConfig(enabled=True, every_n_steps=1,
+                                      run_log=run_log))
+        tr = Trainer(train_step, cfg)
+        state, stats = tr.train({"w": jnp.zeros(()), "b": jnp.zeros(())},
+                                ds)
+        guard = tr.guardian
+        assert stats["steps"] == 16, stats
+        assert guard.skips == 1, f"nonfinite skips: {guard.skips}"
+        assert guard.spikes == 1, f"spike episodes: {guard.spikes}"
+        assert guard.rollbacks == 1, f"rollbacks: {guard.rollbacks}"
+        corrupt = (csum("checkpoint.corrupt_leaves")
+                   - before["checkpoint.corrupt_leaves"])
+        fallbacks = (csum("checkpoint.integrity_fallbacks")
+                     - before["checkpoint.integrity_fallbacks"])
+        assert corrupt >= 1, f"corrupt leaves: {corrupt}"
+        assert fallbacks == 1, f"integrity fallbacks: {fallbacks}"
+        assert math.isfinite(stats["final_loss"]), stats
+        assert stats["final_loss"] < 5.0, (
+            f"run did not re-converge after rollback: {stats}")
+
+        records = read_records(run_log)
+        g_recs = [r for r in records if "guardian" in r]
+        assert any(r.get("action") == "rollback" for r in g_recs), g_recs
+        assert any(r.get("anomaly") == "loss_spike" for r in records), (
+            "no loss_spike watchdog anomaly in the RunLog")
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from run_report import render_train_health
+        health = render_train_health(records)
+        assert "rollback" in health and "integrity fallbacks" in health
+
+        # -- phase 2: kill -9 + bit-exact resume --------------------------
+        ck2 = os.path.join(workdir, "ck_resume")
+        runlog_pat = os.path.join(workdir, "resume_g{gen}.jsonl")
+        script = os.path.join(workdir, "train_drill_worker.py")
+        resume_steps = 12
+        with open(script, "w") as f:
+            f.write(_TRAIN_WORKER.format(repo=REPO, steps=resume_steps,
+                                         kill_index=10, ck=ck2,
+                                         runlog=runlog_pat))
+        runner = ElasticRunner(1, script, max_restarts=2,
+                               restart_delay_s=0.1, crash_window_s=300.0)
+        res = runner.run(timeout=timeout)
+        assert res["restarts"] == [1], res
+        assert res["preemptions"] == [0], res
+
+        # undisturbed in-process reference: same step fn, same guardian
+        # wrap, same data — the trajectory both generations must hit
+        ref_tr = Trainer(train_step, TrainerConfig(
+            num_ingest_threads=1, prefetch=False, channel_capacity=2,
+            max_steps=resume_steps, guardian=GuardianConfig(min_samples=4),
+            telemetry=TelemetryConfig(enabled=True, every_n_steps=1)))
+        ref_ds = _DrillDataset(1000, markers)   # no faults
+        ref_tr.train({"w": jnp.zeros(()), "b": jnp.zeros(())}, ref_ds)
+        ref = {r["step"]: r["loss"] for r in ref_tr.telemetry.records
+               if "step" in r and not r.get("final")}
+        assert sorted(ref) == list(range(1, resume_steps + 1)), ref
+
+        def gen_losses(gen):
+            path = runlog_pat.format(gen=gen)
+            if not os.path.exists(path):
+                return {}
+            return {r["step"]: r["loss"] for r in read_records(path)
+                    if "step" in r and not r.get("final")}
+        g0, g1 = gen_losses(0), gen_losses(1)
+        assert g1, "the respawned generation wrote no step records"
+        resume_at = min(g1) - 1
+        assert resume_at >= 2 and resume_at % 2 == 0, (
+            f"resume step {resume_at} is not a checkpoint boundary")
+        assert sorted(g1) == list(range(resume_at + 1,
+                                        resume_steps + 1)), g1
+        assert sorted(g0) == list(range(1, max(g0) + 1)), g0
+        assert max(g0) >= resume_at - 1, (g0.keys(), resume_at)
+        # the loss written at the step the kill checkpointed may be the
+        # one record the crash dropped on the floor; everything else of
+        # 1..12 must be covered
+        covered = set(g0) | set(g1)
+        missing = set(range(1, resume_steps + 1)) - covered
+        assert missing <= {resume_at}, f"uncovered steps: {missing}"
+        # bit-exact: every recorded loss, from either generation —
+        # including the overlap a torn final save forces gen 1 to replay
+        # — equals the undisturbed reference exactly (json round-trips
+        # floats losslessly, so == here is bitwise)
+        for losses, who in ((g0, "gen0"), (g1, "gen1")):
+            for s, v in losses.items():
+                assert v == ref[s], (
+                    f"{who} step {s}: loss {v!r} != reference {ref[s]!r} "
+                    "— resume is not bit-exact")
+
+        return dict(
+            containment=dict(
+                steps=stats["steps"], final_loss=stats["final_loss"],
+                nonfinite_skips=guard.skips, spike_episodes=guard.spikes,
+                rollbacks=guard.rollbacks, corrupt_leaves=corrupt,
+                integrity_fallbacks=fallbacks),
+            resume=dict(
+                restarts=res["restarts"], resumed_at=resume_at,
+                gen0_steps=sorted(g0), gen1_steps=sorted(g1),
+                bit_exact_steps=len(g0) + len(g1)),
+            train_health=health)
+    finally:
+        ckpt_mod._HAS_ORBAX = had_orbax
+        F.set_flags(saved_flags)
 
 
 def _staging_of(url):
@@ -444,6 +765,10 @@ def main():
     ap.add_argument("--fleet", action="store_true",
                     help="run the fleet router failover drill instead "
                          "of the train drill")
+    ap.add_argument("--train", action="store_true",
+                    help="run the guardian drill: NaN/spike containment, "
+                         "rollback through a corrupted checkpoint, and "
+                         "kill-9 bit-exact resume")
     args = ap.parse_args()
     if args.serve:
         summary = run_serve_drill()
@@ -456,6 +781,16 @@ def main():
         print("\n=== fleet chaos drill PASSED ===")
         for k, v in summary.items():
             print(f"  {k}: {v}")
+        return
+    if args.train:
+        workdir = args.workdir or tempfile.mkdtemp(prefix="pt_train_drill_")
+        summary = run_train_drill(workdir)
+        print(summary.pop("train_health"))
+        print("\n=== guardian train drill PASSED ===")
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+        if args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
         return
     workdir = args.workdir or tempfile.mkdtemp(prefix="pt_chaos_drill_")
     summary = run_drill(workdir, steps=args.steps)

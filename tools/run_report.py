@@ -20,10 +20,17 @@ prefill_done/first_token/preempted/resumed/retired), an ASCII per-slot
 Gantt of slot occupancy, TTFT + token-latency percentiles, goodput
 against the configured SLOs, and preemption attribution.
 
+`--train-health` renders the resilience view: guardian non-finite
+skips, loss-spike episodes and mitigation-ladder actions, rollbacks
+with their restore targets, watchdog anomalies, checkpoint-integrity
+outcomes (corrupt leaves / fallbacks), ingest reader deaths, and the
+AMP loss-scale trail.
+
 Usage:
   python tools/run_report.py /runs/exp1/run.jsonl
   python tools/run_report.py run.jsonl --trace /tmp/prof --top 20
   python tools/run_report.py serve.jsonl --serve
+  python tools/run_report.py run.jsonl --train-health
   python tools/run_report.py --selftest      # tier-1 smoke: tiny GPT
                                              # through the Trainer with
                                              # telemetry on, then render
@@ -189,6 +196,101 @@ def render_report(records, trace_dir=None, top=20, device_filter="TPU"):
         except Exception as e:
             lines.append(f"  (trace unreadable: {e})")
 
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
+# -- training-health view -------------------------------------------------
+
+def render_train_health(records):
+    """The resilience story of a training run: guardian events (non-finite
+    skip-applies, loss-spike episodes, mitigation-ladder actions,
+    rollbacks), watchdog anomalies, checkpoint-integrity outcomes, ingest
+    failures, and the AMP loss-scale trail — everything static/guardian.py
+    and io/checkpoint.py wrote into the RunLog and the final metrics
+    snapshot."""
+    guardian = [r for r in records if "guardian" in r]
+    anomalies = [r for r in records if "anomaly" in r]
+    finals = [r for r in records if r.get("final")]
+    counters = _flatten_counters(finals[-1].get("counters")) if finals else {}
+    gauges = (finals[-1].get("gauges") or {}) if finals else {}
+    lines = ["=" * 72, "TRAIN HEALTH", "=" * 72]
+
+    def ctr(name):
+        return sum(v for k, v in counters.items()
+                   if k == name or k.startswith(name + "{"))
+
+    # -- guardian ladder ---------------------------------------------------
+    kinds = {}
+    actions = {}
+    for r in guardian:
+        kinds[r["guardian"]] = kinds.get(r["guardian"], 0) + 1
+        if r.get("action"):
+            actions[r["action"]] = actions.get(r["action"], 0) + 1
+    lines.append(f"\nguardian events: {len(guardian)}"
+                 + (f"  ({', '.join(f'{k} {v}' for k, v in sorted(kinds.items()))})"
+                    if kinds else "  (clean run)"))
+    lines.append(f"non-finite skips:   {ctr('trainer.nonfinite_skips')}")
+    lines.append(f"loss-spike episodes: {ctr('trainer.loss_spikes')}")
+    if actions:
+        lines.append("ladder actions:     "
+                     + "  ".join(f"{a}={actions[a]}" for a in
+                                 ("skip", "reread", "rollback")
+                                 if a in actions))
+    rb = [r for r in guardian if r["guardian"] == "rollback"]
+    done = [r for r in guardian if r["guardian"] == "rollback_done"]
+    lines.append(f"rollbacks:          {ctr('trainer.rollbacks')}")
+    for r, d in zip(rb, done + [None] * len(rb)):
+        lines.append(f"  at step {r.get('step')}"
+                     + (f" -> restored step {d['restored_step']}"
+                        if d else " (restore unrecorded)"))
+
+    # -- watchdog anomalies ------------------------------------------------
+    if anomalies:
+        by_kind = {}
+        for r in anomalies:
+            by_kind.setdefault(r["anomaly"], []).append(r.get("step"))
+        lines.append("\nwatchdog anomalies:")
+        for k in sorted(by_kind):
+            steps_s = ", ".join(str(s) for s in by_kind[k][:8])
+            more = len(by_kind[k]) - 8
+            lines.append(f"  {k:<18} x{len(by_kind[k])}  (steps {steps_s}"
+                         + (f", +{more} more)" if more > 0 else ")"))
+    else:
+        lines.append("\nwatchdog anomalies: none")
+
+    # -- checkpoint integrity / ingest / amp -------------------------------
+    lines.append("\ncheckpoint integrity:")
+    for name, label in (("checkpoint.saves", "saves"),
+                        ("checkpoint.restores", "restores"),
+                        ("checkpoint.corrupt_leaves", "corrupt leaves"),
+                        ("checkpoint.integrity_fallbacks",
+                         "integrity fallbacks"),
+                        ("checkpoint.torn_skips", "torn-mirror skips")):
+        lines.append(f"  {label:<20} {ctr(name)}")
+    ingest = {k: v for k, v in counters.items()
+              if k.startswith("trainer.ingest_errors")}
+    lines.append("ingest reader deaths: "
+                 + (", ".join(f"{k.split('{', 1)[-1].rstrip('}')} x{v}"
+                              for k, v in sorted(ingest.items()))
+                    if ingest else "0"))
+    if "amp.loss_scale" in gauges or ctr("amp.skipped_steps"):
+        lines.append(f"amp: loss_scale={gauges.get('amp.loss_scale')}  "
+                     f"skipped_steps={ctr('amp.skipped_steps')}")
+
+    # -- loss trajectory around the incidents ------------------------------
+    steps = [r for r in records if "step" in r and not r.get("final")
+             and "guardian" not in r and "anomaly" not in r]
+    losses = [(r["step"], r["loss"]) for r in steps
+              if isinstance(r.get("loss"), (int, float))]
+    if losses:
+        worst = max(losses, key=lambda sv: sv[1])
+        lines.append(f"\nloss: first={losses[0][1]:.6g} "
+                     f"last={losses[-1][1]:.6g} "
+                     f"worst={worst[1]:.6g} (step {worst[0]})")
+    verdict = ("DEGRADED (rollback budget was drawn on)" if rb
+               else "contained" if guardian or anomalies else "clean")
+    lines.append(f"verdict: {verdict}")
     lines.append("=" * 72)
     return "\n".join(lines)
 
@@ -442,6 +544,11 @@ def main():
                          "lifecycles, per-slot Gantt, TTFT/token-"
                          "latency percentiles, goodput, preemption "
                          "attribution")
+    ap.add_argument("--train-health", action="store_true",
+                    help="render the training-resilience view: guardian "
+                         "skips/spikes/rollbacks, watchdog anomalies, "
+                         "checkpoint-integrity outcomes, ingest "
+                         "failures, AMP loss-scale trail")
     ap.add_argument("--selftest", action="store_true",
                     help="train a tiny GPT with telemetry on (CPU) and "
                          "render its report — the tier-1 smoke")
@@ -457,6 +564,9 @@ def main():
         raise SystemExit(f"no records in {args.runlog}")
     if args.serve:
         print(render_serve_report(records, top=args.top))
+        return
+    if args.train_health:
+        print(render_train_health(records))
         return
     print(render_report(records, trace_dir=args.trace, top=args.top,
                         device_filter=args.device_filter))

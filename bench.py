@@ -655,7 +655,12 @@ def bench_gpt_serve_fleet(steps, batch, seq):
     a common full-page prefix; each replica-count row then reports the
     fleet-wide prefix_hit_rate plus the router's affinity_hits (the
     prefix-affinity dispatch steering same-prefix traffic to the
-    replica already holding the pages)."""
+    replica already holding the pages). PT_BENCH_FLEET_RAMP=1 switches
+    to an offered-load ramp against ONE autoscaling router: the row
+    carries a goodput-vs-offered-load curve with replica-count and
+    deploy-overhead columns (a rolling v0 -> v1 deploy lands at the
+    peak level), plus the router's ops_log for `tools/run_report.py
+    --fleet`."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
@@ -707,6 +712,108 @@ def bench_gpt_serve_fleet(steps, batch, seq):
                                    "cancelled", "failed")
                   for r in router.requests.values()):
             router.step()
+
+    if os.environ.get("PT_BENCH_FLEET_RAMP", "0") == "1":
+        # Ramp mode: ONE autoscaling router pushed through an offered-load
+        # ramp (PT_BENCH_FLEET_RAMP_LEVELS are per-level multipliers of
+        # `batch` requests) instead of a fresh router per replica count.
+        # A rolling deploy (v0 -> v1) lands at the peak level so its
+        # overhead shows up in-curve. Each curve row: offered load,
+        # windowed goodput, live replica count after the level settles,
+        # decoded tokens/s, and the deploy's wall time (0 when the level
+        # had no deploy). Feed the row JSON to `tools/run_report.py
+        # --fleet` for the deploy timeline + per-version goodput table.
+        levels = [int(x) for x in os.environ.get(
+            "PT_BENCH_FLEET_RAMP_LEVELS", "1,2,4,8,4,1").split(",")
+            if x.strip()]
+        router = FleetRouter(
+            model, variables,
+            FleetConfig(num_replicas=1, heartbeat_s=60.0, metrics_port=0,
+                        autoscale_min=1, autoscale_max=max(counts),
+                        scale_cooldown_s=0.0),
+            serve_config=serve_cfg())
+        rng = np.random.RandomState(0)
+        shared_prefix = (rng.randint(0, cfg.vocab_size, (shared_len,),
+                                     dtype=np.int32)
+                         if shared_len else None)
+
+        def submit(k):
+            for _ in range(k):
+                plen = int(rng.randint(max(1, seq // 8),
+                                       prefill_len + 1))
+                ids = rng.randint(0, cfg.vocab_size, (plen,),
+                                  dtype=np.int32)
+                if shared_len and rng.random_sample() < share:
+                    ids = np.concatenate([shared_prefix, ids])
+                router.submit(ids, max_new=max_new)
+
+        def alive_now():
+            return sum(1 for s in router.telemetry()["states"]
+                       if s in ("live", "stalled", "draining"))
+
+        def settle_tracked():
+            # settle, reporting the PEAK live replica count: the idle
+            # scale-down usually lands before the level finishes, so a
+            # post-settle sample would always read autoscale_min
+            peak = alive_now()
+            while any(r.status not in ("done", "rejected", "shed",
+                                       "cancelled", "failed")
+                      for r in router.requests.values()):
+                router.step()
+                peak = max(peak, alive_now())
+            return peak
+
+        submit(batch)            # warmup: compile prefill + decode
+        settle(router)
+        deploy_at = levels.index(max(levels))
+        curve = []
+        for li, lvl in enumerate(levels):
+            mark = len(router.requests)
+            n_req = lvl * batch
+            t0 = time.perf_counter()
+            submit(n_req)
+            deploy_s = 0.0
+            if li == deploy_at:
+                d0 = time.perf_counter()
+                router.deploy(variables, version="v1", budget_s=600.0)
+                deploy_s = round(time.perf_counter() - d0, 3)
+            live = settle_tracked()
+            dt = max(time.perf_counter() - t0, 1e-9)
+            recs = [r for r in router.requests.values()
+                    if r.id >= mark]
+            done = [r for r in recs if r.status == "done"]
+            acct = [r for r in recs if r.status != "cancelled"]
+            curve.append({
+                "offered": n_req,
+                "completed": len(done),
+                "goodput": round(sum(1 for r in acct if r.slo_ok)
+                                 / max(len(acct), 1), 4),
+                "replicas": live,
+                "tokens_per_sec": round(
+                    sum(len(r.tokens) for r in done) / dt, 1),
+                "deploy_s": deploy_s,
+            })
+        tel = router.telemetry()
+        router.close()
+        peak = max(curve, key=lambda row: row["tokens_per_sec"])
+        return {
+            "metric": "gpt_serve_fleet_ramp_peak_tokens_per_sec",
+            "value": peak["tokens_per_sec"],
+            "unit": "decoded tokens/s (fleet aggregate, ramp peak)",
+            "vs_baseline": 0.0,
+            "slots_per_replica": batch,
+            "page_size": page,
+            "max_new": max_new,
+            "autoscale_max": max(counts),
+            "deployed_version": tel["baseline_version"],
+            "version_stats": tel["version_stats"],
+            "ops_log": tel["ops_log"],
+            "curve": curve,
+            "note": "PT_BENCH_FLEET_RAMP=1: goodput-vs-offered-load ramp "
+                    "against one autoscaling router; a rolling deploy "
+                    "(v0 -> v1) lands at the peak level so deploy "
+                    "overhead appears in-curve",
+        }
 
     by_replicas = {}
     for n in counts:

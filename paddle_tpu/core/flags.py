@@ -190,6 +190,25 @@ define_flag("fleet_drain_timeout_s", 120.0,
             "Wall-clock budget for FleetRouter.drain() to retire every "
             "accepted request while quiescing replicas one at a time; "
             "0 = unbounded.")
+define_flag("fleet_canary_weight", 0.1,
+            "Fraction of fresh fleet traffic routed to the canary "
+            "version while one is deployed (deploy(..., canary=True)); "
+            "in [0, 1]. A request never switches versions mid-stream.")
+define_flag("fleet_autoscale_min", 1,
+            "Floor on live replicas the fleet autoscaler may drain down "
+            "to (never below 1).")
+define_flag("fleet_autoscale_max", 0,
+            "Ceiling on live replicas the fleet autoscaler may spawn up "
+            "to; 0 disables autoscaling entirely.")
+define_flag("fleet_scale_cooldown_s", 5.0,
+            "Minimum seconds between fleet autoscaling actions (spawn "
+            "or drain-then-retire), so one load spike produces one "
+            "deliberate step, not a thrash.")
+define_flag("fleet_deploy_verify", 1,
+            "Verify a deployed checkpoint against its crc32 integrity "
+            "manifest before any replica is touched (FleetRouter."
+            "deploy); a corrupt manifest aborts the rollout with the "
+            "fleet still serving the old version. 0 skips verification.")
 # profiler
 define_flag("profiler_dir", "/tmp/paddle_tpu_trace", "Profiler trace dir.")
 # data loader

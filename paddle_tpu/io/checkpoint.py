@@ -367,12 +367,17 @@ class CheckpointManager:
                     or actual[key]["dtype"] != spec["dtype"]
                     or actual[key]["shape"] != spec["shape"])]
 
-    def save(self, step, state, force=False, meta=None):
+    def save(self, step, state, force=False, meta=None, version=None):
         """Save when the step hits the save interval; `force=True`
         bypasses the interval gate (preemption: flush the current step at
         the boundary before exiting). `meta` is an arbitrary
         JSON-serializable dict stored in the step's integrity sidecar and
-        returned by read_meta()."""
+        returned by read_meta(). `version` rides the sidecar as
+        meta["model_version"] — FleetRouter.deploy() reads it to tag the
+        rollout when no explicit version is given."""
+        if version is not None:
+            meta = dict(meta or {})
+            meta["model_version"] = str(version)
         if self._mgr is not None:
             if force and self._mgr.latest_step() == step:
                 saved = True           # boundary save already landed

@@ -80,6 +80,18 @@ CATALOG = {
         "prefix cache already held the request's leading prompt pages "
         "(least-loaded remains the tiebreak and the imbalance "
         "fallback)."),
+    "fleet.canary_aborts": MetricSpec(
+        "counter", (),
+        "Automatic canary aborts: the canary version's goodput fell "
+        "below the baseline's by more than the configured margin, so "
+        "canary routing stopped and its replicas rolled back."),
+    "fleet.deploys": MetricSpec(
+        "counter", ("status",),
+        "FleetRouter.deploy() outcomes: ok (baseline moved), canary "
+        "(one replica swapped, weighted routing started), rejected "
+        "(fleet draining), aborted (corrupt manifest or failed first "
+        "swap; fleet untouched), rolled_back (mid-rollout failure; "
+        "already-swapped replicas restored)."),
     "fleet.dispatch_depth": MetricSpec(
         "gauge", ("replica",),
         "Requests dispatched to a replica and not yet terminal, by "
@@ -92,7 +104,8 @@ CATALOG = {
         "re-routes in-flight work and respawns the replica."),
     "fleet.replicas": MetricSpec(
         "gauge", ("state",),
-        "Fleet replicas by state (live | stalled | draining | dead)."),
+        "Fleet replicas by state (live | stalled | draining | dead | "
+        "retired — retired = permanently removed by a scale-down)."),
     "fleet.rerouted": MetricSpec(
         "counter", (),
         "In-flight requests re-routed to a healthy replica after a "
@@ -100,6 +113,16 @@ CATALOG = {
     "fleet.respawns": MetricSpec(
         "counter", ("replica",),
         "Replica respawns performed under the fleet RetryBudget."),
+    "fleet.scale_events": MetricSpec(
+        "counter", ("direction",),
+        "Fleet autoscaling actions: up = a replica spawned against "
+        "pending backlog, down = a replica gracefully drained and "
+        "retired against sustained slack."),
+    "fleet.version_retirements": MetricSpec(
+        "counter", ("version",),
+        "Fleet request retirements by the model version that served "
+        "(or was routed for) the request — the per-version SLO plane "
+        "the canary comparison reads."),
     # parallel/heartbeat.py
     "heartbeat.barrier_wait_s": MetricSpec(
         "counter", ("barrier",),

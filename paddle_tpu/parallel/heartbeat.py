@@ -61,6 +61,16 @@ class HeartBeatMonitor:
     def complete(self, worker):
         self.update(worker, COMPLETED)
 
+    def add_worker(self, worker=None):
+        """Grow the monitored set by one (elastic scale-up: the fleet
+        autoscaler spawning a replica). Returns the new worker index."""
+        with self._lock:
+            if worker is None:
+                worker = self.num_workers
+            self.num_workers = max(self.num_workers, worker + 1)
+            self._state.setdefault(worker, UNINITED)
+            return worker
+
     def check(self):
         """One scan; returns {worker: (state, age_s)}. RUNNING workers past
         the timeout flip to STALLED and fire on_stall."""

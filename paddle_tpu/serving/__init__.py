@@ -22,12 +22,14 @@ drain.
 """
 
 from paddle_tpu.serving.engine import Request, ServeConfig, ServingEngine
-from paddle_tpu.serving.fleet import (FleetConfig, FleetRequest,
-                                      FleetRouter, InProcessReplica,
+from paddle_tpu.serving.fleet import (DeployAborted, FleetConfig,
+                                      FleetRequest, FleetRouter,
+                                      InProcessReplica,
                                       SubprocessReplica,
                                       replica_worker_loop)
 from paddle_tpu.serving.prefix_cache import PrefixCache
 
-__all__ = ["Request", "ServeConfig", "ServingEngine", "FleetConfig",
-           "FleetRequest", "FleetRouter", "InProcessReplica",
-           "PrefixCache", "SubprocessReplica", "replica_worker_loop"]
+__all__ = ["DeployAborted", "Request", "ServeConfig", "ServingEngine",
+           "FleetConfig", "FleetRequest", "FleetRouter",
+           "InProcessReplica", "PrefixCache", "SubprocessReplica",
+           "replica_worker_loop"]

@@ -121,6 +121,10 @@ class ServeConfig:
     default_deadline_s: float = None   # None -> flag; 0 = none
     step_retries: int = None     # None -> flag serve_step_retries
     chunked_prefill: bool = None  # None -> flag serve_chunked_prefill
+    model_version: str = None    # "model_id@version" identity tag the
+    #                              fleet router stamps on a replica's
+    #                              engine; surfaces in slo_stats() and
+    #                              trace records (per-version SLO plane)
 
     def resolve(self):
         if self.num_slots is None:
@@ -211,6 +215,7 @@ class ServingEngine:
         cfg = self.cfg
         self._model = model
         self._params = variables["params"]
+        self.version = cfg.model_version
         self._clock = clock
         self._pages_per_slot = -(-cfg.max_len // cfg.page_size)
         self._caches = model.init_paged_caches(
@@ -739,6 +744,7 @@ class ServingEngine:
                  for k, v in viol.items()}
         return {"goodput": round(self.goodput(), 4),
                 "retired": self._retired,
+                "version": self.version,
                 "slo_ttft_s": self.cfg.slo_ttft_s or None,
                 "slo_token_latency_s":
                     self.cfg.slo_token_latency_s or None,
@@ -786,6 +792,8 @@ class ServingEngine:
                    "t": t, "at_step": self._step_no}
             if req.slot is not None:
                 rec["slot"] = req.slot
+            if self.version is not None:
+                rec["version"] = self.version
             rec.update(extra)
             self._run_log.write(rec)
         return t

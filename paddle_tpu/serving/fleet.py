@@ -40,6 +40,32 @@ What the router does:
   drain      `drain()` quiesces replicas one at a time — no new
              dispatch to a draining replica while the rest absorb the
              backlog — and retires every accepted request.
+  deploy     `deploy(ckpt, version=)` is a zero-downtime rolling weight
+             hot-swap: the checkpoint is loaded and crc32-verified
+             against its PR-15 manifest BEFORE any replica is touched
+             (a corrupt manifest aborts with the fleet still serving
+             the old version), then each replica is drained and
+             respawned on the new weights one at a time while the rest
+             absorb the traffic; a mid-swap failure rolls the touched
+             replica back to its old version. Every retirement carries
+             a version tag (`fleet.version_retirements{version}`), so
+             goodput/SLO are attributable per model version.
+  canary     `deploy(..., canary=True)` swaps ONE replica and routes a
+             `fleet_canary_weight` fraction of fresh traffic to the new
+             version (`model_id@version` dispatch; a request never
+             switches versions mid-stream — failover re-routes stay on
+             the version that generated their tokens). Per-version
+             goodput is tracked from the version-tagged retirements;
+             when the canary's falls below the baseline's by
+             `FleetConfig.canary_margin` the canary is aborted and
+             rolled back automatically (`fleet.canary_aborts`).
+  autoscale  queue-depth/goodput signals (the same plane that feeds
+             `anomaly_sink`) spawn and drain replicas against offered
+             load between `fleet_autoscale_min` and
+             `fleet_autoscale_max` under a `fleet_scale_cooldown_s`
+             cooldown; scale-downs always go through graceful drain —
+             in-flight work finishes or is re-routed, never dropped
+             (`fleet.scale_events{direction}`).
 
 Replicas are in-process by default (N engines, one process — the test
 and bench shape). `SubprocessReplica` + `replica_worker_loop` run an
@@ -79,6 +105,12 @@ class ReplicaDead(RuntimeError):
     """A replica handle was used after its process/engine died."""
 
 
+class DeployAborted(RuntimeError):
+    """A rolling weight deploy was aborted (corrupt manifest, rejected
+    while draining, or a mid-swap failure that rolled the touched
+    replica back). The fleet keeps serving on the versions it had."""
+
+
 @dataclasses.dataclass
 class FleetConfig:
     num_replicas: int = None      # None -> serve_replicas flag
@@ -91,6 +123,19 @@ class FleetConfig:
     replica_queue_limit: int = 0  # queued-per-replica dispatch bound;
     #                               0 -> 2 x the engine's decode slots
     metrics_port: int = None      # None -> metrics_port flag; 0 = off
+    model_id: str = "model"       # dispatch identity: model_id@version
+    baseline_version: str = "v0"  # version tag of the construction-time
+    #                               weights (deploys move the baseline)
+    canary_weight: float = None   # None -> fleet_canary_weight flag
+    canary_margin: float = 0.1    # canary goodput this far below the
+    #                               baseline's -> automatic abort
+    canary_min_retired: int = 5   # per-version retirements before the
+    #                               canary comparison is trusted
+    autoscale_min: int = None     # None -> fleet_autoscale_min flag
+    autoscale_max: int = None     # None -> fleet_autoscale_max flag;
+    #                               0 = autoscaling off
+    scale_cooldown_s: float = None   # None -> fleet_scale_cooldown_s
+    deploy_verify: bool = None    # None -> fleet_deploy_verify flag
 
     def resolve(self):
         if self.num_replicas is None:
@@ -103,8 +148,21 @@ class FleetConfig:
             self.drain_timeout_s = float(get_flag("fleet_drain_timeout_s"))
         if self.metrics_port is None:
             self.metrics_port = int(get_flag("metrics_port"))
+        if self.canary_weight is None:
+            self.canary_weight = float(get_flag("fleet_canary_weight"))
+        if self.autoscale_min is None:
+            self.autoscale_min = int(get_flag("fleet_autoscale_min"))
+        if self.autoscale_max is None:
+            self.autoscale_max = int(get_flag("fleet_autoscale_max"))
+        if self.scale_cooldown_s is None:
+            self.scale_cooldown_s = float(
+                get_flag("fleet_scale_cooldown_s"))
+        if self.deploy_verify is None:
+            self.deploy_verify = bool(get_flag("fleet_deploy_verify"))
         enforce(self.num_replicas >= 1, "fleet needs at least 1 replica")
         enforce(self.heartbeat_s > 0, "fleet_heartbeat_s must be > 0")
+        enforce(0.0 <= self.canary_weight <= 1.0,
+                "fleet_canary_weight must be in [0, 1]")
         return self
 
 
@@ -131,6 +189,10 @@ class FleetRequest:
     done_t: float = None
     replica: int = None           # owning (then completing) replica
     replica_rid: int = None       # the replica-local request id
+    version: str = None           # model version serving this request —
+    #                               chosen at routing time, then PINNED:
+    #                               a failover re-route never switches
+    #                               versions once tokens were generated
     reroutes: int = 0             # failover re-dispatches survived
     retire_reason: str = None
     slo_ok: bool = None
@@ -245,7 +307,8 @@ class InProcessReplica:
                     decode_traces=eng.decode_traces,
                     recoveries=eng.recoveries, queued=self.queued(),
                     active=0 if self._dead else len(eng._running),
-                    alive=self.alive())
+                    alive=self.alive(),
+                    version=getattr(eng, "version", None))
 
     def close(self):
         if self.engine is not None:
@@ -509,37 +572,67 @@ class FleetRouter:
         _catalog.preregister([
             "fleet.replicas", "fleet.failovers", "fleet.rerouted",
             "fleet.dispatch_depth", "fleet.respawns",
-            "fleet.affinity_hits"])
+            "fleet.affinity_hits", "fleet.version_retirements",
+            "fleet.deploys", "fleet.scale_events",
+            "fleet.canary_aborts"])
+        # One reentrant lock guards the router mirror: submit()/cancel()
+        # arrive on client threads while step()/drain() run the round
+        # thread, and the engine watchdog's anomaly callback re-enters
+        # shed_pending() from under a step that already holds the lock.
+        # Created before the replicas: the version-aware engine factory
+        # reads the per-replica weight assignment under it.
+        self._lock = threading.RLock()
+        # deploy()/drain() are whole-fleet operations that drive many
+        # rounds; this mutex serializes them so a drain arriving during
+        # a rollout waits for the swap to finish (or abort) before
+        # quiescing — they never interleave half-done.
+        self._ops_lock = threading.Lock()
+        self._model = model
+        self._serve_template = serve_config or ServeConfig()
+        # version -> weights; every respawn/swap rebuilds its engine
+        # from this store, so a failure mid-rollout comes back on the
+        # version the replica was serving
+        self._weights = {}            # graft-guard: self._lock
+        if variables is not None:
+            self._weights[cfg.baseline_version] = variables
+        self._baseline_version = cfg.baseline_version   # graft-guard: self._lock
+        self._canary_version = None   # graft-guard: self._lock
+        self._deploying = None        # graft-guard: self._lock
+        self._pending_swaps = {}      # replica -> version|None (None =
+        #                               scale-down retire); graft-guard: self._lock
+        self._version_stats = {}      # version -> [retired, slo_ok];
+        #                               graft-guard: self._lock
+        self._last_scale_t = None     # graft-guard: self._lock
+        self.ops_log = []             # deploy/scale/canary event records;
+        #                               graft-guard: self._lock
         if replicas is not None:
             self._replicas = list(replicas)
+            self._versions = [cfg.baseline_version] * len(self._replicas)
         else:
             enforce(model is not None and variables is not None,
                     "FleetRouter needs (model, variables) or explicit "
                     "replica handles")
-            template = serve_config or ServeConfig()
+            self._versions = [cfg.baseline_version] * cfg.num_replicas
             self._replicas = [
                 InProcessReplica(
-                    self._engine_factory(model, variables, template),
+                    self._engine_factory(i),
                     anomaly_sink=self._sink_for(i))
                 for i in range(cfg.num_replicas)]
+        # graft-guard: self._lock (self._versions: per-replica serving
+        # version, read by the engine factory and the dispatch filter)
         n = len(self._replicas)
         # submit() mirrors ServingEngine.submit defaults, so max_new must
         # fall back to the replicas' OWN serve config, not a fresh one
         self._default_max_new = int(next(
-            (h.engine.cfg.default_max_new for h in self._replicas
+            (h.engine.cfg.default_max_new for h in list(self._replicas)
              if isinstance(h, InProcessReplica)),
             serve_config.default_max_new if serve_config is not None
             else ServeConfig().default_max_new))
         if cfg.replica_queue_limit <= 0:
             slots = max((h.engine.cfg.num_slots
-                         for h in self._replicas
+                         for h in list(self._replicas)
                          if isinstance(h, InProcessReplica)), default=4)
             cfg.replica_queue_limit = max(2, 2 * slots)
-        # One reentrant lock guards the router mirror: submit()/cancel()
-        # arrive on client threads while step()/drain() run the round
-        # thread, and the engine watchdog's anomaly callback re-enters
-        # shed_pending() from under a step that already holds the lock.
-        self._lock = threading.RLock()
         self._states = ["live"] * n   # graft-guard: self._lock
         self._monitor = HeartBeatMonitor(
             n, timeout_s=cfg.heartbeat_s, interval_s=cfg.heartbeat_s,
@@ -560,11 +653,19 @@ class FleetRouter:
         self._metrics_server = start_metrics_server(cfg.metrics_port)
         self._publish()
 
-    def _engine_factory(self, model, variables, template):
+    def _engine_factory(self, i):
+        """Factory for replica i's engine, bound to the replica's
+        CURRENT version assignment: a failure respawn comes back on the
+        version the replica was serving, and a deploy swap changes
+        `self._versions[i]` first, then respawns through this."""
         def build():
-            sc = dataclasses.replace(template)
+            sc = dataclasses.replace(self._serve_template)
             sc.metrics_port = 0      # ONE exporter, owned by the router
-            return ServingEngine(model, variables, sc)
+            with self._lock:
+                version = self._versions[i]
+                variables = self._weights[version]
+            sc.model_version = f"{self.cfg.model_id}@{version}"
+            return ServingEngine(self._model, variables, sc)
         return build
 
     def _sink_for(self, i):
@@ -649,8 +750,8 @@ class FleetRouter:
         with self._lock:
             finished = []
             self._dispatch(finished)
-            for i, handle in enumerate(self._replicas):
-                if self._states[i] == "dead":
+            for i, handle in enumerate(list(self._replicas)):
+                if self._states[i] in ("dead", "retired"):
                     continue
                 if not handle.alive():
                     self._on_replica_failure(
@@ -674,6 +775,9 @@ class FleetRouter:
                 self._ping(i)
                 self._sync(i, report, finished)
             self._scan_heartbeats(finished)
+            self._advance_swaps(finished)
+            self._check_canary()
+            self._autoscale()
             self._publish()
             self._step_no += 1
             return finished
@@ -685,7 +789,16 @@ class FleetRouter:
         backlog; once every replica is draining, leftover pending work
         still dispatches to the least-loaded draining (alive) replica,
         so nothing accepted is dropped. New submissions during drain
-        are rejected retriable. Bounded by fleet_drain_timeout_s."""
+        are rejected retriable. Bounded by fleet_drain_timeout_s.
+
+        Serialized against deploy() on the ops mutex: a drain arriving
+        during an in-progress rollout BLOCKS until the swap finishes or
+        aborts deterministically, then quiesces — the two whole-fleet
+        operations never interleave half-done."""
+        with self._ops_lock:
+            return self._drain_locked(max_steps)
+
+    def _drain_locked(self, max_steps):
         with self._lock:
             self._draining = True
         t0 = self._clock()
@@ -769,17 +882,27 @@ class FleetRouter:
         """Per-replica + fleet-level snapshot (the bench row payload)."""
         with self._lock:
             return {
-                "replicas": [h.telemetry() for h in self._replicas],
+                "replicas": [h.telemetry()
+                             for h in list(self._replicas)],
                 "states": list(self._states),
                 "failovers": self.failovers,
                 "rerouted": int(sum(r.reroutes
                                     for r in self.requests.values())),
-                "respawn_failures": [b.failures for b in self._budgets],
+                "respawn_failures": [b.failures
+                                     for b in list(self._budgets)],
                 "goodput": round(self.goodput(), 4),
+                "versions": list(self._versions),
+                "baseline_version": self._baseline_version,
+                "canary_version": self._canary_version,
+                "version_stats": {
+                    v: {"retired": s[0], "slo_ok": s[1],
+                        "goodput": round(s[1] / s[0], 4) if s[0] else 1.0}
+                    for v, s in sorted(self._version_stats.items())},
+                "ops_log": [dict(e) for e in self.ops_log],
             }
 
     def close(self):
-        for handle in self._replicas:
+        for handle in list(self._replicas):
             try:
                 handle.close()
             except Exception:
@@ -811,9 +934,15 @@ class FleetRouter:
         if live:
             return live
         # every survivor is draining (late drain, or failover under
-        # drain): accepted work still has to land somewhere alive
+        # drain): accepted work still has to land somewhere alive.
+        # Replicas quiescing toward a pending swap/retire are excluded:
+        # feeding one fresh work would extend its drain by the whole
+        # backlog (a single-replica deploy would never converge under
+        # load) — the work waits pending and lands on the rebuilt
+        # replica a few rounds later instead.
         return [i for i, s in enumerate(self._states)
-                if s == "draining" and self._replicas[i].alive()]
+                if s == "draining" and self._replicas[i].alive()
+                and i not in self._pending_swaps]
 
     def _affinity_depth(self, handle, rec):
         """Leading full prompt pages of `rec` already in a replica's
@@ -830,13 +959,56 @@ class FleetRouter:
         except Exception:
             return 0
 
+    def _choose_version(self, rec):
+        """Routing version for a fresh request: the canary version for a
+        `fleet_canary_weight` fraction of traffic (deterministic per
+        fleet id, so drills replay identically), else the baseline. The
+        choice PINS `rec.version` — per-version SLO accounting starts at
+        routing, and a later re-route stays on the pinned version."""
+        if rec.version is not None:
+            return rec.version
+        version = self._baseline_version
+        canary = self._canary_version
+        if canary is not None and self.cfg.canary_weight > 0:
+            try:
+                fault_point("fleet.canary")
+                draw = ((1103515245 * (rec.id + 1) + 12345) >> 7) % 1000
+                if draw < int(self.cfg.canary_weight * 1000):
+                    version = canary
+            except Exception:
+                pass      # injected canary-router fault: the request
+                #           falls back to the baseline version
+        rec.version = version
+        return version
+
     def _pick_replica(self, rec=None):
-        """Dispatch target for `rec`: the least-loaded eligible replica,
-        unless some replica's prefix cache already holds the request's
-        leading prompt pages — then the least-loaded such replica wins
+        """Dispatch target for `rec`: the least-loaded eligible replica
+        SERVING THE REQUEST'S VERSION (model_id@version routing), unless
+        some replica's prefix cache already holds the request's leading
+        prompt pages — then the least-loaded such replica wins
         (fleet.affinity_hits), provided it is not overloaded relative
         to the fleet minimum (imbalance fallback: affinity never starves
-        a cold replica of its fair share)."""
+        a cold replica of its fair share). A re-routed request that
+        already generated tokens is HARD-pinned: only replicas serving
+        its version qualify (draining ones included — a failover landing
+        must never adopt tokens onto different weights); a fresh request
+        soft-prefers its routed version but may re-route to whatever
+        capacity exists."""
+        if rec is not None and rec.version is not None and rec.tokens:
+            # hard pin: mid-stream work never switches versions. Live
+            # same-version replicas are preferred; draining ones are
+            # the fallback only (a swap target mid-quiesce may be the
+            # sole holder of the pinned version)
+            live, draining = [], []
+            for i, s in enumerate(self._states):
+                if (s in ("live", "draining")
+                        and self._replicas[i].alive()
+                        and self._versions[i] == rec.version):
+                    (live if s == "live" else draining).append(
+                        (self._replicas[i].load(), i,
+                         self._replicas[i]))
+            candidates = live or draining
+            return min(candidates)[1:] if candidates else None
         candidates = []
         for i in self._eligible_replicas():
             handle = self._replicas[i]
@@ -845,6 +1017,12 @@ class FleetRouter:
             candidates.append((handle.load(), i, handle))
         if not candidates:
             return None
+        if rec is not None:
+            want = self._choose_version(rec)
+            versioned = [c for c in candidates
+                         if self._versions[c[1]] == want]
+            if versioned:
+                candidates = versioned
         least = min(candidates)
         if rec is not None:
             affine = [c for c in candidates
@@ -868,6 +1046,16 @@ class FleetRouter:
             rec = min(self._pending, key=self._admission_key)
             target = self._pick_replica(rec)
             if target is None:
+                if rec.version is not None and rec.tokens:
+                    # mid-stream work hard-pinned to a version no alive
+                    # replica serves: it can never adopt safely (its
+                    # tokens came from those weights), so it fails now
+                    # rather than wedge the queue behind an unroutable
+                    # record
+                    self._pending.remove(rec)
+                    self._retire(rec, "failed", "version_retired",
+                                 finished)
+                    continue
                 break
             i, handle = target
             try:
@@ -884,6 +1072,10 @@ class FleetRouter:
             rec.status = "dispatched"
             rec.replica = i
             rec.replica_rid = rid
+            # pin to the LANDING replica's version: the soft preference
+            # may have fallen back to off-version capacity for a fresh
+            # request, and accounting must tag what actually served it
+            rec.version = self._versions[i]
             self._by_replica[(i, rid)] = rec.id
 
     def _spec_of(self, rec, origin="fleet"):
@@ -895,6 +1087,373 @@ class FleetRouter:
                     temperature=rec.temperature, top_k=rec.top_k,
                     top_p=rec.top_p, seed=rec.seed,
                     origin=origin if not rec.reroutes else "failover")
+
+    # -- live ops: deploy / canary / autoscale ----------------------------
+
+    def _ops_event(self, event, **kw):
+        """Append one record to the ops log (`run_report --fleet` renders
+        the deploy timeline from these)."""
+        with self._lock:
+            rec = dict(event=event, t=self._clock(),
+                       at_step=self._step_no, **kw)
+            self.ops_log.append(rec)
+            return rec
+
+    def version_goodput(self, version):
+        """SLO-met fraction of the version's accountable retirements
+        (1.0 until the version has retired anything)."""
+        with self._lock:
+            st = self._version_stats.get(version)
+            if not st or st[0] == 0:
+                return 1.0
+            return st[1] / st[0]
+
+    def _account_version(self, rec):
+        """Stamp the retirement with the version that served (or was
+        routed for) it and feed the per-version SLO tally the canary
+        comparison reads. Cancellations are tagged but not tallied —
+        same accountability rule as goodput()."""
+        if rec.version is None:
+            rec.version = self._baseline_version
+        _metrics.counter("fleet.version_retirements").inc(
+            version=rec.version)
+        if rec.status != "cancelled":
+            st = self._version_stats.setdefault(rec.version, [0, 0])
+            st[0] += 1
+            if rec.slo_ok:
+                st[1] += 1
+
+    def deploy(self, ckpt, version=None, step=None, verify=None,
+               canary=False, budget_s=None):
+        """Zero-downtime rolling weight hot-swap.
+
+        `ckpt` is a checkpoint path (loaded through CheckpointManager
+        and crc32-verified against its PR-15 manifest BEFORE any replica
+        is touched — a corrupt manifest raises DeployAborted with the
+        fleet untouched), a raw variables pytree (tests/drills; then
+        `version` is required), or None to promote an already-stored
+        version (canary -> full rollout). Each replica then drains and
+        rebuilds on the new weights one at a time while the rest absorb
+        the traffic; a mid-swap failure rolls the touched replica back
+        to its old version, aborts the rollout, and rolls back any
+        replica already swapped. `canary=True` swaps exactly ONE replica
+        and starts weighted canary routing instead of moving the
+        baseline. Serialized against drain() (and other deploys) by the
+        ops mutex; a fleet already draining rejects the deploy."""
+        deploys = _metrics.counter("fleet.deploys")
+        with self._ops_lock:
+            with self._lock:
+                if self._draining:
+                    deploys.inc(status="rejected")
+                    raise DeployAborted("fleet is draining")
+                template_v = self._baseline_version
+                template = self._weights.get(template_v)
+            if verify is None:
+                verify = self.cfg.deploy_verify
+            got = None
+            if ckpt is None:
+                enforce(version is not None,
+                        "deploy(None) promotes a stored version: "
+                        "pass version=")
+                with self._lock:
+                    variables = self._weights.get(version)
+                if variables is None:
+                    deploys.inc(status="aborted")
+                    raise DeployAborted(
+                        f"no stored weights for version {version!r}")
+            elif isinstance(ckpt, str):
+                from paddle_tpu.io.checkpoint import CheckpointManager
+                try:
+                    fault_point("fleet.deploy")
+                    mgr = CheckpointManager(ckpt)
+                    variables, got = mgr.restore(
+                        template, step=step, verify=verify)
+                    if variables is None:
+                        raise RuntimeError(
+                            f"no restorable checkpoint under {ckpt}")
+                except Exception as e:
+                    deploys.inc(status="aborted")
+                    self._ops_event("deploy_abort", ckpt=str(ckpt),
+                                    version=version, error=repr(e))
+                    raise DeployAborted(
+                        f"checkpoint load/verify failed: {e}") from e
+                if version is None:
+                    version = (mgr.read_meta(got) or {}).get(
+                        "model_version") or f"ckpt-{got}"
+            else:
+                # raw pytree: trusted caller (tests, drills), unverified
+                enforce(version is not None,
+                        "deploy(variables) needs an explicit version=")
+                variables = ckpt
+            with self._lock:
+                self._weights[version] = variables
+                self._deploying = version
+                old_baseline = self._baseline_version
+                if canary:
+                    live = [i for i, s in enumerate(self._states)
+                            if s == "live"
+                            and self._versions[i] != version]
+                    if not live:
+                        self._deploying = None
+                        deploys.inc(status="aborted")
+                        raise DeployAborted(
+                            "no live replica available for a canary")
+                    targets = [min(live, key=lambda i: (
+                        self._replicas[i].load(), i))]
+                else:
+                    # skip replicas already queued for a scale-down
+                    # retire (pending swap target None): a deploy must
+                    # not resurrect a replica the autoscaler is
+                    # removing
+                    targets = [i for i, s in enumerate(self._states)
+                               if s not in ("dead", "retired")
+                               and self._versions[i] != version
+                               and self._pending_swaps.get(i, "")
+                               is not None]
+            self._ops_event("deploy_start", version=version,
+                            canary=bool(canary), step=got,
+                            targets=list(targets))
+            deadline = self._clock() + (
+                budget_s if budget_s is not None
+                else max(self.cfg.drain_timeout_s, 1.0))
+            swapped = []              # (replica, its pre-swap version)
+            try:
+                for i in targets:
+                    with self._lock:
+                        prev = self._versions[i]
+                    if self._swap_replica(i, version, deadline):
+                        swapped.append((i, prev))
+                        continue
+                    # abort: roll already-swapped replicas back
+                    # (best-effort, bounded by a fresh budget)
+                    back_by = self._clock() + max(
+                        self.cfg.drain_timeout_s, 1.0)
+                    for j, prev_j in swapped:
+                        self._swap_replica(j, prev_j, back_by)
+                    status = "rolled_back" if swapped else "aborted"
+                    deploys.inc(status=status)
+                    self._ops_event("deploy_abort", version=version,
+                                    failed_replica=i, status=status)
+                    raise DeployAborted(
+                        f"swap of replica {i} to {version!r} failed; "
+                        f"{len(swapped)} replica(s) rolled back")
+            finally:
+                with self._lock:
+                    self._deploying = None
+            with self._lock:
+                if canary:
+                    self._canary_version = version
+                else:
+                    self._baseline_version = version
+                    if self._canary_version == version:
+                        self._canary_version = None
+            deploys.inc(status="canary" if canary else "ok")
+            self._ops_event("deploy_done", version=version,
+                            canary=bool(canary),
+                            baseline=(old_baseline if canary
+                                      else version),
+                            replicas=[i for i, _ in swapped])
+            return version
+
+    def _swap_replica(self, i, version, deadline):
+        """Queue replica i for a drain-then-rebuild onto `version` and
+        drive router rounds until the swap lands (True) or fails —
+        replica dead past its budget, rollback by _advance_swaps, or
+        the deadline (False). The fleet keeps serving throughout: this
+        only steps the normal round loop."""
+        with self._lock:
+            if self._states[i] == "live":
+                self._states[i] = "draining"
+            self._pending_swaps[i] = version
+        while True:
+            with self._lock:
+                if i not in self._pending_swaps:
+                    break
+                if self._states[i] in ("dead", "retired"):
+                    # dead: failover already ran inside step() and the
+                    # budget is spent; retired: a scale-down landed
+                    # first — either way the swap can never land
+                    self._pending_swaps.pop(i, None)
+                    return False
+                if self._clock() > deadline:
+                    self._pending_swaps.pop(i, None)
+                    if (self._states[i] == "draining"
+                            and not self._draining):
+                        self._states[i] = "live"
+                    return False
+            self.step()
+        with self._lock:
+            return (self._versions[i] == version
+                    and self._replicas[i].alive())
+
+    def _advance_swaps(self, finished):
+        """Execute queued replica transitions whose replica has quiesced
+        (idle engine AND no dispatched mirror records): a version target
+        rebuilds the engine on the new weights (`fleet.deploy` fault
+        point; a failure rolls THIS replica back to the version it was
+        serving), a None target retires the replica (scale-down)."""
+        for i, target in list(self._pending_swaps.items()):
+            if self._states[i] in ("dead", "retired"):
+                continue
+            handle = self._replicas[i]
+            if not handle.alive():
+                continue      # failover will respawn it (old version)
+            if handle.load() > 0 or self._replica_outstanding(i):
+                continue      # still draining toward the swap
+            del self._pending_swaps[i]
+            if target is None:
+                others = [j for j, s in enumerate(self._states)
+                          if j != i and s in ("live", "stalled",
+                                              "draining")
+                          and self._replicas[j].alive()]
+                if not others:
+                    # the fleet shrank under the queued retire (deaths,
+                    # other retires): never remove the last alive
+                    # replica — cancel the scale-down instead
+                    self._states[i] = ("draining" if self._draining
+                                       else "live")
+                    self._ops_event("scale_down_cancelled", replica=i)
+                    continue
+                try:
+                    handle.close()
+                except Exception:
+                    pass
+                handle.kill()
+                self._states[i] = "retired"
+                self._monitor.update(i)
+                _metrics.counter("fleet.scale_events").inc(
+                    direction="down")
+                self._ops_event("scale_down", replica=i)
+                continue
+            old = self._versions[i]
+            self._versions[i] = target
+            try:
+                fault_point("fleet.deploy")
+                handle.respawn()
+            except Exception as e:
+                # mid-swap failure: never trade a failed swap for a
+                # lost replica — back onto the old weights
+                self._versions[i] = old
+                self._ops_event("swap_fail", replica=i, version=target,
+                                error=repr(e))
+                if handle.alive():
+                    # in-process factory failure leaves the old engine
+                    # untouched and serving
+                    self._states[i] = ("draining" if self._draining
+                                       else "live")
+                else:
+                    self._respawn(i, e, "live", finished)
+                continue
+            self._monitor.update(i)
+            self._states[i] = "draining" if self._draining else "live"
+            self._ops_event("swap", replica=i, version=target, prev=old)
+
+    def _check_canary(self):
+        """Automatic canary abort: once both versions have enough
+        accountable retirements, a canary goodput below the baseline's
+        by more than canary_margin rolls every canary replica back to
+        the baseline (graceful, via the swap queue) and stops canary
+        routing."""
+        canary = self._canary_version
+        if canary is None:
+            return
+        cs = self._version_stats.get(canary)
+        bs = self._version_stats.get(self._baseline_version)
+        need = self.cfg.canary_min_retired
+        if not cs or cs[0] < need or not bs or bs[0] < need:
+            return
+        c_good, b_good = cs[1] / cs[0], bs[1] / bs[0]
+        if c_good >= b_good - self.cfg.canary_margin:
+            return
+        _metrics.counter("fleet.canary_aborts").inc()
+        self._ops_event("canary_abort", version=canary,
+                        canary_goodput=round(c_good, 4),
+                        baseline_goodput=round(b_good, 4))
+        self._canary_version = None
+        for i, v in enumerate(list(self._versions)):
+            if v == canary and self._states[i] not in ("dead",
+                                                       "retired"):
+                if self._states[i] == "live":
+                    self._states[i] = "draining"
+                self._pending_swaps[i] = self._baseline_version
+
+    def _autoscale(self):
+        """Load-driven replica count: pending backlog with headroom
+        under fleet_autoscale_max spawns a baseline replica; sustained
+        slack above the floor queues a graceful drain-then-retire of
+        the least-loaded one. One action per fleet_scale_cooldown_s;
+        parked during deploys and drains."""
+        cfg = self.cfg
+        if not cfg.autoscale_max or cfg.autoscale_max <= 0:
+            return
+        if (self._model is None or self._deploying is not None
+                or self._draining):
+            return
+        now = self._clock()
+        if (self._last_scale_t is not None
+                and now - self._last_scale_t < cfg.scale_cooldown_s):
+            return
+        live = [i for i, s in enumerate(self._states) if s == "live"]
+        backlog = len(self._pending)
+        if backlog > 0 and len(live) < cfg.autoscale_max:
+            try:
+                fault_point("fleet.scale")
+                i = self._spawn_replica(self._baseline_version)
+            except Exception as e:
+                self._ops_event("scale_up_fail", error=repr(e))
+                self._last_scale_t = now   # failed spawns cool down too
+                return
+            self._last_scale_t = now
+            _metrics.counter("fleet.scale_events").inc(direction="up")
+            self._ops_event("scale_up", replica=i, backlog=backlog)
+            return
+        floor = max(1, cfg.autoscale_min or 1)
+        if len(live) <= floor:
+            return
+        out = backlog + sum(self._replica_outstanding(i) for i in live)
+        if out * 2 > (len(live) - 1) * cfg.replica_queue_limit:
+            return            # the survivors couldn't absorb the load
+        victims = [i for i in live
+                   if self._canary_version is None
+                   or self._versions[i] != self._canary_version]
+        if not victims:
+            return
+        try:
+            fault_point("fleet.scale")
+        except Exception as e:
+            self._ops_event("scale_down_fail", error=repr(e))
+            self._last_scale_t = now
+            return
+        victim = min(victims,
+                     key=lambda i: (self._replicas[i].load(), -i))
+        self._states[victim] = "draining"
+        self._pending_swaps[victim] = None
+        self._last_scale_t = now
+        self._ops_event("scale_down_begin", replica=victim,
+                        outstanding=out)
+
+    def _spawn_replica(self, version):
+        """Grow the fleet by one in-process replica on `version`. The
+        per-replica registries are appended BEFORE the engine is built
+        (the version-aware factory reads self._versions[i]); a factory
+        failure unwinds them."""
+        i = len(self._replicas)
+        self._versions.append(version)
+        self._states.append("live")
+        try:
+            handle = InProcessReplica(self._engine_factory(i),
+                                      anomaly_sink=self._sink_for(i))
+        except Exception:
+            self._versions.pop()
+            self._states.pop()
+            raise
+        self._replicas.append(handle)
+        self._budgets.append(RetryBudget(
+            RetryPolicy(max_attempts=self.cfg.respawn_budget + 1),
+            "fleet.respawn"))
+        self._monitor.add_worker(i)
+        self._monitor.update(i)
+        return i
 
     # -- liveness + failover ----------------------------------------------
 
@@ -909,7 +1468,7 @@ class FleetRouter:
     def _scan_heartbeats(self, finished):
         dead_after = self.cfg.heartbeat_s * self.cfg.heartbeat_dead_factor
         for w, (st, age) in self._monitor.check().items():
-            if self._states[w] == "dead":
+            if self._states[w] in ("dead", "retired"):
                 continue
             if age > dead_after:
                 self._on_replica_failure(
@@ -997,6 +1556,7 @@ class FleetRouter:
             if fin["first_token_t"] is not None:
                 rec.first_token_t = fin["first_token_t"]
             rec.done_t = self._clock()
+            self._account_version(rec)
             finished.append(rec)
         for inf in report["inflight"]:
             fid = self._by_replica.get((i, inf["rid"]))
@@ -1009,6 +1569,11 @@ class FleetRouter:
 
     def _on_replica_anomaly(self, replica, event):
         if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
+            # same signal plane drives both relief valves: spare
+            # capacity spawns first (the autoscaler's cooldown and
+            # bounds apply), then expired/low-priority pending sheds
+            with self._lock:
+                self._autoscale()
             self.shed_pending(cause=event["anomaly"])
 
     def _retire(self, rec, status, why, finished=None, account=True,
@@ -1020,16 +1585,17 @@ class FleetRouter:
             rec.slo_ok = False
         if count:
             _metrics.counter("serve.requests").inc(status=status)
+        self._account_version(rec)
         if finished is not None:
             finished.append(rec)
 
     def _publish(self):
         counts = collections.Counter(self._states)
         g = _metrics.gauge("fleet.replicas")
-        for st in ("live", "stalled", "draining", "dead"):
+        for st in ("live", "stalled", "draining", "dead", "retired"):
             g.set(counts.get(st, 0), state=st)
         depth = _metrics.gauge("fleet.dispatch_depth")
-        for i, handle in enumerate(self._replicas):
+        for i, handle in enumerate(list(self._replicas)):
             depth.set(self._replica_outstanding(i)
                       + sum(1 for r in self._pending
                             if r.replica == i), replica=str(i))

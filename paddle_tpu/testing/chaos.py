@@ -242,9 +242,18 @@ FAULT_POINTS = {
     "checkpoint.mirror": "remote mirror push of a committed checkpoint",
     "checkpoint.verify": "restore-side crc32 integrity check of a "
                          "checkpoint step against its manifest",
+    "fleet.canary": "canary routing draw for a fresh fleet request (a "
+                     "fault degrades the request to the baseline "
+                     "version)",
+    "fleet.deploy": "rolling weight hot-swap: the checkpoint "
+                    "load/verify before any replica is touched, and "
+                    "each per-replica engine rebuild on the new "
+                    "version (a fault rolls the touched replica back)",
     "fleet.dispatch": "fleet router handing a request to a replica",
     "fleet.heartbeat": "fleet router per-replica liveness ping",
     "fleet.respawn": "fleet router respawning a dead replica",
+    "fleet.scale": "fleet autoscaler acting on a load signal (spawn "
+                   "or graceful drain-then-retire)",
     "serve.prefill": "serving admission prefill (per chunk) device call",
     "serve.prefix_cache": "prefix-cache lookup at admission (a hash "
                           "collision or evict-under-use injection "

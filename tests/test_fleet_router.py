@@ -545,6 +545,300 @@ class TestPrefixAffinity:
         router.close()
 
 
+# --------------------------------------------------------------------------
+# live ops: rolling deploy, canary routing, autoscaling
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow   # ~45s of engine rebuilds; tier-1 runs under a hard budget
+class TestLiveOps:
+    def test_rolling_deploy_serves_new_weights(self, fast_retry):
+        """deploy() pushes fresh weights through the whole fleet one
+        replica at a time with requests in flight: the rollout
+        completes, in-flight work retires tagged with the OLD version,
+        and post-deploy requests decode on the NEW weights."""
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.serving import ServingEngine
+        router, model, variables, cfg = _router(num_replicas=2)
+        v1 = _tiny_decoder(seed=1)[1]
+        prompts = _mixed_prompts(cfg, 4, seed=31)
+        fids = [router.submit(p, max_new=6) for p in prompts]
+        router.step()
+        ok0 = dict(_metrics.counter("fleet.deploys").snapshot()).get(
+            "status=ok", 0)
+        assert router.deploy(v1, version="v1") == "v1"
+        assert router._baseline_version == "v1"
+        assert router._versions == ["v1", "v1"]
+        events = [e["event"] for e in router.ops_log]
+        assert events.index("deploy_start") < events.index("swap")
+        assert events.count("swap") == 2
+        assert events.index("deploy_done") > events.index("swap")
+        assert dict(_metrics.counter("fleet.deploys").snapshot())[
+            "status=ok"] == ok0 + 1
+        # the rollout drained the in-flight wave on the old weights
+        for fid in fids:
+            rec = router.requests[fid]
+            assert rec.status == "done", (fid, rec.status)
+            assert rec.version == "v0", (fid, rec.version)
+        # fresh traffic decodes on the NEW weights, tagged v1
+        probe = _mixed_prompts(cfg, 1, seed=32)[0]
+        fid = router.submit(probe, max_new=8)
+        router.drain()
+        rec = router.requests[fid]
+        assert rec.status == "done" and rec.version == "v1"
+        ref = ServingEngine(model, v1, _serve_cfg())
+        rid = ref.submit(probe, max_new=8)
+        ref.drain()
+        assert np.array_equal(rec.output, ref.requests[rid].output)
+        ref.close()
+        router.close()
+
+    def test_corrupt_manifest_aborts_with_fleet_untouched(
+            self, fast_retry, tmp_path):
+        """A checkpoint push whose crc32 manifest fails verification
+        must abort BEFORE any replica is touched; an intact push picks
+        its version tag up from the manifest meta."""
+        import json
+
+        from paddle_tpu.io.checkpoint import CheckpointManager
+        from paddle_tpu.serving import DeployAborted
+        router, model, variables, cfg = _router(num_replicas=2)
+        v1 = _tiny_decoder(seed=1)[1]
+        ck = str(tmp_path / "ck")
+        with CheckpointManager(ck) as mgr:
+            mgr.save(1, v1, force=True, version="good")
+            mgr.save(2, v1, force=True, version="bad")
+        meta_path = os.path.join(ck, "2.meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        leaf = sorted(meta["crc32"])[0]
+        meta["crc32"][leaf]["crc32"] ^= 0xDEADBEEF
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+
+        assert router.deploy(ck, step=1) == "good"   # tag from manifest
+        assert router._versions == ["good", "good"]
+        with pytest.raises(DeployAborted):
+            router.deploy(ck, step=2)
+        assert router._versions == ["good", "good"]
+        assert router._baseline_version == "good"
+        assert not router._pending_swaps
+        events = [e["event"] for e in router.ops_log]
+        assert "deploy_abort" in events
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=33)[0],
+                            max_new=4)
+        router.drain()
+        assert router.requests[fid].status == "done"
+        router.close()
+
+    def test_canary_weighted_routing_and_auto_abort(self, fast_retry):
+        """canary=True swaps exactly one replica; fleet_canary_weight
+        steers fresh traffic at it (1.0 = every request); a canary
+        goodput below baseline - margin rolls the canary replica back
+        and stops canary routing (fleet.canary_aborts)."""
+        from paddle_tpu.observability import metrics as _metrics
+        router, model, variables, cfg = _router(
+            num_replicas=2, canary_weight=1.0, canary_min_retired=2,
+            canary_margin=0.05)
+        v1 = _tiny_decoder(seed=1)[1]
+        assert router.deploy(v1, version="v1", canary=True) == "v1"
+        assert router._canary_version == "v1"
+        assert sorted(router._versions) == ["v0", "v1"]
+        assert router._baseline_version == "v0"
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=34)[0],
+                            max_new=4)
+        rec = router.requests[fid]
+        assert rec.version == "v1"          # weight 1.0: all -> canary
+        while rec.status not in ("done", "failed"):
+            router.step()
+        assert rec.status == "done"
+        aborts0 = _metrics.counter("fleet.canary_aborts").total()
+        with router._lock:                  # forged SLO gap: canary at
+            router._version_stats = {"v0": [10, 10],   # 0%, baseline
+                                     "v1": [10, 0]}    # at 100%
+        router.step()
+        assert _metrics.counter("fleet.canary_aborts").total() == (
+            aborts0 + 1)
+        assert router._canary_version is None
+        for _ in range(100):
+            if router._versions == ["v0", "v0"]:
+                break
+            router.step()
+        assert router._versions == ["v0", "v0"]   # rolled back
+        assert "canary_abort" in [e["event"] for e in router.ops_log]
+        # post-abort traffic routes (and is tagged) baseline only
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=35)[0],
+                            max_new=4)
+        assert router.requests[fid].version == "v0"
+        router.drain()
+        router.close()
+
+    def test_autoscale_up_under_backlog_down_when_idle(self,
+                                                       fast_retry):
+        """Queue pressure spawns replicas up to fleet_autoscale_max;
+        an idle fleet drains surplus replicas back to the floor, always
+        gracefully (the victim quiesces before retiring)."""
+        router, model, variables, cfg = _router(
+            num_replicas=1, autoscale_min=1, autoscale_max=3,
+            scale_cooldown_s=0.0)
+        prompts = _mixed_prompts(cfg, 12, seed=36)
+        fids = [router.submit(p, max_new=4) for p in prompts]
+        grew = 0
+        for _ in range(300):
+            router.step()
+            grew = max(grew, len(router._replicas))
+            if all(router.requests[f].status == "done" for f in fids):
+                break
+        assert grew > 1, "backlog never spawned a replica"
+        assert all(router.requests[f].status == "done"
+                   for f in fids)
+        events = [e["event"] for e in router.ops_log]
+        assert "scale_up" in events
+        for _ in range(300):                # idle: drain the surplus
+            if sum(1 for s in router._states if s == "live") == 1:
+                break
+            router.step()
+        assert sum(1 for s in router._states if s == "live") == 1
+        assert "scale_down" in [e["event"] for e in router.ops_log]
+        assert router._states.count("retired") >= 1
+        router.close()
+
+    def test_drain_during_rollout_finishes_swap_first(self,
+                                                      fast_retry):
+        """Satellite regression: drain() issued while a rollout is in
+        progress must serialize behind it — the swap completes (or
+        aborts) deterministically first, so the fleet never quiesces
+        half-swapped — and a deploy against an already-draining fleet
+        is rejected outright."""
+        from paddle_tpu.serving import DeployAborted
+        router, model, variables, cfg = _router(num_replicas=2)
+        v1 = _tiny_decoder(seed=1)[1]
+        fids = [router.submit(p, max_new=8)
+                for p in _mixed_prompts(cfg, 6, seed=37)]
+        router.step()
+        errs = []
+        mid_rollout = threading.Event()
+        orig_step = router.step
+
+        def step_signal():
+            mid_rollout.set()
+            orig_step()
+
+        router.step = step_signal
+
+        def do_deploy():
+            try:
+                router.deploy(v1, version="v1")
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        t = threading.Thread(target=do_deploy)
+        t.start()
+        assert mid_rollout.wait(30), "deploy never started stepping"
+        router.drain()                      # blocks on the ops mutex
+        t.join(120)
+        assert not t.is_alive() and not errs, errs
+        assert router._baseline_version == "v1"
+        assert router._versions == ["v1", "v1"]   # never half-swapped
+        assert not router._pending_swaps
+        assert all(router.requests[f].status == "done" for f in fids)
+        with pytest.raises(DeployAborted):  # the reverse order rejects
+            router.deploy(variables, version="v2")
+        router.close()
+
+    def test_token_exact_across_swap_on_old_version(self, fast_retry):
+        """Satellite acceptance: a greedy request knocked off a
+        draining replica by a kill mid-swap completes bit-identical to
+        an undisturbed single-engine run on the OLD weights — the
+        version pin survives the failover re-route."""
+        from paddle_tpu.serving import ServingEngine
+        router, model, variables, cfg = _router(num_replicas=2)
+        v1 = _tiny_decoder(seed=1)[1]
+        prompts = _mixed_prompts(cfg, 4, seed=38)
+        fids = [router.submit(p, max_new=10) for p in prompts]
+        for _ in range(2):
+            router.step()                   # tokens flowing everywhere
+        orig_step = router.step
+        killed = {}
+
+        def step_with_kill():
+            if not killed and router._deploying is not None:
+                for i, tgt in list(router._pending_swaps.items()):
+                    h = router._replicas[i]
+                    if (tgt is not None and h.alive()
+                            and h.load() > 0):
+                        router.kill_replica(i)
+                        killed["victim"] = i
+                        break
+            orig_step()
+
+        router.step = step_with_kill
+        assert router.deploy(v1, version="v1") == "v1"
+        router.step = orig_step
+        assert "victim" in killed, "no busy swap target to kill"
+        assert router.failovers >= 1
+        assert any(router.requests[f].reroutes for f in fids)
+        router.drain()
+        ref = ServingEngine(model, variables, _serve_cfg())
+        rids = [ref.submit(p, max_new=10) for p in prompts]
+        ref.drain()
+        for fid, rid in zip(fids, rids):
+            rec = router.requests[fid]
+            assert rec.status == "done", (fid, rec.status)
+            assert rec.version == "v0", (fid, rec.version)
+            assert np.array_equal(rec.output,
+                                  ref.requests[rid].output), fid
+        assert router._versions == ["v1", "v1"]   # rollout still landed
+        ref.close()
+        router.close()
+
+    def test_live_ops_metrics_reach_the_exporter(self, fast_retry):
+        """Satellite acceptance: fleet.deploys, fleet.scale_events,
+        fleet.version_retirements, and fleet.canary_aborts all show up
+        on a real /metrics scrape after the corresponding operations."""
+        from paddle_tpu.observability import metrics as _metrics
+        from paddle_tpu.observability.exporter import MetricsServer
+        router, model, variables, cfg = _router(
+            num_replicas=1, autoscale_min=1, autoscale_max=3,
+            scale_cooldown_s=0.0, canary_weight=0.5,
+            canary_min_retired=1)
+        v1 = _tiny_decoder(seed=1)[1]
+        fid = router.submit(_mixed_prompts(cfg, 1, seed=39)[0],
+                            max_new=4)
+        while router.requests[fid].status != "done":
+            router.step()                   # one v0-tagged retirement
+        assert router.deploy(v1, version="v1") == "v1"
+        fids = [router.submit(p, max_new=4)
+                for p in _mixed_prompts(cfg, 10, seed=40)]
+        for _ in range(300):
+            router.step()
+            if all(router.requests[f].status == "done" for f in fids):
+                break
+        for _ in range(300):                # idle -> scale back down
+            if sum(1 for s in router._states if s == "live") == 1:
+                break
+            router.step()
+        # a canary that tanks: forge the gap, step to trigger the abort
+        v2 = _tiny_decoder(seed=2)[1]
+        router.deploy(v2, version="v2", canary=True)
+        with router._lock:
+            router._version_stats = {"v1": [10, 10], "v2": [10, 0]}
+        router.step()
+        assert router._canary_version is None
+        with MetricsServer(port=0, host="127.0.0.1") as srv:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=5).read().decode()
+        assert 'fleet_deploys{status="ok"}' in body
+        assert 'fleet_deploys{status="canary"}' in body
+        assert 'fleet_version_retirements{version="v0"}' in body
+        assert 'fleet_version_retirements{version="v1"}' in body
+        assert 'fleet_scale_events{direction="up"}' in body
+        assert 'fleet_scale_events{direction="down"}' in body
+        assert "fleet_canary_aborts" in body
+        router.close()
+
+
 @pytest.mark.slow
 def test_subprocess_replica_failover_end_to_end(tmp_path, fast_retry):
     """A replica engine in a child process over the host_allgather
@@ -610,6 +904,29 @@ def test_fleet_chaos_drill_end_to_end():
     assert summary["failovers"] == summary["injected_kills"] == 1
     assert summary["statuses"].get("failed", 0) == 0
     assert summary["token_exact"] == 9
+
+
+@pytest.mark.slow
+def test_fleet_ops_drill_end_to_end():
+    """The full tools/chaos_drill.py --fleet-ops scenario: rolling
+    deploy + kill -9 mid-swap + overload ramp + corrupt-manifest
+    deploy, in one run — 100% terminal, zero cross-version token
+    leaks, failovers == injected kills."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drill_fleet_ops", os.path.join(repo, "tools",
+                                              "chaos_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    summary = mod.run_fleet_ops_drill()
+    assert summary["statuses"] == {"done": summary["submitted"]}
+    assert summary["cross_version_leaks"] == 0
+    assert summary["failovers"] == summary["injected_kills"] == 1
+    assert summary["deployed"] == "v1"
+    assert summary["deploys"].get("status=ok") == 1
+    assert summary["deploys"].get("status=aborted", 0) >= 1
+    assert summary["scale_ups"] >= 1 and summary["scale_downs"] >= 1
 
 
 def test_concurrent_submit_hammer_races_step_and_scrapes():

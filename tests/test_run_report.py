@@ -174,6 +174,87 @@ class TestServeReport:
         assert "no serve trace events" in rep
 
 
+def _fleet_records():
+    """A PT_BENCH_FLEET_RAMP-style row (ops_log + version_stats +
+    curve) plus one raw ops event record."""
+    ops = [
+        {"event": "deploy_start", "t": 10.0, "at_step": 3,
+         "version": "v1", "canary": False, "targets": [0, 1]},
+        {"event": "swap", "t": 10.4, "at_step": 9, "replica": 0,
+         "version": "v1", "prev": "v0"},
+        {"event": "swap", "t": 10.9, "at_step": 15, "replica": 1,
+         "version": "v1", "prev": "v0"},
+        {"event": "deploy_done", "t": 10.9, "at_step": 15,
+         "version": "v1", "canary": False, "baseline": "v1",
+         "replicas": [0, 1]},
+        {"event": "scale_up", "t": 12.0, "at_step": 20, "replica": 2,
+         "backlog": 7},
+    ]
+    row = {
+        "metric": "gpt_serve_fleet_ramp_peak_tokens_per_sec",
+        "ops_log": ops,
+        "version_stats": {
+            "v0": {"retired": 12, "slo_ok": 10, "goodput": 0.8333},
+            "v1": {"retired": 20, "slo_ok": 19, "goodput": 0.95}},
+        "curve": [
+            {"offered": 2, "completed": 2, "goodput": 1.0,
+             "replicas": 1, "tokens_per_sec": 90.0, "deploy_s": 0.0},
+            {"offered": 8, "completed": 8, "goodput": 0.75,
+             "replicas": 3, "tokens_per_sec": 220.0,
+             "deploy_s": 0.41}],
+    }
+    raw = {"event": "scale_down", "t": 15.0, "at_step": 44,
+           "replica": 2}
+    return [row, raw]
+
+
+class TestFleetReport:
+    def test_timeline_versions_and_curve(self):
+        rep = _import_run_report().render_fleet_report(_fleet_records())
+        assert "FLEET REPORT" in rep
+        # timeline is time-ordered and folds raw + ops_log events
+        assert rep.index("deploy_start") < rep.index("deploy_done")
+        assert rep.index("deploy_done") < rep.index("scale_down")
+        assert "replica=0, version=v1, prev=v0" in rep
+        # per-version goodput table
+        assert "per-version goodput" in rep
+        lines = rep.splitlines()
+        v0 = [ln for ln in lines if ln.strip().startswith("v0")][0]
+        assert "12" in v0 and "0.8333" in v0
+        # offered-load ramp with replica-count + deploy-overhead cols
+        assert "offered-load ramp" in rep
+        ramp8 = [ln for ln in lines if ln.strip().startswith("8 ")][0]
+        assert "3" in ramp8 and "0.41" in ramp8
+
+    def test_version_stats_reconstructed_from_trace(self):
+        recs = [
+            {"event": "retired", "req": 0, "t": 1.0, "version": "v0",
+             "slo_ok": True, "reason": "eos", "tokens": 4},
+            {"event": "retired", "req": 1, "t": 2.0, "version": "v0",
+             "slo_ok": False, "reason": "eos", "tokens": 4},
+        ]
+        rep = _import_run_report().render_fleet_report(recs)
+        assert "per-version goodput" in rep
+        assert "0.5000" in rep
+
+    def test_cli_fleet_flag(self, tmp_path):
+        p = tmp_path / "fleet.jsonl"
+        with open(p, "w") as f:
+            for r in _fleet_records():
+                f.write(json.dumps(r) + "\n")
+        proc = subprocess.run(
+            [sys.executable, RUN_REPORT, str(p), "--fleet"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "FLEET REPORT" in proc.stdout
+        assert "deploy timeline" in proc.stdout
+
+    def test_no_fleet_data_degrades_gracefully(self):
+        rep = _import_run_report().render_fleet_report(_records())
+        assert "no fleet ops events" in rep
+
+
 @pytest.mark.perf
 def test_run_report_selftest_smoke():
     """Tier-1: tiny GPT through the Trainer with telemetry on (CPU),

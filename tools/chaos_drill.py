@@ -48,10 +48,20 @@ SIGKILLed from its reader thread mid-run; the respawn resumes from the
 checkpoint + meta and every per-step loss either generation recorded is
 bit-identical to an undisturbed reference run.
 
+Fleet live-ops drill (--fleet-ops): one run combining a rolling weight
+deploy (crc32-gated), a kill -9 mid-swap, an overload ramp under the
+autoscaler, and a corrupt-manifest push. Verifies 100% terminal
+requests, ZERO cross-version token leaks (every greedy completion is
+token-exact under the weights of the version that retired it), version
+tags on every retirement, failovers == kills, at least one autoscale
+spawn + retire, and the corrupt deploy aborting with the fleet still
+serving the deployed version.
+
 Usage:
     python tools/chaos_drill.py [--steps 8] [--workdir DIR]
     python tools/chaos_drill.py --serve
     python tools/chaos_drill.py --fleet
+    python tools/chaos_drill.py --fleet-ops
     python tools/chaos_drill.py --train
 
 Also exercised as tests (tests/test_chaos.py slow-marked train drill;
@@ -753,6 +763,211 @@ def run_fleet_drill(seed=0):
         F.set_flags(saved)
 
 
+def run_fleet_ops_drill(seed=0, workdir=None):
+    """Live fleet operations drill — one run combining a rolling weight
+    deploy, a kill -9 mid-swap, an overload ramp under the autoscaler,
+    and a corrupt-manifest deploy. Verifies 100% of requests reach a
+    terminal status, ZERO cross-version token leaks (every greedy
+    completion is token-exact vs generate() under the weights of the
+    version that retired it), every retirement carries a version tag,
+    `fleet.failovers` == injected kills, the autoscaler both spawned
+    and retired replicas, and the corrupt-manifest push aborted with
+    the fleet still serving the deployed version."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.core import flags as F
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.serving import (DeployAborted, FleetConfig,
+                                    FleetRouter, ServeConfig)
+
+    saved = F.all_flags()
+    router = None
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pt_fleet_ops_")
+    try:
+        F.set_flags({"retry_backoff_base_s": 0.001, "retry_jitter": 0.0})
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        cfg.use_flash = False
+        model = GPTDecoder(cfg)
+        weights = {"v0": model.init(jax.random.key(0)),
+                   "v1": model.init(jax.random.key(1))}
+
+        # the deployable artifacts: step 1 is a healthy v1 checkpoint,
+        # step 2 the same weights with a TAMPERED crc32 manifest — the
+        # corrupt push the rollout must refuse before touching a replica
+        ck = os.path.join(workdir, "ck")
+        with CheckpointManager(ck) as mgr:
+            mgr.save(1, weights["v1"], force=True, version="v1")
+            mgr.save(2, weights["v1"], force=True, version="v-bad")
+        meta_path = os.path.join(ck, "2.meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        leaf = sorted(meta["crc32"])[0]
+        meta["crc32"][leaf]["crc32"] ^= 0xDEADBEEF
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+
+        router = FleetRouter(
+            model, weights["v0"],
+            # dead_factor headroom per the --fleet drill: sibling cold
+            # compiles must never read as heartbeat death
+            # autoscaling is armed for phase 3 (cooldown 0 on a real
+            # clock would shrink the fleet between phases otherwise)
+            FleetConfig(num_replicas=3, heartbeat_s=0.04,
+                        heartbeat_dead_factor=600.0, respawn_budget=3,
+                        autoscale_min=1, autoscale_max=0,
+                        scale_cooldown_s=0.0),
+            serve_config=ServeConfig(num_slots=2, page_size=8,
+                                     max_len=64, prefill_len=16,
+                                     step_retries=4))
+        rng = np.random.RandomState(seed)
+        traffic = {}                  # fid -> (prompt, max_new)
+
+        def submit_wave(n, mn=6):
+            out = []
+            for _ in range(n):
+                p = rng.randint(0, cfg.vocab_size,
+                                (int(rng.randint(3, 28)),),
+                                dtype=np.int32)
+                fid = router.submit(p, max_new=mn)
+                traffic[fid] = (p, mn)
+                out.append(fid)
+            return out
+
+        # -- phase 1: steady traffic on v0, all replicas warm ------------
+        submit_wave(6)
+        for _ in range(6):
+            router.step()
+
+        # -- phase 2: rolling deploy v0 -> v1 with a kill -9 mid-swap ----
+        submit_wave(6)                # in flight across the rollout
+        kills = {"n": 0}
+        orig_step = router.step
+
+        def step_with_midswap_kill():
+            if kills["n"] == 0 and router._deploying is not None:
+                # the replica currently draining toward its swap, caught
+                # with work still on it: the sharpest interleave — its
+                # victims re-route pinned to v0, it respawns on v0, and
+                # the swap completes on the respawned corpse
+                busy_swap = [i for i in router._pending_swaps
+                             if router._replicas[i].alive()
+                             and router._replicas[i].load() > 0]
+                if busy_swap:
+                    router.kill_replica(busy_swap[0])
+                    kills["n"] += 1
+            return orig_step()
+
+        router.step = step_with_midswap_kill
+        deployed = router.deploy(ck, step=1)
+        router.step = orig_step
+        assert deployed == "v1", deployed
+        assert kills["n"] == 1, "the mid-swap kill never fired"
+        assert router._baseline_version == "v1"
+        live_versions = {router._versions[i]
+                         for i, s in enumerate(router._states)
+                         if s in ("live", "stalled", "draining")}
+        assert live_versions == {"v1"}, live_versions
+
+        # -- phase 3: overload ramp under the autoscaler -----------------
+        router.cfg.autoscale_max = 5      # arm the autoscaler
+        scale0 = dict(_metrics.counter("fleet.scale_events").snapshot())
+        submit_wave(18, mn=4)         # backlog past 3 replicas' queues
+        for _ in range(200):
+            router.step()
+            if all(r.status in ("done", "rejected", "shed", "cancelled",
+                                "failed") for r in
+                   router.requests.values()):
+                break
+        for _ in range(80):           # idle: sustained slack drains
+            router.step()
+            snap = _metrics.counter("fleet.scale_events").snapshot()
+            if (snap.get("direction=down", 0)
+                    - scale0.get("direction=down", 0)) >= 1:
+                break
+        snap = _metrics.counter("fleet.scale_events").snapshot()
+        ups = snap.get("direction=up", 0) - scale0.get("direction=up", 0)
+        downs = (snap.get("direction=down", 0)
+                 - scale0.get("direction=down", 0))
+        assert ups >= 1, "overload ramp never spawned a replica"
+        assert downs >= 1, "idle fleet never retired a replica"
+
+        # -- phase 4: corrupt-manifest deploy must abort -----------------
+        versions_before = list(router._versions)
+        try:
+            router.deploy(ck, step=2)
+            raise AssertionError("corrupt-manifest deploy did not abort")
+        except DeployAborted:
+            pass
+        assert router._versions == versions_before
+        assert router._baseline_version == "v1"
+        probe = submit_wave(3, mn=4)  # the fleet still serves
+        router.drain()
+        assert all(router.requests[f].status == "done" for f in probe)
+
+        # -- verify ------------------------------------------------------
+        statuses = {fid: r.status for fid, r in router.requests.items()}
+        terminal = {"done", "rejected", "shed", "cancelled", "failed"}
+        stuck = {f: s for f, s in statuses.items() if s not in terminal}
+        assert not stuck, f"non-terminal requests: {stuck}"
+        assert not any(s == "failed" for s in statuses.values()), statuses
+        assert router.failovers == kills["n"], (router.failovers, kills)
+        untagged = [f for f, r in router.requests.items()
+                    if r.version is None]
+        assert not untagged, f"retirements without a version: {untagged}"
+        # zero cross-version token leaks: every greedy completion must
+        # be bit-identical to generate() under the weights of the
+        # version stamped on it — a single adopted token computed on
+        # the other version's weights would break this
+        refs = {}
+        leaks = []
+        for fid, (p, mn) in traffic.items():
+            rec = router.requests[fid]
+            if rec.status != "done":
+                continue
+            key = (rec.version, p.tobytes(), mn)
+            if key not in refs:
+                refs[key] = np.asarray(model.apply(
+                    weights[rec.version], jnp.asarray(p[None, :]),
+                    method=lambda pr: model.generate(pr, mn)))[0]
+            if not np.array_equal(rec.output, refs[key]):
+                leaks.append(fid)
+        assert not leaks, f"cross-version token leaks: {leaks}"
+        deploy_counts = dict(
+            _metrics.counter("fleet.deploys").snapshot())
+        events = [e["event"] for e in router.ops_log]
+        assert "deploy_start" in events and "deploy_done" in events
+        assert "deploy_abort" in events, events
+        assert "scale_up" in events and "scale_down" in events, events
+        return dict(
+            submitted=len(statuses),
+            statuses={s: sum(1 for v in statuses.values() if v == s)
+                      for s in sorted(set(statuses.values()))},
+            deployed=deployed, injected_kills=kills["n"],
+            failovers=router.failovers,
+            scale_ups=ups, scale_downs=downs,
+            deploys=deploy_counts,
+            version_retirements=dict(_metrics.counter(
+                "fleet.version_retirements").snapshot()),
+            token_exact=sum(1 for s in statuses.values() if s == "done"),
+            cross_version_leaks=0,
+            ops_events=events)
+    finally:
+        if router is not None:
+            router.close()
+        F.set_flags(saved)
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -765,6 +980,10 @@ def main():
     ap.add_argument("--fleet", action="store_true",
                     help="run the fleet router failover drill instead "
                          "of the train drill")
+    ap.add_argument("--fleet-ops", action="store_true",
+                    help="run the live fleet operations drill: rolling "
+                         "deploy + kill -9 mid-swap + overload ramp + "
+                         "corrupt-manifest abort in one run")
     ap.add_argument("--train", action="store_true",
                     help="run the guardian drill: NaN/spike containment, "
                          "rollback through a corrupted checkpoint, and "
@@ -779,6 +998,12 @@ def main():
     if args.fleet:
         summary = run_fleet_drill()
         print("\n=== fleet chaos drill PASSED ===")
+        for k, v in summary.items():
+            print(f"  {k}: {v}")
+        return
+    if args.fleet_ops:
+        summary = run_fleet_ops_drill(workdir=args.workdir)
+        print("\n=== fleet live-ops drill PASSED ===")
         for k, v in summary.items():
             print(f"  {k}: {v}")
         return

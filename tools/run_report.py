@@ -20,6 +20,11 @@ prefill_done/first_token/preempted/resumed/retired), an ASCII per-slot
 Gantt of slot occupancy, TTFT + token-latency percentiles, goodput
 against the configured SLOs, and preemption attribution.
 
+`--fleet` renders the fleet live-ops view: the deploy/scale/canary
+timeline from FleetRouter ops events (raw records, a dumped telemetry
+snapshot's `ops_log`, or a PT_BENCH_FLEET_RAMP=1 bench row), the
+per-version goodput table, and the goodput-vs-offered-load curve.
+
 `--train-health` renders the resilience view: guardian non-finite
 skips, loss-spike episodes and mitigation-ladder actions, rollbacks
 with their restore targets, watchdog anomalies, checkpoint-integrity
@@ -30,6 +35,7 @@ Usage:
   python tools/run_report.py /runs/exp1/run.jsonl
   python tools/run_report.py run.jsonl --trace /tmp/prof --top 20
   python tools/run_report.py serve.jsonl --serve
+  python tools/run_report.py fleet.jsonl --fleet
   python tools/run_report.py run.jsonl --train-health
   python tools/run_report.py --selftest      # tier-1 smoke: tiny GPT
                                              # through the Trainer with
@@ -453,6 +459,100 @@ def render_serve_report(records, top=20, width=64):
     return "\n".join(lines)
 
 
+_FLEET_EVENTS = frozenset((
+    "deploy_start", "deploy_done", "deploy_abort", "swap", "swap_fail",
+    "scale_up", "scale_up_fail", "scale_down_begin", "scale_down",
+    "scale_down_cancelled", "scale_down_fail", "canary_abort"))
+
+
+def render_fleet_report(records, width=64):
+    """The live-ops story of a fleet: the deploy/scale/canary timeline
+    (FleetRouter.ops_log events, taken either as raw records or from any
+    record carrying an `ops_log` list — e.g. a dumped telemetry snapshot
+    or a `bench.py gpt_serve_fleet` ramp row) plus the per-version
+    goodput table (`version_stats` snapshot when present, else
+    reconstructed from engine trace `retired` events that carry a
+    version tag) and, when a ramp row is present, the goodput-vs-
+    offered-load curve."""
+    ops = [r for r in records if r.get("event") in _FLEET_EVENTS]
+    vstats, curve = None, None
+    for r in records:
+        if isinstance(r.get("ops_log"), list):
+            ops.extend(e for e in r["ops_log"]
+                       if e.get("event") in _FLEET_EVENTS)
+        if isinstance(r.get("version_stats"), dict):
+            vstats = r["version_stats"]
+        if isinstance(r.get("curve"), list):
+            curve = r["curve"]
+    if vstats is None:
+        # reconstruct from version-tagged retirements in the trace
+        tally = {}
+        for r in records:
+            if r.get("event") == "retired" and r.get("version"):
+                st = tally.setdefault(r["version"], [0, 0])
+                st[0] += 1
+                if r.get("slo_ok"):
+                    st[1] += 1
+        if tally:
+            vstats = {v: {"retired": s[0], "slo_ok": s[1],
+                          "goodput": round(s[1] / s[0], 4)}
+                      for v, s in tally.items()}
+    lines = ["=" * 72, "FLEET REPORT", "=" * 72]
+    if not ops and vstats is None and curve is None:
+        lines.append("\n(no fleet ops events in this RunLog — dump "
+                     "router.telemetry() as a record, or feed a "
+                     "PT_BENCH_FLEET_RAMP=1 bench row)")
+        return "\n".join(lines + ["=" * 72])
+
+    if ops:
+        ops.sort(key=lambda e: e.get("t", 0.0))
+        t0 = ops[0].get("t", 0.0)
+        deploys = [e for e in ops if e["event"].startswith("deploy")]
+        swaps = [e for e in ops if e["event"].startswith("swap")]
+        scales = [e for e in ops if e["event"].startswith("scale")]
+        aborts = [e for e in ops if e["event"] == "canary_abort"]
+        lines.append(
+            f"\nops events: {len(ops)} "
+            f"({len(deploys)} deploy, {len(swaps)} swap, "
+            f"{len(scales)} scale, {len(aborts)} canary_abort)")
+        lines.append(f"\ndeploy timeline (t0=+0.000s over "
+                     f"{ops[-1].get('t', t0) - t0:.3f}s):")
+        for e in ops:
+            extra = ", ".join(
+                f"{k}={v}" for k, v in e.items()
+                if k not in ("event", "t", "at_step"))
+            lines.append(f"  +{e.get('t', t0) - t0:9.3f}  "
+                         f"{e['event']:<21}" + (f" {extra}" if extra
+                                                else ""))
+
+    if vstats:
+        lines.append("\nper-version goodput:")
+        lines.append(f"  {'version':<16} {'retired':>8} {'slo_ok':>8} "
+                     f"{'goodput':>8}")
+        for v in sorted(vstats):
+            st = vstats[v]
+            lines.append(f"  {v:<16} {st.get('retired', 0):>8} "
+                         f"{st.get('slo_ok', 0):>8} "
+                         f"{st.get('goodput', 0.0):>8.4f}")
+
+    if curve:
+        lines.append("\noffered-load ramp (goodput bar scaled to 1.0):")
+        lines.append(f"  {'offered':>7} {'done':>5} {'replicas':>8} "
+                     f"{'tok/s':>8} {'deploy_s':>8} {'goodput':>8}")
+        barw = max(8, width - 52)
+        for row in curve:
+            g = float(row.get("goodput", 0.0))
+            bar = "#" * int(round(g * barw))
+            lines.append(
+                f"  {row.get('offered', 0):>7} "
+                f"{row.get('completed', 0):>5} "
+                f"{row.get('replicas', 0):>8} "
+                f"{row.get('tokens_per_sec', 0.0):>8} "
+                f"{row.get('deploy_s', 0.0):>8} {g:>8.4f} |{bar}|")
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
 def _selftest():
     """Tier-1 smoke (CPU-only): a tiny GPT trained through the Trainer
     with telemetry on must produce a RunLog whose records carry wall
@@ -544,6 +644,11 @@ def main():
                          "lifecycles, per-slot Gantt, TTFT/token-"
                          "latency percentiles, goodput, preemption "
                          "attribution")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the fleet live-ops view: deploy/scale/"
+                         "canary timeline, per-version goodput table, "
+                         "and (from a ramp bench row) the goodput-vs-"
+                         "offered-load curve")
     ap.add_argument("--train-health", action="store_true",
                     help="render the training-resilience view: guardian "
                          "skips/spikes/rollbacks, watchdog anomalies, "
@@ -564,6 +669,9 @@ def main():
         raise SystemExit(f"no records in {args.runlog}")
     if args.serve:
         print(render_serve_report(records, top=args.top))
+        return
+    if args.fleet:
+        print(render_fleet_report(records))
         return
     if args.train_health:
         print(render_train_health(records))

@@ -38,7 +38,22 @@ DUMP_HLO = None    # --dump-hlo: write the compiled (post-SPMD) HLO text
 MESH_AXES = None   # --mesh: {"dp": 2, "tp": 2} parsed from "dp2,tp2",
                    # or the string "auto" until the planner resolves it
 AUTO_PLAN = None   # --mesh auto: the winning autoplan MeshPlan
+DP_COLLECTIVE = None   # dp>1 mesh rows: {"dp_collective", "dp_wire_bytes"}
 RUN_LOG = None     # --run-log: RunLog streaming per-step bench records
+
+
+def _kv_dtype_env():
+    """PT_BENCH_KV_DTYPE=int8 stores the serve benches' paged KV
+    quantized (the serve_kv_dtype flag's bench knob); default f32."""
+    v = os.environ.get("PT_BENCH_KV_DTYPE", "").strip().lower()
+    return "int8" if v == "int8" else None
+
+
+def _quant_clamps():
+    """Cumulative quant.overflow_clamps counter — int8 values pinned at
+    the rail by a quantized write/collective (0 in a healthy run)."""
+    from paddle_tpu.observability import metrics as _metrics
+    return int(_metrics.counter("quant.overflow_clamps").total())
 
 
 def _parse_mesh(spec):
@@ -77,7 +92,7 @@ def _mesh_setup(params, opt, cfg_vocab, batch, cfg=None, seq=None):
     (pipeline candidates pruned — this train step has no pipeline
     executor) and its MeshPlan emits the param shardings through the
     DistributionPlanner layer; the plan lands in the JSON row."""
-    global MESH_AXES, AUTO_PLAN
+    global MESH_AXES, AUTO_PLAN, DP_COLLECTIVE
     import jax
     import paddle_tpu as pt
     if MESH_AXES == "auto":
@@ -96,6 +111,22 @@ def _mesh_setup(params, opt, cfg_vocab, batch, cfg=None, seq=None):
         params = pt.parallel.tp_lm_sharding(mesh, params)
     dp = mesh.shape.get("dp", 1)
     tp = mesh.shape.get("tp", 1)
+    if dp > 1 and cfg is not None:
+        # record the dp gradient-exchange strategy + bytes on the wire
+        # for this mesh (the same resolution/pricing the planner and
+        # runtime use), so dp>1 train rows carry the collective choice
+        from paddle_tpu.parallel import autoplan as _ap
+        from paddle_tpu.parallel import communicator as _comm
+        from paddle_tpu.parallel.autoplan import costmodel as _cm
+        topo = _ap.get_topology()
+        strat = ("int8" if _comm.resolve_quant_allreduce(
+            crosses_slices=topo.num_slices > 1) else "f32")
+        spec = _ap.ModelSpec.from_config(cfg, batch=batch, seq=seq)
+        DP_COLLECTIVE = {
+            "dp_collective": strat,
+            "dp_wire_bytes": _cm.collective_bytes(
+                spec, dp, tp, 1, dp_collective=strat)["dp"],
+        }
     batch = ((batch + dp - 1) // dp) * dp
     opt_state = opt.init(params)
     vocab_axis = "tp" if tp > 1 and cfg_vocab % tp == 0 else None
@@ -116,6 +147,8 @@ def _mesh_row(row):
         row["mesh"] = dict(MESH_AXES)
     if AUTO_PLAN is not None:
         row["autoplan"] = AUTO_PLAN.summary()
+    if DP_COLLECTIVE is not None:
+        row.update(DP_COLLECTIVE)
     return row
 
 
@@ -534,7 +567,10 @@ def bench_gpt_serve(steps, batch, seq):
     full-page prefix — the prefix-cache workload; the row reports
     prefix_hit_rate / pages_shared / prefill_tokens_skipped, and
     serve_prefix_cache=0 in PT_FLAGS gives the uncached A/B on the
-    identical request stream."""
+    identical request stream. PT_BENCH_KV_DTYPE=int8 stores the paged
+    KV quantized (per-token scales ride the pool); the row reports
+    kv_dtype / kv_pool_bytes / quant_overflow_clamps either way, so
+    the quantized-vs-f32 A/B is one env flip on the same stream."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
@@ -564,6 +600,7 @@ def bench_gpt_serve(steps, batch, seq):
     sc = ServeConfig(num_slots=batch, page_size=page,
                      max_len=shared_len + prefill_len + max_new,
                      prefill_len=prefill_len, cache_dtype=cache_dtype,
+                     kv_dtype=_kv_dtype_env(),
                      run_log=RUN_LOG, slo_ttft_s=slo_ttft,
                      slo_token_latency_s=slo_tok)
     engine = ServingEngine(model, variables, sc)
@@ -615,6 +652,9 @@ def bench_gpt_serve(steps, batch, seq):
         "slots": batch,
         "page_size": page,
         "max_new": max_new,
+        "kv_dtype": engine.kv_dtype_name(),
+        "kv_pool_bytes": engine.kv_pool_bytes(),
+        "quant_overflow_clamps": _quant_clamps(),
         "token_ms": stats.get("token_ms"),
         "ttft_ms": stats.get("ttft_ms"),
         "goodput": slo["goodput"],
@@ -691,8 +731,19 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         return ServeConfig(num_slots=batch, page_size=page,
                            max_len=shared_len + prefill_len + max_new,
                            prefill_len=prefill_len,
-                           cache_dtype=cache_dtype, slo_ttft_s=slo_ttft,
+                           cache_dtype=cache_dtype,
+                           kv_dtype=_kv_dtype_env(),
+                           slo_ttft_s=slo_ttft,
                            slo_token_latency_s=slo_tok, metrics_port=0)
+
+    def fleet_kv_stats(router):
+        """(kv_dtype, total pool bytes) across the router's replicas."""
+        engines = [rep.engine for rep in router._replicas
+                   if getattr(rep, "engine", None) is not None]
+        if not engines:
+            return "f32", 0
+        return (engines[0].kv_dtype_name(),
+                sum(e.kv_pool_bytes() for e in engines))
 
     if COMPILE_ONLY:
         router = FleetRouter(model, variables,
@@ -794,6 +845,7 @@ def bench_gpt_serve_fleet(steps, batch, seq):
                 "deploy_s": deploy_s,
             })
         tel = router.telemetry()
+        kv_name, kv_bytes = fleet_kv_stats(router)
         router.close()
         peak = max(curve, key=lambda row: row["tokens_per_sec"])
         return {
@@ -804,6 +856,9 @@ def bench_gpt_serve_fleet(steps, batch, seq):
             "slots_per_replica": batch,
             "page_size": page,
             "max_new": max_new,
+            "kv_dtype": kv_name,
+            "kv_pool_bytes": kv_bytes,
+            "quant_overflow_clamps": _quant_clamps(),
             "autoscale_max": max(counts),
             "deployed_version": tel["baseline_version"],
             "version_stats": tel["version_stats"],
@@ -896,6 +951,9 @@ def bench_gpt_serve_fleet(steps, batch, seq):
             entry["failover_step_ms"] = failover_ms
             entry["failover_overhead_ms"] = round(failover_ms - mean_ms,
                                                   1)
+        kv_name, kv_bytes = fleet_kv_stats(router)
+        entry["kv_dtype"] = kv_name
+        entry["kv_pool_bytes"] = kv_bytes
         by_replicas[str(n)] = entry
         router.close()
 
@@ -908,6 +966,9 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         "slots_per_replica": batch,
         "page_size": page,
         "max_new": max_new,
+        "kv_dtype": top["kv_dtype"],
+        "kv_pool_bytes": top["kv_pool_bytes"],
+        "quant_overflow_clamps": _quant_clamps(),
         "goodput": top["goodput"],
         "fleet_kill": kill,
         "prefix_share": share,

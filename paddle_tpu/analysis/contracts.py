@@ -142,6 +142,48 @@ class NoTemporary(Contract):
                 for shp in self.temporaries(ctx.hlo_text)]
 
 
+class NoKvDequantTemporary(Contract):
+    """int8-paged-KV serve contract: no wide-float tensor at paged-KV
+    layout scale in the compiled module. The page pools are laid out
+    [..., page_size, head_dim]; with serve_kv_dtype=int8 the only
+    f32 KV values allowed are the kernel's per-page dequant tiles, so
+    any f32/bf16 tensor that (a) ends in head_dim, (b) carries
+    page_size on an earlier axis, and (c) holds >= ``min_rows`` x
+    (page_size x head_dim) elements is a dequantized pool or
+    pool-gather materialized outside the kernel — the exact temporary
+    int8 storage exists to avoid. ``min_rows`` sits above the kernel's
+    per-tile dequant (block_h rows) and below the smallest whole-pool
+    dequant, so the f32-pool engine is the positive control that trips
+    it."""
+
+    def __init__(self, page_size, head_dim, min_rows,
+                 dtypes=("f32", "bf16")):
+        self.page_size = int(page_size)
+        self.head_dim = int(head_dim)
+        self.min_rows = int(min_rows)
+        self.dtypes = tuple(dtypes)
+        self.name = (f"no-kv-dequant-temporary([...,{page_size},"
+                     f"{head_dim}], rows>={min_rows})")
+
+    def temporaries(self, hlo_text):
+        hits = set()
+        tile = self.page_size * self.head_dim
+        for _, shp in hlo_shapes(hlo_text, self.dtypes):
+            if (len(shp) >= 3 and shp[-1] == self.head_dim
+                    and self.page_size in shp[:-1]
+                    and math.prod(shp) // tile >= self.min_rows):
+                hits.add(shp)
+        return sorted(hits)
+
+    def check(self, ctx):
+        if ctx.hlo_text is None:
+            return []
+        return [f"f32 KV temporary {shp} at page-pool scale in the "
+                "compiled int8 serve step — dequantization escaped the "
+                "kernel's per-page tiles"
+                for shp in self.temporaries(ctx.hlo_text)]
+
+
 class NoOpMatching(Contract):
     """No HLO instruction line matching ``pattern`` — optionally only
     lines where some bracketed shape satisfies ``shape_test`` (e.g.
@@ -523,6 +565,14 @@ def fused_mlp_contracts(inter=MLP_INTER, min_rows=MLP_MIN_ROWS):
 
 SERVE_TMAX = 48
 SERVE_MIN_ROWS = 8
+# the serve probe's paged-KV layout (tools/compile_smoke._serve_engine:
+# GPTConfig.tiny heads=4 x hd=16, page_size=8, 13 pages). KV_MIN_ROWS
+# sits above the kernel's per-tile dequant (block_h<=4 rows of ps x hd)
+# and below both the whole-pool dequant (13 x 4 = 52 rows) and the
+# dense gather (slots x Pmax x heads = 48 rows).
+SERVE_PAGE_SIZE = 8
+SERVE_HEAD_DIM = 16
+SERVE_KV_MIN_ROWS = 24
 
 
 def serve_decode_contracts(tmax=SERVE_TMAX, min_rows=SERVE_MIN_ROWS):
@@ -611,20 +661,35 @@ def train_budget_contracts(model="gpt", dp=2, tp=2):
     ]
 
 
-def serve_budget_contracts(slots=SERVE_SLOTS, context=SERVE_TMAX):
+def serve_budget_contracts(slots=SERVE_SLOTS, context=SERVE_TMAX,
+                           kv_dtype=None):
     """Budget row for the paged decode step, priced by
     ``costmodel.predict_decode()`` on the same tiny-gpt spec the serve
-    smoke compiles."""
+    smoke compiles. ``kv_dtype="int8"`` re-derives the byte budget from
+    the quantized pool's traffic (1 byte/value + scales) — the int8
+    serve row's budget shrinks automatically with the KV footprint."""
     cm, topo, rate = _pricing()
     pred = cm.predict_decode(_train_spec("gpt"), topo, slots=slots,
-                             context=context, rate=rate)
-    src = f"costmodel.predict_decode(gpt, slots={slots}, Tmax={context})"
+                             context=context, rate=rate,
+                             kv_dtype=kv_dtype)
+    src = (f"costmodel.predict_decode(gpt, slots={slots}, Tmax={context}"
+           + (f", kv_dtype={kv_dtype}" if kv_dtype else "") + ")")
     return [
         MaxHloFlops(pred["flops_per_chip"],
                     SERVE_BUDGET_TOLERANCE["flops"], source=src),
         MaxHloBytes(pred["hlo_bytes"],
                     SERVE_BUDGET_TOLERANCE["bytes"], source=src),
     ]
+
+
+def serve_decode_int8_contracts():
+    """The quantized-KV serve row: everything the f32 row demands, plus
+    the no-f32-KV-temporary detector, with the byte budget re-derived
+    from the int8 pool footprint."""
+    return (serve_decode_contracts()
+            + [NoKvDequantTemporary(SERVE_PAGE_SIZE, SERVE_HEAD_DIM,
+                                    SERVE_KV_MIN_ROWS)]
+            + serve_budget_contracts(kv_dtype="int8"))
 
 
 # name -> contract list; tools/compile_smoke.py compiles each target and
@@ -642,6 +707,7 @@ CONTRACTS = {
     "train.transformer_big@dp2,tp2":
         sharded_train_contracts("transformer_big"),
     "serve.decode": serve_decode_contracts() + serve_budget_contracts(),
+    "serve.decode@int8": serve_decode_int8_contracts(),
     "serve.prefill": serve_prefill_contracts(),
     "mlp.fused": fused_mlp_contracts(),
 }
@@ -654,4 +720,5 @@ CONTRACTS = {
 CONTRACT_SNAPSHOTS = {
     "train.gpt@dp2,tp2": HloSnapshot("train.gpt@dp2,tp2"),
     "serve.decode": HloSnapshot("serve.decode"),
+    "serve.decode@int8": HloSnapshot("serve.decode@int8"),
 }

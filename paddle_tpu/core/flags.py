@@ -171,6 +171,13 @@ define_flag("serve_top_p", 0.0,
             "Default per-request nucleus (top-p) mass for sampled "
             "decoding; 0 = no nucleus cut. Per-request submit() values "
             "override; greedy requests (temperature 0) ignore it.")
+define_flag("serve_kv_dtype", "",
+            "Paged KV pool storage dtype for the serving engine: "
+            "'int8' stores quantized values with per-row scales beside "
+            "each page (roughly halving KV bytes vs bf16, 4x vs f32 — "
+            "doubled servable context), dequantized inside the fused "
+            "decode kernel and the XLA fallback alike. '' or 'f32' "
+            "keeps the unquantized pool (ServeConfig.cache_dtype).")
 # fleet serving (serving/fleet.py): a router in front of N ServingEngine
 # replicas — least-loaded dispatch, heartbeat liveness, failover replay
 # of in-flight requests, bounded respawn, graceful drain
@@ -320,6 +327,17 @@ define_flag("autoplan_hbm_fraction", 0.9,
             "Fraction of per-chip HBM the planner may budget; candidates "
             "whose memory estimate exceeds it are pruned with a recorded "
             "reason.")
+define_flag("quant_allreduce", "auto",
+            "Data-parallel gradient all-reduce strategy: 'auto' lets the "
+            "autoplan cost model choose between the f32 psum and the "
+            "chunked int8 quantize->psum->dequant collective per "
+            "topology (quantized wins on DCN-bandwidth dp axes, loses "
+            "on ICI); 'on' forces quantized, 'off' forces f32.")
+define_flag("quant_allreduce_chunk", 65536,
+            "Chunk size (elements) of the quantized all-reduce: each "
+            "chunk carries one shared f32 scale, so smaller chunks "
+            "track gradient dynamic range tighter at 4/chunk bytes of "
+            "scale overhead on the wire.")
 # Pallas tile autotuner (ops/pallas/autotune.py): sweep candidate block
 # sizes on first eager contact with a (kernel, shape, chip) triple, cache
 # winners, and feed measured achieved-flops/s into the autoplan cost model

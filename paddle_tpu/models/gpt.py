@@ -262,14 +262,17 @@ class GPTDecoder(GPT):
 
     # --- paged serving cache (slot/page-pool layout; ops/attention.py) ---
 
-    def init_paged_caches(self, num_pages, page_size, dtype=jnp.float32):
+    def init_paged_caches(self, num_pages, page_size, dtype=jnp.float32,
+                          kv_dtype=None):
         """Per-layer page pools for the serving engine. Unlike
         init_caches, capacity is pages (shared across slots), not a
-        padded [B, Tmax] rectangle per request."""
+        padded [B, Tmax] rectangle per request. kv_dtype=int8 stores
+        quantized values with per-row scales (ops/attention.py)."""
         from paddle_tpu.core.enforce import enforce
         enforce(self.cfg.seq_axis is None,
                 "paged decoding needs an unsharded sequence")
-        return [blk.attn.init_page_pool(num_pages, page_size, dtype)
+        return [blk.attn.init_page_pool(num_pages, page_size, dtype,
+                                        kv_dtype=kv_dtype)
                 for blk in self.blocks]
 
     def paged_decode_step(self, tokens, caches, page_table, lengths,

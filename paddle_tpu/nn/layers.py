@@ -620,15 +620,17 @@ class MultiHeadAttention(Module):
 
     # --- paged KV cache (serving fast path; ops/attention.py layout) ---
 
-    def init_page_pool(self, num_pages, page_size, dtype=jnp.float32):
+    def init_page_pool(self, num_pages, page_size, dtype=jnp.float32,
+                       kv_dtype=None):
         """This layer's slice of the paged serving cache:
-        {"k","v"} [num_pages, H, page_size, hd]. Reads the embed dim from
-        the declaration (ParamSpec), so it works outside apply() — the
-        serving engine allocates pools before any forward runs."""
+        {"k","v"} [num_pages, H, page_size, hd] (plus per-row
+        {"k_scale","v_scale"} for kv_dtype=int8). Reads the embed dim
+        from the declaration (ParamSpec), so it works outside apply() —
+        the serving engine allocates pools before any forward runs."""
         from paddle_tpu.ops.attention import init_page_pool
         hd = self._params["wq"].shape[0] // self.num_heads
         return init_page_pool(num_pages, self.num_heads, page_size, hd,
-                              dtype)
+                              dtype, kv_dtype=kv_dtype)
 
     def paged_decode_step(self, x_t, pool, page_table, att_lengths,
                           write_pages, write_offsets):
@@ -650,7 +652,9 @@ class MultiHeadAttention(Module):
         pool = paged_write(pool, proj("k"), proj("v"), write_pages,
                            write_offsets)
         ctx = paged_decode_attention(q, pool["k"], pool["v"], page_table,
-                                     att_lengths)
+                                     att_lengths,
+                                     k_scale=pool.get("k_scale"),
+                                     v_scale=pool.get("v_scale"))
         return self._project(ctx.reshape(s, 1, e), "o"), pool
 
     def paged_prefill(self, x, pool, page_ids, offsets):
@@ -723,11 +727,16 @@ class MultiHeadAttention(Module):
             ctx = scaled_dot_product_attention(q, k, v, causal=True)
         # full-history path: pool pages were just updated with this
         # chunk, so the gather sees prefix + chunk at absolute positions
+        # (int8 pools dequantize the gathered pages through the same
+        # per-row scales the decode kernel reads)
         tk = page_rows.shape[1] * pool["k"].shape[2]
-        kf = jnp.moveaxis(pool["k"][page_rows], 2, 1).reshape(
-            b, self.num_heads, tk, hd)
-        vf = jnp.moveaxis(pool["v"][page_rows], 2, 1).reshape(
-            b, self.num_heads, tk, hd)
+        kg, vg = pool["k"][page_rows], pool["v"][page_rows]
+        if "k_scale" in pool:
+            from paddle_tpu.ops.attention import dequantize_pages
+            kg = dequantize_pages(kg, pool["k_scale"][page_rows])
+            vg = dequantize_pages(vg, pool["v_scale"][page_rows])
+        kf = jnp.moveaxis(kg, 2, 1).reshape(b, self.num_heads, tk, hd)
+        vf = jnp.moveaxis(vg, 2, 1).reshape(b, self.num_heads, tk, hd)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                             kf.astype(jnp.float32)) / (hd ** 0.5)
         keep = (jnp.arange(tk)[None, None, None, :]

@@ -64,6 +64,17 @@ CATALOG = {
     "checkpoint.torn_skips": MetricSpec(
         "counter", (),
         "Uncommitted (torn) checkpoint steps skipped at discovery."),
+    # parallel/communicator.py
+    "collective.quant_bytes": MetricSpec(
+        "counter", ("direction",),
+        "Bytes the quantized dp all-reduce moved on the wire (int8 "
+        "payload plus per-chunk scales), by direction (send | recv) — "
+        "compare against grad elements x 4 for the f32 baseline."),
+    "collective.quant_degraded": MetricSpec(
+        "counter", (),
+        "Gradient syncs that degraded from the quantized int8 "
+        "all-reduce to plain f32 psum (the collective.quant fault "
+        "point, or guardian-driven parity fallback)."),
     # tools/graft_lint.py
     "contracts.violations": MetricSpec(
         "counter", ("contract",),
@@ -145,6 +156,13 @@ CATALOG = {
     "pallas.fallback": MetricSpec(
         "counter", ("kernel",),
         "Pallas kernel refusals that fell back to the XLA formulation."),
+    # parallel/communicator.py
+    "quant.overflow_clamps": MetricSpec(
+        "counter", (),
+        "Gradient values the quantized all-reduce clamped at the int8 "
+        "rail (|round(x/scale)| > 127). Zero in healthy operation — the "
+        "shared absmax scale covers every rank's range; non-zero flags "
+        "non-finite or scale-corrupting gradients for the guardian."),
     # core/retry.py
     "retry.attempts": MetricSpec(
         "counter", ("op",), "Retried attempts of remote I/O operations."),
@@ -163,6 +181,15 @@ CATALOG = {
         "gauge", (),
         "Fraction of retired requests that met every configured SLO "
         "(slo_ttft_s / slo_token_latency_s)."),
+    "serve.kv_quant_degraded": MetricSpec(
+        "counter", (),
+        "Quantized-KV admissions degraded to private pages by the "
+        "quant.kv_write fault point (no prefix-cache mapping or "
+        "publish for that request)."),
+    "serve.kv_quant_pages": MetricSpec(
+        "gauge", (),
+        "KV pages currently allocated out of an int8-quantized page "
+        "pool (0 / absent when serve_kv_dtype is f32)."),
     "serve.page_stalls": MetricSpec(
         "counter", ("where",),
         "Admissions or decode growths that waited on a free KV page."),

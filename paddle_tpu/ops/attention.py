@@ -94,20 +94,78 @@ def _as_key_padding_mask(mask, batch, tk):
 # share one batch, and a finished request frees its pages without reshaping
 # anything — the jitted serve step's shapes never change across admissions
 # (paddle_tpu/serving/ owns the host-side allocator).
+#
+# Quantized pools (kv_dtype=int8) add {"k_scale","v_scale"} f32
+# [num_pages, page_size] beside the int8 value tensors: one symmetric
+# absmax scale per (page, token-row), shared across heads and head_dim.
+# Row granularity makes the incremental decode write exact (each new token
+# sets its own int8 row + one scale scalar; existing rows are untouched),
+# and keying scales by page id means prefix-cache sharing, copy-on-write
+# and recovery-rebuild all carry scales for free — they only ever move
+# whole pages.
+
+
+def quantized_pool(pool):
+    """True iff `pool` is an int8 pool carrying per-row scales."""
+    return "k_scale" in pool
+
+
+def quantize_kv_rows(x):
+    """Symmetric per-token-row int8 quantization. x: [T, H, hd] ->
+    (q int8 [T, H, hd], scale f32 [T]) with scale = absmax/127. An
+    all-zero row stores scale 0 and dequantizes to exactly zero."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(1, 2))
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / jnp.maximum(scale, 1e-30)[:, None, None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_pages(pages, scales):
+    """Dequantize gathered pages. pages: [..., H, ps, hd] int8 with
+    leading gather dims; scales: [..., ps] f32 aligned on those dims.
+    -> f32 of pages.shape."""
+    return pages.astype(jnp.float32) * scales[..., None, :, None]
 
 
 def init_page_pool(num_pages, num_heads, page_size, head_dim,
-                   dtype=jnp.float32):
-    """One layer's KV page pool: {"k","v"} [num_pages, H, page_size, hd]."""
+                   dtype=jnp.float32, kv_dtype=None):
+    """One layer's KV page pool: {"k","v"} [num_pages, H, page_size, hd].
+    kv_dtype=int8 adds {"k_scale","v_scale"} f32 [num_pages, page_size]
+    (per-row symmetric scales) and stores values as int8."""
     shape = (num_pages, num_heads, page_size, head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if kv_dtype is None or jnp.dtype(kv_dtype) == jnp.dtype(dtype):
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(kv_dtype) != jnp.dtype(jnp.int8):
+        raise ValueError(f"unsupported kv_dtype {kv_dtype!r} "
+                         "(supported: int8, or None for the pool dtype)")
+    sshape = (num_pages, page_size)
+    return {"k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32)}
 
 
 def paged_write(pool, k_t, v_t, page_ids, offsets):
     """Scatter per-token K/V into pool pages. k_t/v_t: [T, H, hd];
     page_ids/offsets: [T] int32. An out-of-range page id DROPS the write
     (mode="drop") — the engine routes inactive slots and pad positions to
-    page id == num_pages on purpose."""
+    page id == num_pages on purpose. On a quantized pool each row is
+    quantized on the way in and its scale written beside it."""
+    if quantized_pool(pool):
+        k_q, k_s = quantize_kv_rows(k_t)
+        v_q, v_s = quantize_kv_rows(v_t)
+        return {
+            "k": pool["k"].at[page_ids, :, offsets, :].set(
+                k_q, mode="drop"),
+            "v": pool["v"].at[page_ids, :, offsets, :].set(
+                v_q, mode="drop"),
+            "k_scale": pool["k_scale"].at[page_ids, offsets].set(
+                k_s, mode="drop"),
+            "v_scale": pool["v_scale"].at[page_ids, offsets].set(
+                v_s, mode="drop"),
+        }
     return {
         "k": pool["k"].at[page_ids, :, offsets, :].set(
             k_t.astype(pool["k"].dtype), mode="drop"),
@@ -121,25 +179,34 @@ def copy_pages(pool, src_ids, dst_ids):
     engine's copy-on-write primitive: a slot about to write into a
     prefix-cache-shared page first duplicates it to a private page.
     src_ids/dst_ids: [M] int32. An out-of-range dst DROPS the copy
-    (mode="drop"), matching paged_write's inactive-slot convention."""
-    return {
-        "k": pool["k"].at[dst_ids].set(pool["k"][src_ids], mode="drop"),
-        "v": pool["v"].at[dst_ids].set(pool["v"][src_ids], mode="drop"),
-    }
+    (mode="drop"), matching paged_write's inactive-slot convention.
+    Generic over the pool's entries, so a quantized pool's per-row
+    scales travel with their int8 pages (already-quantized content is
+    copied bit-exact — no requantization error on CoW)."""
+    return {name: arr.at[dst_ids].set(arr[src_ids], mode="drop")
+            for name, arr in pool.items()}
 
 
-def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale):
+def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale,
+                         k_scale=None, v_scale=None):
     """Gather-and-mask reference: pull every table page densely and mask by
     length. Materializes [S, H, Pmax*ps]-scale score temporaries — the
     parity oracle for the Pallas kernel and the CPU fallback, never the
     serving hot path (compile_smoke's serve probe asserts the kernel path
-    holds no such temporary, with this path as the positive control)."""
+    holds no such temporary, with this path as the positive control).
+    k_scale/v_scale [N, ps] dequantize int8 pools on the same gathered
+    pages the kernel reads."""
     s_slots, h, hd = q.shape
     page_size = k_pages.shape[2]
     p_max = page_table.shape[1]
     t = p_max * page_size
-    k = jnp.moveaxis(k_pages[page_table], 2, 1).reshape(s_slots, h, t, hd)
-    v = jnp.moveaxis(v_pages[page_table], 2, 1).reshape(s_slots, h, t, hd)
+    kg = k_pages[page_table]                   # [S, Pmax, H, ps, hd]
+    vg = v_pages[page_table]
+    if k_scale is not None:
+        kg = dequantize_pages(kg, k_scale[page_table])
+        vg = dequantize_pages(vg, v_scale[page_table])
+    k = jnp.moveaxis(kg, 2, 1).reshape(s_slots, h, t, hd)
+    v = jnp.moveaxis(vg, 2, 1).reshape(s_slots, h, t, hd)
     scores = jnp.einsum("shd,shtd->sht", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     valid = (jnp.arange(t)[None, :] < lengths[:, None])[:, None, :]
@@ -156,12 +223,16 @@ def _paged_attention_xla(q, k_pages, v_pages, page_table, lengths, scale):
 
 @register_op("paged_decode_attention")
 def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                           scale=None):
+                           scale=None, k_scale=None, v_scale=None):
     """Single-query attention over a paged KV cache (the serving decode
     read). q: [S, H, hd] — one query token per slot; k_pages/v_pages:
     [N, H, page_size, hd]; page_table: [S, Pmax] int32 with IN-RANGE
     entries everywhere (0 for unallocated); lengths: [S] int32 valid
     token counts (0 = inactive slot -> exactly-zero output).
+    k_scale/v_scale: [N, page_size] f32 per-row scales when the pool is
+    int8 (init_page_pool(kv_dtype=int8)); both paths dequantize the same
+    gathered pages, so kernel-vs-fallback parity holds for quantized
+    pools too.
 
     On TPU (or under pallas_interpret): the Pallas kernel gathers only
     live pages through the page table and runs flash-style online softmax
@@ -186,9 +257,9 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths,
             paged_decode_attention_tpu)
         return paged_decode_attention_tpu(
             q, k_pages, v_pages, page_table, lengths, scale,
-            interpret=interpret)
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret)
     return _paged_attention_xla(q, k_pages, v_pages, page_table, lengths,
-                                scale)
+                                scale, k_scale=k_scale, v_scale=v_scale)
 
 
 @register_op("multihead_attention")

@@ -203,6 +203,18 @@ def tail_valid_cols(idx, block, total, shape, axis=1):
     return pos < total
 
 
+# ------------------------------------------------- quantized-tile primitive
+
+def dequant_rows(x, row_scales):
+    """Dequantize a loaded [H, R, D] int8 value tile against its per-row
+    symmetric scales ([R], one scale per token row shared across heads
+    and head_dim — the paged-KV layout of ops/attention.py). Lives here
+    rather than in the decode kernel because it is the tiled-primitive
+    counterpart of quantize_kv_rows: any future int8 kernel (prefill
+    chunk, flash over quantized caches) reuses the same contract."""
+    return x.astype(jnp.float32) * row_scales[None, :, None]
+
+
 # ------------------------------------------- online-softmax (m, l) combiner
 
 def softmax_init(m_scr, l_scr, *acc_scrs):

@@ -13,10 +13,19 @@ positive control).
 Layout: q [S, H, hd] (one query token per slot), k_pages/v_pages
 [N, H, page_size, hd] (the pool the whole engine shares), page_table
 [S, Pmax] int32, lengths [S] int32 (tokens valid in the cache INCLUDING
-the one written this step). Grid (S, Pmax) with the page axis innermost
-(sequential on TPU) carrying the softmax state. fp32 statistics and
-accumulation regardless of the pool dtype (bf16 pools re-read through
-f32 math — same contract as flash_attention).
+the one written this step). Grid (S, H/block_h, Pmax) with the page axis
+innermost (sequential on TPU) carrying the softmax state; the head axis
+is the autotuned tile knob (``block_h``, default all heads). fp32
+statistics and accumulation regardless of the pool dtype (bf16 pools
+re-read through f32 math — same contract as flash_attention).
+
+Int8 pools ride the same (m, l, acc) pipeline: the per-row scales
+([N, page_size] beside the pool) come in as two extra gathered blocks
+and ``core.dequant_rows`` folds them into the loaded K/V tiles before
+the score matmul — dequant is a tile-level extension of the existing
+pipeline, not a separate kernel (the TPP argument). The quantized
+variant registers under its own autotune shape-sig (``kv=int8``), so
+sweeps and measured rates feed the cost model per dtype.
 
 Every page_table entry must be an IN-RANGE page index (0 for unallocated
 slots/pages is fine — the kernel skips blocks past `length`, but the
@@ -31,16 +40,21 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from paddle_tpu.ops.pallas.core import (NEG_INF, kernel_call, pltpu,
-                                        softmax_finalize, softmax_init,
-                                        softmax_update)
+from paddle_tpu.ops.pallas.core import (NEG_INF, dequant_rows, kernel_call,
+                                        pltpu, softmax_finalize,
+                                        softmax_init, softmax_update)
 
 
-def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, page_size):
+def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, *refs,
+                   scale, page_size, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = refs
     s = pl.program_id(0)
-    j = pl.program_id(1)
-    nj = pl.num_programs(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
 
     @pl.when(j == 0)
     def _init():
@@ -50,12 +64,16 @@ def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * page_size < length)
     def _step():
-        q = q_ref[0].astype(jnp.float32)               # [H, hd]
-        k = k_ref[0].astype(jnp.float32)               # [H, ps, hd]
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)               # [BH, hd]
+        if quantized:
+            k = dequant_rows(k_ref[0], ks_ref[0])      # [BH, ps, hd]
+            v = dequant_rows(v_ref[0], vs_ref[0])
+        else:
+            k = k_ref[0].astype(jnp.float32)           # [BH, ps, hd]
+            v = v_ref[0].astype(jnp.float32)
         sc = jax.lax.dot_general(
             q, k, (((1,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # [H, ps]
+            preferred_element_type=jnp.float32) * scale  # [BH, ps]
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         valid = pos < length                 # [1, ps] broadcasts over heads
@@ -63,40 +81,86 @@ def _decode_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                                   jnp.broadcast_to(valid, sc.shape))
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)         # [H, hd]
+            preferred_element_type=jnp.float32)         # [BH, hd]
 
     @pl.when(j == nj - 1)
     def _finalize():
         o_ref[0] = softmax_finalize(l_scr[:], acc_scr[:], o_ref.dtype)
 
 
+def _tuned_block_h(q, k_pages, page_table, runner):
+    """Head-tile size for the decode grid, autotuned per (shape, pool
+    dtype, chip). The shape-sig carries ``kv=<dtype>`` so the int8 kernel
+    is its own cache row — its sweeps/measured rates feed the cost model
+    separately from the f32 kernel's."""
+    s_slots, h, hd = q.shape
+    from paddle_tpu.core.flags import get_flag
+    if not get_flag("autotune"):
+        return h
+    from paddle_tpu.ops.pallas import autotune
+    page_size = k_pages.shape[2]
+    p_max = page_table.shape[1]
+    sig = autotune.signature(s=s_slots, h=h, hd=hd, ps=page_size,
+                             pmax=p_max, kv=k_pages.dtype.name)
+    cands = [{"block_h": b} for b in (1, 2, 4, 8, 16)
+             if b < h and h % b == 0]
+    blocks = autotune.tuned_blocks(
+        "decode_attention", sig, defaults={"block_h": h}, candidates=cands,
+        runner=runner, flops=4.0 * s_slots * h * p_max * page_size * hd,
+        args=(q, k_pages, page_table))
+    return blocks["block_h"]
+
+
 def paged_decode_attention_tpu(q, k_pages, v_pages, page_table, lengths,
-                               scale, interpret=None):
+                               scale, k_scale=None, v_scale=None,
+                               interpret=None, block_h=None):
     """q [S, H, hd]; k_pages/v_pages [N, H, ps, hd]; page_table [S, Pmax]
-    int32 (in-range everywhere); lengths [S] int32. -> [S, H, hd]."""
+    int32 (in-range everywhere); lengths [S] int32; k_scale/v_scale
+    [N, ps] f32 per-row scales for int8 pools (None = unquantized pool).
+    -> [S, H, hd]."""
     if interpret is None:
         from paddle_tpu.core.flags import get_flag
         interpret = get_flag("pallas_interpret")
+    quantized = k_scale is not None
+    if block_h is None:
+        block_h = _tuned_block_h(
+            q, k_pages, page_table,
+            lambda block_h: paged_decode_attention_tpu(
+                q, k_pages, v_pages, page_table, lengths, scale,
+                k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+                block_h=block_h))
     s_slots, h, hd = q.shape
     page_size = k_pages.shape[2]
     p_max = page_table.shape[1]
+    bh = block_h if h % block_h == 0 else h
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               page_size=page_size)
+                               page_size=page_size, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, bh, hd), lambda s, b, j, pt, ln: (s, b, 0)),
+        pl.BlockSpec((1, bh, page_size, hd),
+                     lambda s, b, j, pt, ln: (pt[s, j], b, 0, 0)),
+        pl.BlockSpec((1, bh, page_size, hd),
+                     lambda s, b, j, pt, ln: (pt[s, j], b, 0, 0)),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, page_size),
+                         lambda s, b, j, pt, ln: (pt[s, j], 0)),
+            pl.BlockSpec((1, page_size),
+                         lambda s, b, j, pt, ln: (pt[s, j], 0)),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(s_slots, p_max),
-        in_specs=[
-            pl.BlockSpec((1, h, hd), lambda s, j, pt, ln: (s, 0, 0)),
-            pl.BlockSpec((1, h, page_size, hd),
-                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
-            pl.BlockSpec((1, h, page_size, hd),
-                         lambda s, j, pt, ln: (pt[s, j], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, h, hd), lambda s, j, pt, ln: (s, 0, 0)),
+        grid=(s_slots, h // bh, p_max),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bh, hd),
+                               lambda s, b, j, pt, ln: (s, b, 0)),
         scratch_shapes=[
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, 1), jnp.float32),
-            pltpu.VMEM((h, hd), jnp.float32),
+            pltpu.VMEM((bh, 1), jnp.float32),
+            pltpu.VMEM((bh, 1), jnp.float32),
+            pltpu.VMEM((bh, hd), jnp.float32),
         ],
     )
     return kernel_call(
@@ -106,4 +170,4 @@ def paged_decode_attention_tpu(q, k_pages, v_pages, page_table, lengths,
         out_shape=jax.ShapeDtypeStruct((s_slots, h, hd), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pages, v_pages)
+      *operands)

@@ -207,25 +207,34 @@ def decode_flops(spec, slots, context):
     return spec.layers * (proj + attn) + logits
 
 
-def decode_hlo_bytes(spec, slots, context):
+def decode_hlo_bytes(spec, slots, context, kv_dtype=None):
     """Traffic estimate for one decode step: every parameter is read
     once (batch=slots is too small to amortize below one sweep) and the
     K/V cache pages for ``context`` positions are read and written
-    back. The MaxHloBytes serve budget multiplies by a tolerance."""
+    back. ``kv_dtype="int8"`` prices the quantized pool: 1 byte/element
+    plus one f32 per-row scale per cached token (the ops/attention.py
+    layout) in place of ``param_bytes`` per element — the ~4x KV-traffic
+    cut the re-derived MaxHloBytes serve budget encodes. The budget
+    contract multiplies by a tolerance."""
     counts = param_counts(spec)
     params = (counts["embedding"] + spec.layers * counts["per_layer"]
               + counts["head"]) * spec.param_bytes
-    kv = (2 * spec.layers * slots * context * spec.hidden
-          * 2 * spec.param_bytes)
+    if str(kv_dtype or "") == "int8":
+        row_bytes = spec.hidden * 1 + 4          # int8 values + f32 scale
+    else:
+        row_bytes = spec.hidden * spec.param_bytes
+    kv = 2 * spec.layers * slots * context * row_bytes * 2
     return params + kv
 
 
-def predict_decode(spec, topology, slots, context, rate=None):
+def predict_decode(spec, topology, slots, context, rate=None,
+                   kv_dtype=None):
     """Score one serving decode step the way :func:`predict` scores a
     train step: flops + traffic estimates and a step-seconds figure.
     ``rate=None`` prices compute at the autotune-measured achieved rate
     (falling back to analytic); passing an explicit rate keeps the call
-    stdlib-pure — what the budget contracts do."""
+    stdlib-pure — what the budget contracts do. ``kv_dtype`` prices the
+    KV pool per :func:`decode_hlo_bytes`."""
     flops = float(decode_flops(spec, slots, context))
     if rate is None:
         rate, rate_source = achieved_rate(topology)
@@ -234,7 +243,9 @@ def predict_decode(spec, topology, slots, context, rate=None):
     return {
         "step_s": flops / rate,
         "flops_per_chip": flops,
-        "hlo_bytes": float(decode_hlo_bytes(spec, slots, context)),
+        "hlo_bytes": float(decode_hlo_bytes(spec, slots, context,
+                                            kv_dtype=kv_dtype)),
+        "kv_dtype": str(kv_dtype or "f32"),
         "rate_source": rate_source,
         "rate_flops_s": rate,
     }
@@ -242,20 +253,48 @@ def predict_decode(spec, topology, slots, context, rate=None):
 
 # ----------------------------------------------------------- collectives
 
-def collective_bytes(spec, dp, tp, pp, microbatches=1):
+# compute overhead of the chunked int8 collective, in simple ops per
+# gradient element: abs/max + divide + round + clip + cast on the way
+# out, int32 accumulate + scale-multiply back — ~8 elementwise ops
+QUANT_ALLREDUCE_OPS_PER_ELEM = 8.0
+QUANT_CHUNK_DEFAULT = 65536
+
+
+def dp_grad_elements(spec, tp, pp):
+    """Gradient elements one dp all-reduce exchanges per chip (the
+    tp/pp-sharded parameter count) — what both collective strategies
+    quantify over."""
+    counts = param_counts(spec)
+    layers_local = -(-spec.layers // pp)
+    return (counts["embedding"] / tp
+            + layers_local * counts["per_layer"] / tp
+            + counts["head"])
+
+
+def collective_bytes(spec, dp, tp, pp, microbatches=1,
+                     dp_collective="f32", quant_chunk=QUANT_CHUNK_DEFAULT):
     """Per-chip bytes moved per step, by mesh axis. Ring all-reduce of N
     payload bytes moves 2(n-1)/n x N per chip; all-gather/reduce-scatter
     halves (n-1)/n x N each — the dp grad sync is priced as the full
     all-reduce, tp as the Megatron per-layer activation all-reduces, pp
-    as p2p boundary sends."""
+    as p2p boundary sends.
+
+    ``dp_collective`` picks the dp strategy the EQuARX way
+    (arxiv 2506.17615 — quantized all-reduce is a planner decision):
+    "f32" moves param_bytes per gradient element; "int8" moves 1 byte
+    per element plus one f32 scale per ``quant_chunk`` elements (the
+    parallel/communicator.py quantized_psum wire layout)."""
     out = {}
     counts = param_counts(spec)
     layers_local = -(-spec.layers // pp)
     local_b = max(1, spec.batch // dp)
     if dp > 1:
-        grad_payload = (counts["embedding"] / tp
-                        + layers_local * counts["per_layer"] / tp
-                        + counts["head"]) * spec.param_bytes
+        elems = dp_grad_elements(spec, tp, pp)
+        if dp_collective == "int8":
+            chunk = max(int(quant_chunk), 1)
+            grad_payload = elems + (-(-elems // chunk)) * 4
+        else:
+            grad_payload = elems * spec.param_bytes
         out["dp"] = 2.0 * (dp - 1) / dp * grad_payload
     if tp > 1:
         act = local_b * spec.seq * spec.hidden * spec.act_bytes
@@ -299,7 +338,8 @@ def achieved_rate(topology):
 
 
 def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b",
-            rate=None):
+            rate=None, dp_collective="f32",
+            quant_chunk=QUANT_CHUNK_DEFAULT):
     """Score one candidate: predicted step seconds + the estimates that
     produced it. dp is the outermost axis — it crosses slice boundaries
     first on a multi-slice topology, so it prices at DCN bandwidth.
@@ -308,7 +348,13 @@ def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b",
     autotuner when its cache has entries for this chip family (the
     ``rate_source`` field says which constant priced the candidate);
     passing ``rate`` explicitly skips that lookup and keeps the call
-    stdlib-pure (what the budget contracts do)."""
+    stdlib-pure (what the budget contracts do).
+
+    ``dp_collective="int8"`` prices the chunked quantized all-reduce:
+    ~4x fewer dp wire bytes, paid for with
+    QUANT_ALLREDUCE_OPS_PER_ELEM elementwise ops per gradient element of
+    quantize/dequant compute — the trade that makes quantization win on
+    DCN-bandwidth dp axes and lose on ICI ones."""
     flops_c = train_flops(spec) / (dp * tp * pp)
     if rate is None:
         rate, rate_source = achieved_rate(topology)
@@ -316,16 +362,24 @@ def predict(spec, topology, dp, tp, pp, microbatches=1, schedule="1f1b",
         rate_source = "fixed"
     compute_s = flops_c / rate
     bubble = (pp - 1) / max(1, microbatches) if pp > 1 else 0.0
-    coll = collective_bytes(spec, dp, tp, pp, microbatches)
+    coll = collective_bytes(spec, dp, tp, pp, microbatches,
+                            dp_collective=dp_collective,
+                            quant_chunk=quant_chunk)
     multi = topology.num_slices > 1
     coll_s = sum(
         b / topology.axis_bandwidth(crosses_slices=(ax == "dp" and multi))
         for ax, b in coll.items())
+    quant_s = 0.0
+    if dp > 1 and dp_collective == "int8":
+        quant_s = (QUANT_ALLREDUCE_OPS_PER_ELEM
+                   * dp_grad_elements(spec, tp, pp) / rate)
     mem = chip_memory(spec, dp, tp, pp, microbatches, schedule)
     return {
-        "step_s": compute_s * (1.0 + bubble) + coll_s,
+        "step_s": compute_s * (1.0 + bubble) + coll_s + quant_s,
         "compute_s": compute_s,
         "collective_s": coll_s,
+        "quant_s": quant_s,
+        "dp_collective": dp_collective if dp > 1 else "none",
         "bubble_fraction": bubble,
         "flops_per_chip": flops_c,
         "hlo_bytes": float(train_hlo_bytes(spec, dp, tp, pp)),

@@ -32,13 +32,17 @@ PP_SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 @dataclasses.dataclass
 class Candidate:
-    """One (dp, tp, pp) factorization, scored or pruned-with-reasons."""
+    """One (dp, tp, pp) factorization, scored or pruned-with-reasons.
+    ``dp_collective`` records the gradient-exchange strategy the cost
+    model chose for the dp axis ("f32" | "int8"; defaulted for JSON
+    records written before quantized collectives existed)."""
     dp: int
     tp: int
     pp: int
     schedule: str = "1f1b"
     microbatches: int = 1
     feasible: bool = True
+    dp_collective: str = "f32"
     reasons: list = dataclasses.field(default_factory=list)
     predicted: dict = dataclasses.field(default_factory=dict)
 
@@ -90,9 +94,14 @@ def _pick_microbatches(local_batch, pp):
     return divs[-1]
 
 
-def _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable_hbm):
+def _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable_hbm,
+           quant_allreduce="auto"):
     """Feasibility of one candidate -> (Candidate). Never raises: every
-    infeasibility is a recorded reason."""
+    infeasibility is a recorded reason. ``quant_allreduce`` ("auto" |
+    "on" | "off") governs the dp gradient-exchange strategy: "auto"
+    prices BOTH the f32 and the chunked-int8 collective and keeps the
+    cheaper (the EQuARX decision — quantized bytes change which mesh
+    wins), recording why in the candidate's decision record."""
     cand = Candidate(dp=dp, tp=tp, pp=pp, schedule=schedule)
     reasons = cand.reasons
     if spec.batch % dp:
@@ -125,8 +134,17 @@ def _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable_hbm):
     if reasons:
         cand.feasible = False
         return cand
-    pred = costmodel.predict(spec, topology, dp, tp, pp,
-                             cand.microbatches, cand.schedule)
+    strategies = {"auto": ("f32", "int8"), "on": ("int8",),
+                  "off": ("f32",)}.get(quant_allreduce, ("f32",))
+    if dp == 1:
+        strategies = ("f32",)       # no dp exchange to quantize
+    preds = {s: costmodel.predict(spec, topology, dp, tp, pp,
+                                  cand.microbatches, cand.schedule,
+                                  dp_collective=s)
+             for s in strategies}
+    strat = min(preds, key=lambda s: preds[s]["step_s"])
+    pred = preds[strat]
+    cand.dp_collective = strat
     if pred["mem_bytes"] > usable_hbm:
         cand.feasible = False
         reasons.append(
@@ -135,6 +153,18 @@ def _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable_hbm):
     cand.predicted = {k: v for k, v in pred.items()
                       if k not in ("mem", "collective_bytes")}
     cand.predicted["collective_bytes"] = pred["collective_bytes"]
+    if dp > 1 and len(preds) > 1:
+        other = next(s for s in preds if s != strat)
+        cand.predicted["dp_collective_reason"] = (
+            f"{strat} all-reduce predicted "
+            f"{preds[strat]['step_s'] * 1e3:.3f} ms/step vs "
+            f"{preds[other]['step_s'] * 1e3:.3f} for {other} "
+            f"(dp wire bytes {preds[strat]['collective_bytes']['dp']:.3g}"
+            f" vs {preds[other]['collective_bytes']['dp']:.3g}, quantize "
+            f"overhead {preds['int8']['quant_s'] * 1e3:.3f} ms)")
+    elif dp > 1:
+        cand.predicted["dp_collective_reason"] = (
+            f"{strat} forced by quant_allreduce={quant_allreduce}")
     return cand
 
 
@@ -228,13 +258,19 @@ class MeshPlan:
     # -- inspection ---------------------------------------------------
     def summary(self):
         """Compact record for bench rows / run logs."""
-        return {"axes": dict(self.axes), "schedule": self.schedule,
-                "microbatches": self.microbatches,
-                "topology": self.topology.name,
-                "step_s": round(self.predicted.get("step_s", 0.0), 6),
-                "mem_gib": round(
-                    self.predicted.get("mem_bytes", 0) / topo_lib.GIB, 3),
-                "reason": self.reason}
+        out = {"axes": dict(self.axes), "schedule": self.schedule,
+               "microbatches": self.microbatches,
+               "topology": self.topology.name,
+               "step_s": round(self.predicted.get("step_s", 0.0), 6),
+               "mem_gib": round(
+                   self.predicted.get("mem_bytes", 0) / topo_lib.GIB, 3),
+               "reason": self.reason}
+        if self.dp > 1:
+            out["dp_collective"] = self.predicted.get("dp_collective",
+                                                      "f32")
+            out["dp_wire_bytes"] = self.predicted.get(
+                "collective_bytes", {}).get("dp")
+        return out
 
     def describe(self, top=None):
         """Human-readable ranked candidate table."""
@@ -297,7 +333,7 @@ class NoFeasiblePlanError(ValueError):
 
 
 def plan(spec, topology=None, devices=None, allow_pp=True,
-         schedule="1f1b", hbm_fraction=None):
+         schedule="1f1b", hbm_fraction=None, quant_allreduce=None):
     """Search dp x tp x pp factorizations of the device count and return
     the argmin-predicted-step-time :class:`MeshPlan`.
 
@@ -305,19 +341,24 @@ def plan(spec, topology=None, devices=None, allow_pp=True,
     over the live `jax.devices()` while a preset supplies per-chip
     characteristics). `allow_pp=False` prunes pipeline candidates with
     a recorded reason — for callers whose train step has no pipeline
-    executor.
+    executor. `quant_allreduce` (default: the flag) governs the dp
+    gradient-exchange strategy per :func:`_check`.
     """
     t0 = time.perf_counter()
     if topology is None or isinstance(topology, str):
         topology = topo_lib.get_topology(topology)
-    if hbm_fraction is None:
+    if hbm_fraction is None or quant_allreduce is None:
         from paddle_tpu.core.flags import get_flag
-        hbm_fraction = get_flag("autoplan_hbm_fraction")
+        if hbm_fraction is None:
+            hbm_fraction = get_flag("autoplan_hbm_fraction")
+        if quant_allreduce is None:
+            quant_allreduce = get_flag("quant_allreduce")
     n = int(devices) if devices else topology.num_chips
     usable = topology.hbm_bytes * hbm_fraction
     cands = []
     for dp, tp, pp in factorizations(n):
-        c = _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable)
+        c = _check(spec, topology, dp, tp, pp, allow_pp, schedule, usable,
+                   quant_allreduce=quant_allreduce)
         _metrics.counter("autoplan.candidates").inc(
             outcome="scored" if c.feasible else "pruned")
         cands.append(c)
@@ -337,7 +378,9 @@ def plan(spec, topology=None, devices=None, allow_pp=True,
         f"(~{win.step_s * 1e3:.2f} ms/step, "
         f"{win.predicted.get('mem_bytes', 0) / topo_lib.GIB:.2f} GiB/chip"
         + (f", {win.schedule} x{win.microbatches} microbatches"
-           if win.pp > 1 else "") + ")")
+           if win.pp > 1 else "")
+        + (f", {win.dp_collective} dp all-reduce" if win.dp > 1 else "")
+        + ")")
     out = MeshPlan(model=spec.name, topology=topology,
                    axes=win.mesh_axes(), schedule=win.schedule,
                    microbatches=win.microbatches, predicted=win.predicted,

@@ -21,6 +21,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.testing.chaos import fault_point
+
 
 def _tmap(f, *trees):
     return jax.tree_util.tree_map(f, *trees)
@@ -222,3 +225,155 @@ class GeoSGD:
         since = jnp.where(do_sync, 0, since)
         return loss, params, {"inner": inner, "anchor": anchor,
                               "since_sync": since}, aux
+
+
+# --- quantized dp all-reduce (the EQuARX direction, arXiv:2506.17615) ----
+#
+# collective.compressed_psum's int8 variant carries ONE per-tensor scale
+# (a pmax round-trip per tensor, and one outlier ruins the whole tensor's
+# resolution). The chunked collective below is the planner-visible
+# strategy: the flattened gradient is cut into fixed-size chunks, each
+# chunk carries its own shared f32 scale (4 bytes of overhead per chunk
+# on the wire), values travel as int8 and are summed in int32. The
+# autoplan cost model prices exactly this layout (elems x 1B + chunks x
+# 4B) so search.py can CHOOSE it where the dp axis crosses slices (DCN
+# bandwidth) and reject it on ICI, where the quantize/dequant compute
+# overhead exceeds the wire saving. Same stock-XLA caveat as
+# compressed_psum: the int32 psum means semantic parity, not true int8
+# wire traffic, off EQuARX-capable backends.
+
+
+def _quant_chunked(flat, chunk):
+    n = flat.shape[0]
+    nch = -(-n // chunk)
+    return jnp.pad(flat, (0, nch * chunk - n)).reshape(nch, chunk), n
+
+
+def quantized_psum(x, axis_name, chunk=None):
+    """Chunked int8 quantize->psum->dequant cross-replica sum. Each chunk
+    quantizes against the axis-wide absmax of that chunk (lax.pmax), so
+    every shard agrees on the scale and integer sums are exact. Returns
+    ``(sum_like_x, clamps)`` — `clamps` counts elements that exceeded the
+    int8 range pre-clip (zero in healthy operation; non-zero flags a
+    scale gone bad, e.g. non-finite gradients — the guardian's skip-apply
+    gate catches the resulting non-finite update)."""
+    if chunk is None:
+        from paddle_tpu.core.flags import get_flag
+        chunk = int(get_flag("quant_allreduce_chunk"))
+    flat = x.astype(jnp.float32).reshape(-1)
+    xc, n = _quant_chunked(flat, max(int(chunk), 1))
+    absmax = lax.pmax(jnp.max(jnp.abs(xc), axis=1), axis_name)   # [nch]
+    scale = jnp.maximum(absmax, 1e-30) / 127.0
+    qf = jnp.round(xc / scale[:, None])
+    clamps = jnp.sum((jnp.abs(qf) > 127.0).astype(jnp.int32))
+    q = jnp.clip(qf, -127.0, 127.0).astype(jnp.int8)
+    s = lax.psum(q.astype(jnp.int32), axis_name)
+    out = (s.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
+    return out.reshape(x.shape).astype(x.dtype), clamps
+
+
+def quantized_pmean(x, axis_name, chunk=None):
+    """Mean-reducing twin of :func:`quantized_psum` (the gradient
+    exchange form). Returns ``(mean_like_x, clamps)``."""
+    s, clamps = quantized_psum(x, axis_name, chunk=chunk)
+    return s / lax.psum(1, axis_name), clamps
+
+
+def quant_wire_bytes(num_elements, dp, chunk=None):
+    """Per-chip wire bytes one quantized all-reduce of `num_elements`
+    moves on a dp-way ring: 2(dp-1)/dp passes over int8 payload plus one
+    f32 scale per chunk — the same expression autoplan/costmodel.py
+    prices, kept here so bench rows and the planner cannot drift."""
+    if chunk is None:
+        from paddle_tpu.core.flags import get_flag
+        chunk = int(get_flag("quant_allreduce_chunk"))
+    chunk = max(int(chunk), 1)
+    payload = num_elements + (-(-num_elements // chunk)) * 4
+    return 2.0 * (dp - 1) / max(dp, 1) * payload
+
+
+def resolve_quant_allreduce(choice=None, crosses_slices=False):
+    """Resolve the `quant_allreduce` flag to a bool for one dp axis:
+    'on'/'off' force it; 'auto' quantizes only cross-slice (DCN) dp axes
+    — the same rule the autoplan cost model prices, so a forced choice
+    and a planned one agree on when quantization pays. The
+    ``collective.quant`` fault point sits on this resolution: an
+    injected fault degrades the exchange to the exact f32 collective
+    (counted, never raised into a step)."""
+    if choice is None:
+        from paddle_tpu.core.flags import get_flag
+        choice = get_flag("quant_allreduce")
+    try:
+        fault_point("collective.quant")
+    except Exception:
+        _metrics.counter("collective.quant_degraded").inc()
+        return False
+    if choice == "on":
+        return True
+    if choice == "off":
+        return False
+    return bool(crosses_slices)
+
+
+def record_quant_traffic(nbytes):
+    """Publish one quantized exchange's per-chip wire traffic to the
+    ``collective.quant_bytes{direction}`` counter (ring all-reduce moves
+    the payload both ways)."""
+    c = _metrics.counter("collective.quant_bytes")
+    c.inc(nbytes, direction="send")
+    c.inc(nbytes, direction="recv")
+
+
+def publish_clamp_count(state, last=0):
+    """Host-side delta publisher for a QuantizedGradSync state's
+    cumulative clamp counter -> ``quant.overflow_clamps`` (the
+    amp.skipped_steps idiom: the device count lives in the optimizer
+    state; the host publishes deltas between reads). Returns the new
+    `last` watermark."""
+    n = int(state["clamps"])
+    if n > last:
+        _metrics.counter("quant.overflow_clamps").inc(n - last)
+    return n
+
+
+class QuantizedGradSync:
+    """Data-parallel gradient exchange through the chunked int8
+    collective. Wraps any paddle_tpu Optimizer; use under shard_map with
+    a dp axis (the LocalSGD/GeoSGD discipline): each apply_gradients
+    quantize-pmeans every gradient leaf across the axis before the inner
+    apply, and accumulates the clamp count in its state
+    ({"inner": opt_state, "clamps": i32} — publish_clamp_count turns it
+    into the quant.overflow_clamps counter host-side).
+
+    Parity guard: quantization error is bounded (<= scale/2 per element
+    pre-mean), but a pathological batch (inf/nan gradients) collapses
+    the chunk scale and surfaces as a non-finite update — exactly what
+    the guardian's skip-apply gate already rejects, so a quantized step
+    can degrade a step to a skip but never corrupt params."""
+
+    def __init__(self, optimizer, axis_name="dp", chunk=None):
+        self.inner = optimizer
+        self.axis_name = axis_name
+        self.chunk = chunk
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "clamps": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        synced, clamps = [], state["clamps"]
+        for g in leaves:
+            m, c = quantized_pmean(g, self.axis_name, chunk=self.chunk)
+            synced.append(m)
+            clamps = clamps + c
+        mean = jax.tree_util.tree_unflatten(treedef, synced)
+        params, inner = self.inner.apply_gradients(params, mean,
+                                                   state["inner"])
+        return params, {"inner": inner, "clamps": clamps}
+
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, *args, **kwargs)
+        params, state = self.apply_gradients(params, grads, state)
+        return loss, params, state, aux

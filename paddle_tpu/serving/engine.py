@@ -102,6 +102,9 @@ class ServeConfig:
     prefill_len: int = 64        # padded admission prompt length (fixed)
     num_pages: int = None        # None -> num_slots * ceil(max_len/page)
     cache_dtype: typing.Any = jnp.float32
+    kv_dtype: typing.Any = None  # None -> serve_kv_dtype flag; jnp.int8
+    #                              stores paged K/V quantized (per-token
+    #                              symmetric scales ride the page pool)
     temperature: float = 0.0     # 0 = greedy; >0 samples per step
     top_k: int = None            # default per-request top-k (None -> flag)
     top_p: float = None          # default per-request top-p (None -> flag)
@@ -152,6 +155,17 @@ class ServeConfig:
             self.prefix_cache = bool(get_flag("serve_prefix_cache"))
         if self.prefix_pages is None:
             self.prefix_pages = int(get_flag("serve_prefix_pages"))
+        if self.kv_dtype is None:
+            f = str(get_flag("serve_kv_dtype")).lower()
+            if f == "int8":
+                self.kv_dtype = jnp.int8
+            elif f not in ("", "f32", "float32"):
+                raise ValueError(f"serve_kv_dtype={f!r}: expected "
+                                 "'int8' or ''/'f32'")
+        elif self.kv_dtype in ("", "f32", "float32"):
+            self.kv_dtype = None       # explicit f32 = the plain pool
+        elif isinstance(self.kv_dtype, str):
+            self.kv_dtype = jnp.dtype(self.kv_dtype).type
         pages_per_slot = -(-self.max_len // self.page_size)
         if self.num_pages is None:
             self.num_pages = self.num_slots * pages_per_slot
@@ -219,7 +233,8 @@ class ServingEngine:
         self._clock = clock
         self._pages_per_slot = -(-cfg.max_len // cfg.page_size)
         self._caches = model.init_paged_caches(
-            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype)
+            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
+            kv_dtype=cfg.kv_dtype)
 
         s = cfg.num_slots
         self._page_table = np.zeros((s, self._pages_per_slot), np.int32)
@@ -289,7 +304,8 @@ class ServingEngine:
             "serve.page_stalls", "serve.preemptions", "serve.goodput",
             "serve.slo_violations", "serve.recoveries", "serve.shed",
             "serve.prefix_hits", "serve.prefix_misses",
-            "serve.cow_copies", "serve.pages_shared", "jit.retraces"])
+            "serve.cow_copies", "serve.pages_shared",
+            "serve.kv_quant_pages", "jit.retraces"])
         self._retired = 0
         self._retired_ok = 0
         self._viol_base = dict(
@@ -631,6 +647,9 @@ class ServingEngine:
             _metrics.counter("serve.tokens").inc(new_tokens)
             _metrics.gauge("serve.active_slots").set(len(self._running))
             _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            if self.cfg.kv_dtype is not None:
+                _metrics.gauge("serve.kv_quant_pages").set(
+                    self.cfg.num_pages - len(self._free_pages))
             wall_s = self._clock() - t0
             if self._run_log is not None:
                 self._run_log.write({
@@ -667,6 +686,8 @@ class ServingEngine:
         if self._run_log is not None:
             snap = _metrics.snapshot()
             self._run_log.write({"final": True, "phase": "serve",
+                                 "kv_dtype": self.kv_dtype_name(),
+                                 "kv_pool_bytes": self.kv_pool_bytes(),
                                  "counters": snap.get("counters", {}),
                                  "gauges": snap.get("gauges", {}),
                                  "slo": self.slo_stats()})
@@ -729,6 +750,18 @@ class ServingEngine:
                    np.zeros(cfg.num_slots, bool))
         return save_train_program(path, step,
                                   (self._params, self._caches), example)
+
+    def kv_dtype_name(self):
+        """"int8" for a quantized page pool, else "f32" — the bench /
+        report label for the serve_kv_dtype choice in effect."""
+        return "int8" if self.cfg.kv_dtype is not None else "f32"
+
+    def kv_pool_bytes(self):
+        """Device bytes held by the paged KV pools across layers (value
+        tensors plus, for quantized pools, their scale tensors) —
+        shape/dtype metadata only, never a device sync."""
+        return int(sum(arr.nbytes for pool in list(self._caches)
+                       for arr in pool.values()))
 
     def goodput(self):
         """Fraction of retired requests that met every configured SLO
@@ -1006,7 +1039,18 @@ class ServingEngine:
         self._page_table[slot] = 0
         req.pages = []
         req.shared_pages = []
-        matched = self._map_prefix(req, total)
+        quant_ok = True
+        if self.cfg.kv_dtype is not None:
+            try:
+                fault_point("quant.kv_write")
+            except Exception:
+                # quantized-write fault: degrade THIS admission to
+                # private pages only (no cache mapping, no publish on
+                # the way out) so a suspect write can never be shared
+                # into another request's table row
+                _metrics.counter("serve.kv_quant_degraded").inc()
+                quant_ok = False
+        matched = self._map_prefix(req, total) if quant_ok else 0
         tok = None
         skipped = 0
         for ci in range(-(-total // cfg.prefill_len)):
@@ -1043,7 +1087,8 @@ class ServingEngine:
                 self._recover("serve.prefill", e, pending=req)
                 return False
         self.prefill_tokens_skipped += skipped
-        self._publish_prefix(req)
+        if quant_ok:
+            self._publish_prefix(req)
         self._lengths[slot] = total
         self._trace_event(req, "prefill_done")
         t = self._trace_event(req, "first_token")
@@ -1177,7 +1222,8 @@ class ServingEngine:
             self._build_jits()
         # quarantine: drop the (donated, possibly poisoned) pools
         self._caches = self._model.init_paged_caches(
-            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype)
+            cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
+            kv_dtype=cfg.kv_dtype)
         self._page_table[:] = 0
         self._lengths[:] = 0
         self._active[:] = False

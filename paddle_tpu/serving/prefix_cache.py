@@ -29,7 +29,10 @@ that makes that safe:
 
 The cache holds page IDS only — the page *content* lives in the paged
 KV pools (ops/attention.py); page ids are common across layers, so ONE
-cache serves every layer's pool. All methods are plain host work; the
+cache serves every layer's pool. Quantized pools (serve_kv_dtype=int8)
+need no extra handling here: the per-row scales live pool-side, keyed
+by the same page ids, so a shared or copy-on-write page carries its
+scales wherever its id is mapped. All methods are plain host work; the
 engine calls them under its request-table lock.
 """
 

@@ -242,6 +242,8 @@ FAULT_POINTS = {
     "checkpoint.mirror": "remote mirror push of a committed checkpoint",
     "checkpoint.verify": "restore-side crc32 integrity check of a "
                          "checkpoint step against its manifest",
+    "collective.quant": "quantized dp all-reduce strategy resolution (a "
+                        "fault degrades the sync to plain f32 psum)",
     "fleet.canary": "canary routing draw for a fresh fleet request (a "
                      "fault degrades the request to the baseline "
                      "version)",
@@ -254,6 +256,9 @@ FAULT_POINTS = {
     "fleet.respawn": "fleet router respawning a dead replica",
     "fleet.scale": "fleet autoscaler acting on a load signal (spawn "
                    "or graceful drain-then-retire)",
+    "quant.kv_write": "quantized paged-KV admission write (a fault "
+                      "degrades that admission to private pages — no "
+                      "prefix-cache mapping or publish)",
     "serve.prefill": "serving admission prefill (per chunk) device call",
     "serve.prefix_cache": "prefix-cache lookup at admission (a hash "
                           "collision or evict-under-use injection "

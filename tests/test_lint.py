@@ -428,7 +428,7 @@ def test_registered_snapshots_are_blessed_on_disk():
     compile_smoke judges against these; a missing file would turn the
     gate into a permanent failure."""
     assert set(contracts.CONTRACT_SNAPSHOTS) == {
-        "train.gpt@dp2,tp2", "serve.decode"}
+        "train.gpt@dp2,tp2", "serve.decode", "serve.decode@int8"}
     for key, snap in contracts.CONTRACT_SNAPSHOTS.items():
         rec = snap.load()
         assert rec is not None, f"{key}: no blessed snapshot at {snap.path}"

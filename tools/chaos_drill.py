@@ -27,7 +27,10 @@ expiring deadline, and a client cancellation. Verifies that 100% of
 submitted requests reach a terminal status (done / rejected / shed /
 cancelled), that every COMPLETED greedy request is token-exact vs a
 per-request generate() reference despite the recoveries, and that each
-injected fault produced exactly one engine recovery.
+injected fault produced exactly one engine recovery. A closing
+quantized-KV wave re-runs shared-prefix traffic through an int8 page
+pool with an injected `quant.kv_write` fault: the faulted admission
+degrades to private pages, everything stays terminal and traced-once.
 
 Fleet drill (--fleet): 3 in-process engine replicas behind a
 FleetRouter — mixed traffic, one replica killed mid-decode, one
@@ -485,7 +488,10 @@ def run_serve_drill(seed=0):
     token-exact against per-request generate() references. Ends with a
     shared-prefix wave whose first admission takes an injected
     serve.prefix_cache fault (degrade to private pages, never corrupt)
-    while the rest must still hit the cache."""
+    while the rest must still hit the cache, then a quantized-KV wave
+    through an int8 pool whose first admission takes an injected
+    quant.kv_write fault (degrade to private pages, terminal, one
+    trace)."""
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time as _time
@@ -606,6 +612,57 @@ def run_serve_drill(seed=0):
                 f"wave request {rid} not token-exact under the "
                 "degraded prefix cache")
         assert engine.decode_traces == 1 and engine.prefill_traces == 1
+
+        # -- quantized-KV wave: a shared-prefix wave through an int8
+        # page pool (serve_kv_dtype=int8). The FIRST admission takes an
+        # injected quant.kv_write fault and must degrade to private
+        # pages (no prefix-cache mapping or publish — the containment
+        # boundary for a suspect quantized write); later admissions
+        # prefill and publish normally and the tail request must hit
+        # the cache. Greedy decode over int8 KV is deterministic, so
+        # the degraded request and an identical normally-admitted
+        # request must emit identical tokens. Wave terminal, traces
+        # stay 1.
+        from paddle_tpu.observability import metrics as _metrics
+        qengine = ServingEngine(model, variables, ServeConfig(
+            num_slots=2, page_size=8, max_len=64, prefill_len=16,
+            kv_dtype="int8"))
+        deg0 = _metrics.counter("serve.kv_quant_degraded").total()
+        qpc = qengine._prefix_cache
+        qhits0 = qpc.hits if qpc else 0
+        qplan = chaos.FaultPlan(seed=seed)
+        qplan.fail("fault_point", path=r"^quant\.kv_write$", nth=1,
+                   times=1)
+        qshared = rng.randint(0, cfg.vocab_size, (20,), dtype=np.int32)
+        qprompts = [
+            np.concatenate([qshared, rng.randint(0, cfg.vocab_size, (k,),
+                                                 dtype=np.int32)])
+            for k in (4, 4, 6)]
+        qprompts[1] = qprompts[0].copy()   # identical degraded/normal pair
+        with chaos.active(qplan):
+            q_ids = [qengine.submit(p, max_new=6) for p in qprompts]
+            qengine.drain()
+        quant_faults = qplan.fired("fault_point")
+        assert quant_faults == 1, (
+            f"expected 1 injected quant.kv_write fault, {quant_faults}")
+        quant_degraded = int(
+            _metrics.counter("serve.kv_quant_degraded").total() - deg0)
+        assert quant_degraded == 1, (
+            "the faulted admission did not degrade to private pages "
+            f"(serve.kv_quant_degraded delta {quant_degraded})")
+        for rid in q_ids:
+            assert qengine.requests[rid].status == "done", (
+                rid, qengine.requests[rid].status)
+        quant_hits = (qpc.hits - qhits0) if qpc else 0
+        assert quant_hits > 0, (
+            "post-fault admissions never hit the quantized prefix cache")
+        assert np.array_equal(qengine.requests[q_ids[0]].output,
+                              qengine.requests[q_ids[1]].output), (
+            "degraded (private-page) request diverged from its "
+            "identical shared-path twin over the same int8 pool")
+        assert (qengine.decode_traces == 1
+                and qengine.prefill_traces == 1), "int8 engine retraced"
+        qengine.close()
         engine.close()
         return dict(
             submitted=len(statuses),
@@ -618,7 +675,9 @@ def run_serve_drill(seed=0):
             token_exact=len(accepted),
             prefix_wave=len(wave_ids), prefix_hits=wave_hits,
             prefix_faults=prefix_faults,
-            wave_token_exact=len(wave_ids))
+            wave_token_exact=len(wave_ids),
+            quant_wave=len(q_ids), quant_faults=quant_faults,
+            quant_degraded=quant_degraded, quant_hits=quant_hits)
     finally:
         F.set_flags(saved)
 

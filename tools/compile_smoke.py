@@ -277,6 +277,13 @@ def serve_smoke(positive_control=True, update_snapshots=False):
        a tolerance=0 positive control), and its op histogram matches
        the blessed serve.decode snapshot (``update_snapshots=True``
        re-blesses instead).
+    4. Quantized-KV leg: the same waves through a serve_kv_dtype=int8
+       engine must stay traced-once and clean against the
+       serve.decode@int8 row — no f32 tensor at page-pool scale (the
+       dequant lives inside the kernel's tiles), byte budget re-derived
+       from predict_decode(kv_dtype=int8), its own snapshot — while the
+       f32 engine's compile TRIPS the KV detector (positive control:
+       its pool is exactly the wide-KV tensor the row forbids).
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -292,7 +299,6 @@ def serve_smoke(positive_control=True, update_snapshots=False):
     try:
         set_flags({"pallas_interpret": True, "use_pallas_decode": True})
         _, _, engine = _serve_engine()
-        rng = np.random.RandomState(0)
         # admission waves of ragged prompts through 2 slots: every
         # admission lands in a freed slot mid-run. The 40-token prompt
         # exceeds prefill_len=16 — chunked prefill admits it as three
@@ -301,15 +307,21 @@ def serve_smoke(positive_control=True, update_snapshots=False):
         # greedy, temperature, top-k, top-p, and a pinned seed in one
         # batch — because they ride as traced [slots] values, not
         # retrace axes
-        for plen, mn, kw in [
-                (3, 7, {}), (9, 5, dict(temperature=0.8)),
-                (16, 6, dict(temperature=0.9, top_k=5)),
-                (40, 6, {}), (5, 9, dict(temperature=0.7, top_p=0.9)),
-                (12, 4, dict(temperature=1.0, top_k=8, top_p=0.95)),
-                (2, 8, dict(temperature=0.6, seed=123))]:
-            engine.submit(rng.randint(0, 512, (plen,), dtype=np.int32),
-                          max_new=mn, **kw)
-        done = engine.drain()
+        waves = [
+            (3, 7, {}), (9, 5, dict(temperature=0.8)),
+            (16, 6, dict(temperature=0.9, top_k=5)),
+            (40, 6, {}), (5, 9, dict(temperature=0.7, top_p=0.9)),
+            (12, 4, dict(temperature=1.0, top_k=8, top_p=0.95)),
+            (2, 8, dict(temperature=0.6, seed=123))]
+
+        def _drive(eng):
+            rng = np.random.RandomState(0)
+            for plen, mn, kw in waves:
+                eng.submit(rng.randint(0, 512, (plen,), dtype=np.int32),
+                           max_new=mn, **kw)
+            return eng.drain()
+
+        done = _drive(engine)
         out["finished"] = len(done)
         out["decode_traces"] = engine.decode_traces
         out["prefill_traces"] = engine.prefill_traces
@@ -338,6 +350,39 @@ def serve_smoke(positive_control=True, update_snapshots=False):
         out["cost"] = cost
         out["violations"] = [v.format() for v in violations]
         out["clean"] = not violations
+
+        # --- quantized-KV leg: the same waves through an int8 pool ----
+        # (run before the positive controls flip the pallas flags off)
+        _, _, qeng = _serve_engine(kv_dtype="int8")
+        _drive(qeng)
+        q_compiled = qeng.compiled_decode()
+        q_hlo = q_compiled.as_text()
+        try:
+            q_cost = c.normalize_cost(q_compiled.cost_analysis())
+        except Exception:
+            q_cost = None
+        q_ctx = c.ContractContext(
+            hlo_text=q_hlo, cost=q_cost,
+            trace_counts={"serve.decode": qeng.decode_traces,
+                          "serve.prefill": qeng.prefill_traces})
+        q_viol = c.evaluate(c.CONTRACTS["serve.decode@int8"], q_ctx)
+        q_snap = c.CONTRACT_SNAPSHOTS["serve.decode@int8"]
+        if update_snapshots:
+            out["int8_snapshot_blessed"] = q_snap.bless(q_hlo)["hash"]
+        else:
+            q_viol += q_snap.violations(q_ctx)
+        out["int8_kv_pool_bytes"] = qeng.kv_pool_bytes()
+        out["f32_kv_pool_bytes"] = engine.kv_pool_bytes()
+        out["int8_cost"] = q_cost
+        out["int8_violations"] = [v.format() for v in q_viol]
+        out["int8_clean"] = not q_viol
+        # positive control for the KV detector: the f32 engine's page
+        # pool IS the KV-layout-scale f32 tensor the int8 row forbids,
+        # so judging the f32 compile with it must trip
+        kvdet = next(r for r in c.CONTRACTS["serve.decode@int8"]
+                     if isinstance(r, c.NoKvDequantTemporary))
+        out["kv_control_trips"] = bool(kvdet.temporaries(hlo))
+
         if positive_control:
             budgets = [b for b in c.CONTRACTS["serve.decode"]
                        if isinstance(b, c.MaxHloCost)]
@@ -375,6 +420,8 @@ def serve_smoke(positive_control=True, update_snapshots=False):
     finally:
         set_flags(saved)
     out["ok"] = bool(out.get("traced_once") and out.get("clean")
+                     and out.get("int8_clean")
+                     and out.get("kv_control_trips")
                      and out.get("positive_control_trips",
                                  not positive_control)
                      and out.get("retrace_control_trips",

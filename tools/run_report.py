@@ -18,7 +18,9 @@ command turns a training run's artifacts into the human-readable story —
 reconstructed from the engine's trace events (submitted/admitted/
 prefill_done/first_token/preempted/resumed/retired), an ASCII per-slot
 Gantt of slot occupancy, TTFT + token-latency percentiles, goodput
-against the configured SLOs, and preemption attribution.
+against the configured SLOs, preemption attribution, and the KV pool
+footprint (kv_dtype + pool bytes, plus quantized-page / overflow-clamp
+/ degraded-admission counters for serve_kv_dtype=int8 runs).
 
 `--fleet` renders the fleet live-ops view: the deploy/scale/canary
 timeline from FleetRouter ops events (raw records, a dumped telemetry
@@ -421,6 +423,26 @@ def render_serve_report(records, top=20, width=64):
         lines.append(_pctl_line(
             f"serve steps:    {len(steps)} ({toks} tokens)  step ",
             walls))
+    fin = finals[-1] if finals else {}
+    if fin.get("kv_dtype") or fin.get("kv_pool_bytes"):
+        counters = _flatten_counters(fin.get("counters"))
+        gauges = fin.get("gauges") or {}
+
+        def _near(table, name):
+            return sum(v for k, v in table.items()
+                       if k == name or k.startswith(name))
+
+        kv = (f"KV pool:        {fin.get('kv_dtype') or 'f32'}, "
+              f"{int(fin.get('kv_pool_bytes') or 0):,} bytes")
+        if fin.get("kv_dtype") == "int8":
+            kv += (
+                f"  (quantized pages in use "
+                f"{int(_near(gauges, 'serve.kv_quant_pages'))}, "
+                f"overflow clamps "
+                f"{int(_near(counters, 'quant.overflow_clamps'))}, "
+                f"degraded admits "
+                f"{int(_near(counters, 'serve.kv_quant_degraded'))})")
+        lines.append(kv)
     lines.append("")
     lines.extend(_slot_gantt(events, width=width))
 

@@ -700,10 +700,16 @@ def bench_gpt_serve_fleet(steps, batch, seq):
     carries a goodput-vs-offered-load curve with replica-count and
     deploy-overhead columns (a rolling v0 -> v1 deploy lands at the
     peak level), plus the router's ops_log for `tools/run_report.py
-    --fleet`."""
+    --fleet`. The standard mode closes with a short untraced window
+    (trace_fleet=0, flight_ring=0) at the max replica count and reports
+    `trace_overhead` (untraced/traced tokens/s — ~1.0 proves the trace
+    plane never syncs the device) plus the path of the most recent
+    flight-recorder bundle, if an anomaly dumped one."""
     import jax
     import jax.numpy as jnp
+    from paddle_tpu.core import flags as _F
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+    from paddle_tpu.observability import flight as _flight
     from paddle_tpu.observability import metrics as _metrics
     from paddle_tpu.serving import FleetConfig, FleetRouter, ServeConfig
 
@@ -957,6 +963,50 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         by_replicas[str(n)] = entry
         router.close()
 
+    # tracing overhead: one more short window at the max replica count
+    # with the trace plane off (trace_fleet=0, flight_ring=0). Every
+    # trace event is a host-side dict append (+ one RunLog line when
+    # configured) — traced/untraced tokens/s should read ~1.0; a drift
+    # here means something synced the device on the trace path.
+    nmax = max(counts)
+    saved_flags = _F.all_flags()
+    try:
+        _F.set_flags({"trace_fleet": False, "flight_ring": 0})
+        router = FleetRouter(
+            model, variables,
+            FleetConfig(num_replicas=nmax, heartbeat_s=60.0,
+                        metrics_port=0),
+            serve_config=serve_cfg())
+        rng = np.random.RandomState(0)
+        shared_prefix = (rng.randint(0, cfg.vocab_size, (shared_len,),
+                                     dtype=np.int32)
+                         if shared_len else None)
+
+        def submit_untraced(k):
+            for _ in range(k):
+                plen = int(rng.randint(max(1, seq // 8),
+                                       prefill_len + 1))
+                ids = rng.randint(0, cfg.vocab_size, (plen,),
+                                  dtype=np.int32)
+                if shared_len and rng.random_sample() < share:
+                    ids = np.concatenate([shared_prefix, ids])
+                router.submit(ids, max_new=max_new)
+
+        submit_untraced(nmax * batch)      # warmup (fresh jits)
+        settle(router)
+        warm = len(router.requests)
+        n_req = max(4 * batch * nmax, steps)
+        t0 = time.perf_counter()
+        submit_untraced(n_req)
+        settle(router)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        recs = [r for r in router.requests.values()
+                if r.id >= warm and r.status == "done"]
+        untraced_tps = round(sum(len(r.tokens) for r in recs) / dt, 1)
+        router.close()
+    finally:
+        _F.set_flags(saved_flags)
+
     top = by_replicas[str(max(counts))]
     return {
         "metric": "gpt_serve_fleet_tokens_per_sec",
@@ -972,11 +1022,18 @@ def bench_gpt_serve_fleet(steps, batch, seq):
         "goodput": top["goodput"],
         "fleet_kill": kill,
         "prefix_share": share,
+        "untraced_tokens_per_sec": untraced_tps,
+        "trace_overhead": round(
+            untraced_tps / max(top["tokens_per_sec"], 1e-9), 3),
+        "flight_bundle": _flight.last_bundle(),
         "by_replicas": by_replicas,
         "note": "FleetRouter over in-process engine replicas; "
                 "least-loaded dispatch, heartbeat liveness, token-exact "
                 "failover replay (PT_BENCH_FLEET_KILL=1 kills a busy "
-                "replica mid-stream)",
+                "replica mid-stream); trace_overhead = untraced/traced "
+                "tokens per second (~1.0 when the trace plane stays off "
+                "the hot path); flight_bundle = the most recent "
+                "flight-recorder dump this process produced, if any",
     }
 
 

@@ -5,6 +5,7 @@ a fixture test can instantiate a single rule against a planted tree.
 """
 
 from paddle_tpu.analysis.rules import (  # noqa: F401
-    catalog_drift, fault_point_drift, flag_drift, hot_path_sync,
-    lock_order, no_committed_logs, raw_pallas_call, stale_suppression,
-    thread_unsafe_publish, tracer_leak, unguarded_shared_state)
+    catalog_drift, event_drift, fault_point_drift, flag_drift,
+    hot_path_sync, lock_order, no_committed_logs, raw_pallas_call,
+    stale_suppression, thread_unsafe_publish, tracer_leak,
+    unguarded_shared_state)

@@ -1,0 +1,117 @@
+"""event-drift: trace.EVENTS and the literal trace-plane event writers
+(``_trace_event(req, "...")`` / ``note_event("...")``) may never drift
+apart, either way.
+
+Mirror of catalog-drift/fault-point-drift for the trace plane: an event
+kind emitted but not registered is invisible to the fleet-trace
+collector's consumers (the Gantt/critical-path renderers key on known
+kinds), and a registered kind with no emitter documents an event that
+never happens. The catalog is parsed statically from the EVENTS dict
+literal in observability/trace.py.
+
+Event args that are conditional expressions over string literals
+(``"resumed" if req.preemptions else "admitted"``) contribute every
+branch; fully dynamic args are out of static reach and stay silent.
+"""
+
+import ast
+
+from paddle_tpu.analysis.lint import Finding, Rule, register
+from paddle_tpu.analysis.rules._common import call_name, walk_calls
+
+
+def parse_events(sf):
+    """{event kind: lineno} from the trace module's EVENTS literal."""
+    if sf is None or sf.tree is None:
+        return None
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "EVENTS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _event_literals(node):
+    """String literals an event argument can evaluate to: a Constant
+    yields itself, an IfExp yields both branches, anything else is
+    dynamic (empty)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, ast.IfExp):
+        return _event_literals(node.body) + _event_literals(node.orelse)
+    return []
+
+
+# writer name -> positional index of the event-kind argument
+WRITERS = {"_trace_event": 1, "note_event": 0}
+
+
+@register
+class EventDrift(Rule):
+    name = "event-drift"
+    help = ("literal _trace_event/note_event kinds and trace.EVENTS "
+            "must match in both directions")
+
+    DEFAULT_CATALOG_PATH = "paddle_tpu/observability/trace.py"
+    DEFAULT_SCOPE = ("paddle_tpu/**/*.py", "paddle_tpu/*.py")
+    MIN_SITES = 8   # the wiring exists; below this the detection rotted
+
+    def __init__(self, catalog_path=None, scope=None, min_sites=None):
+        self.catalog_path = catalog_path or self.DEFAULT_CATALOG_PATH
+        self.scope = tuple(scope or self.DEFAULT_SCOPE)
+        self.min_sites = (self.MIN_SITES if min_sites is None
+                          else min_sites)
+
+    def sites(self, ctx):
+        """{event kind: [(relpath, lineno), ...]} from literal writer
+        call sites (the catalog module's own writers count too — its
+        helpers emit anchor/span events)."""
+        out = {}
+        for sf in ctx.glob(*self.scope):
+            if sf.tree is None:
+                continue
+            for call in walk_calls(sf.tree):
+                cn = call_name(call)
+                if cn is None:
+                    continue
+                index = WRITERS.get(cn.split(".")[-1])
+                if index is None or len(call.args) <= index:
+                    continue
+                for kind in _event_literals(call.args[index]):
+                    out.setdefault(kind, []).append(
+                        (sf.relpath, call.lineno))
+        return out
+
+    def check(self, ctx):
+        registered = parse_events(ctx.file(self.catalog_path))
+        if registered is None:
+            yield Finding(self.name, self.catalog_path, 1,
+                          "EVENTS dict literal not found — the rule's "
+                          "anchor rotted")
+            return
+        sites = self.sites(ctx)
+        n_sites = sum(len(v) for v in sites.values())
+        if n_sites < self.min_sites:
+            yield Finding(
+                self.name, self.catalog_path, 1,
+                f"only {n_sites} trace-event writer sites detected "
+                f"(expected >= {self.min_sites}) — the site detection "
+                "rotted")
+        for kind, locs in sorted(sites.items()):
+            if kind not in registered:
+                rel, lineno = locs[0]
+                yield Finding(
+                    self.name, rel, lineno,
+                    f"trace event {kind!r} is not registered in "
+                    "trace.EVENTS — the fleet-trace collector's "
+                    "consumers cannot see it")
+        for kind, lineno in sorted(registered.items()):
+            if kind not in sites:
+                yield Finding(
+                    self.name, self.catalog_path, lineno,
+                    f"trace.EVENTS entry {kind!r} has no writer call "
+                    "site — it documents an event that never happens")

@@ -48,6 +48,8 @@ class HotPathSync(Rule):
         "paddle_tpu/static/guardian.py",
         "paddle_tpu/observability/telemetry.py",
         "paddle_tpu/observability/watchdog.py",
+        "paddle_tpu/observability/trace.py",
+        "paddle_tpu/observability/flight.py",
         "paddle_tpu/data/loader.py",
     )
     DEFAULT_ROOTS = (

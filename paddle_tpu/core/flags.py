@@ -282,6 +282,24 @@ define_flag("watchdog_stall_s", 1.0,
 define_flag("watchdog_goodput_min", 0.5,
             "serve.goodput below this (after enough retired requests) "
             "latches a goodput_collapse anomaly.")
+# distributed tracing + flight recorder (observability/trace.py +
+# flight.py): fleet-durable trace contexts and the anomaly-triggered
+# evidence bundle
+define_flag("trace_fleet", True,
+            "Mint durable fleet-wide trace contexts at FleetRouter."
+            "submit() and carry them across dispatch/failover hops so "
+            "one trace id covers a request's whole life; off falls back "
+            "to engine-run-scoped ids.")
+define_flag("flight_ring", 256,
+            "Per-process flight-recorder ring size (recent trace events "
+            "+ metric deltas kept in memory for anomaly bundles); 0 "
+            "disables recording.")
+define_flag("flight_profile_s", 0.0,
+            "Seconds of jax.profiler XPlane capture to include in a "
+            "flight bundle (0 skips the capture — dumps stay instant).")
+define_flag("flight_dir", "/tmp/paddle_tpu_flight",
+            "Directory flight-recorder bundles are dumped into (one "
+            "timestamped subdir per dump).")
 # training guardian (static/guardian.py): in-trace non-finite
 # containment, host-side loss-spike detection, and the skip -> re-read ->
 # rollback mitigation ladder (GuardianConfig fields left unset resolve

@@ -36,6 +36,15 @@ Reference-framework ancestry (what each piece re-architects):
                 stall, steady-state retrace, goodput collapse) latching
                 watchdog.anomalies{kind} + RunLog events; fed by the
                 Trainer loop and the serving engine.
+  trace.py      fleet-wide distributed tracing — durable trace contexts
+                minted at FleetRouter.submit() and carried across
+                dispatch/failover hops, per-process clock anchors, and
+                the skew-corrected cross-replica timeline merge behind
+                tools/run_report.py --fleet-trace.
+  flight.py     anomaly-triggered flight recorder — bounded ring of
+                recent trace events + dump_bundle() evidence bundles
+                (metrics, ring, RunLog tails, config, optional XPlane)
+                fired from the watchdog action hook.
 
 tools/run_report.py joins a RunLog with an optional XPlane trace dir
 into the human-readable run report (the EnableProfiler/DisableProfiler
@@ -51,7 +60,8 @@ from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
                                               MetricsRegistry, counter,
                                               gauge, histogram, registry,
                                               reset_all, snapshot)
-from paddle_tpu.observability.runlog import RunLog, read_records
+from paddle_tpu.observability.runlog import (RunLog, read_records,
+                                             tail_records)
 
 # lazily-resolved members -> defining submodule (PEP 562): these pull in
 # jax/profiler, which early importers of the metrics registry must not
@@ -60,6 +70,11 @@ _LAZY = {
     "span_report": "spans", "reset_spans": "spans", "recorder": "spans",
     "spans": None, "telemetry": None, "perf": None,
     "catalog": None, "exporter": None, "watchdog": None,
+    "trace": None, "flight": None,
+    "TraceContext": "trace", "merge_fleet_trace": "trace",
+    "write_anchor": "trace",
+    "FlightRecorder": "flight", "dump_bundle": "flight",
+    "last_bundle": "flight",
     "TelemetryConfig": "telemetry", "StepTelemetry": "telemetry",
     "default_tokens": "telemetry",
     "peak_flops": "perf", "cost_flops": "perf", "mfu": "perf",

@@ -134,6 +134,12 @@ CATALOG = {
         "Fleet request retirements by the model version that served "
         "(or was routed for) the request — the per-version SLO plane "
         "the canary comparison reads."),
+    # observability/flight.py
+    "flight.dumps": MetricSpec(
+        "counter", ("status",),
+        "Flight-recorder bundle dumps by outcome (ok = a complete "
+        "bundle landed, error = the dump failed or was fault-injected "
+        "and was swallowed — anomaly handlers never raise)."),
     # parallel/heartbeat.py
     "heartbeat.barrier_wait_s": MetricSpec(
         "counter", ("barrier",),

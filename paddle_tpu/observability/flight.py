@@ -1,0 +1,225 @@
+"""Anomaly-triggered flight recorder: a bounded in-memory ring of
+recent trace events and metric deltas, dumped as one self-contained
+evidence bundle the moment a watchdog anomaly fires.
+
+Watchdog anomalies used to fire with zero evidence captured — by the
+time a human looked, the engine had shed load, respawned, or moved on,
+and the metrics that explained the collapse were gone. The recorder
+keeps the last ``flight_ring`` events per process (deque appends, no
+I/O — recording costs nothing on the hot path) and ``dump_bundle()``
+materializes everything into a timestamped directory:
+
+  - ``metrics.json``     full metrics-registry snapshot
+  - ``ring.jsonl``       the event ring, oldest first
+  - ``runlog_tail.jsonl``tails of the RunLogs handed in (rotation-aware)
+  - ``config.json``      active ServeConfig / MeshPlan / fleet summary
+  - ``profile/``         optional ``flight_profile_s``-second XPlane
+                         capture (jax.profiler; skipped when 0 or jax
+                         is unavailable)
+  - ``MANIFEST.json``    reason, wall time, and the section list —
+                         written LAST, so a complete manifest certifies
+                         a complete bundle
+
+Wiring: the engine watchdog's ``action`` hook dumps locally for a
+standalone engine; a fleet-owned engine forwards through its
+``anomaly_sink`` and ``FleetRouter`` fans one fleet-level dump out
+across every replica so the drill artifact is complete. The dump path
+carries a ``flight.dump`` chaos fault point and never raises — an
+anomaly handler that crashes the engine is worse than no handler.
+
+Host-side stdlib only (jax imported lazily for the optional profile
+capture); the ``hot-path-sync`` lint runs over this module.
+"""
+
+import collections
+import itertools
+import json
+import os
+import time
+
+from paddle_tpu.core.flags import get_flag
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.testing.chaos import fault_point
+
+_SEQ = itertools.count()
+_LAST_BUNDLE = None
+
+
+class FlightRecorder:
+    """Bounded ring of recent events. Appends are single deque ops
+    (thread-safe under the GIL, no lock, no I/O); ``snapshot()`` copies
+    the ring for a dump."""
+
+    def __init__(self, size):
+        self.size = int(size)
+        self._ring = collections.deque(maxlen=self.size)
+
+    def note_event(self, kind, **fields):
+        self._ring.append(dict(event=kind, t=time.perf_counter(),
+                               **fields))
+
+    def note(self, rec):
+        """Append an already-formed trace record (the engine's
+        ``_trace_event`` feeds the ring the same record it logs — the
+        kind was already stamped, and the event-drift lint checked it
+        at that call site)."""
+        self._ring.append(rec)
+
+    def note_metric_delta(self, name, value, **labels):
+        """Record a metric observation worth keeping in the ring (the
+        engine's per-step deltas feed this alongside the counter)."""
+        self._ring.append(dict(metric=name, value=value,
+                               t=time.perf_counter(), **labels))
+
+    def snapshot(self):
+        return list(self._ring)
+
+    def __len__(self):
+        return len(self._ring)
+
+
+_RECORDER = None
+
+
+def recorder():
+    """The process-global ring, sized by the ``flight_ring`` flag;
+    None when the flag is 0 (recording off). Resizing the flag builds
+    a fresh ring."""
+    global _RECORDER
+    size = int(get_flag("flight_ring"))
+    if size <= 0:
+        return None
+    if _RECORDER is None or _RECORDER.size != size:
+        _RECORDER = FlightRecorder(size)
+    return _RECORDER
+
+
+def last_bundle():
+    """Path of the most recent bundle this process dumped, else None."""
+    return _LAST_BUNDLE
+
+
+def _jsonable(obj):
+    """Best-effort JSON view of a config-ish object: dicts/lists
+    recurse, scalars pass through, everything else reprs."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)
+
+
+def _write_json(path, obj):
+    with open(path, "w") as fh:
+        json.dump(_jsonable(obj), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(_jsonable(rec)) + "\n")
+
+
+def _capture_profile(path, seconds):
+    """Optional XPlane capture; returns True when a trace landed."""
+    try:
+        import jax
+        jax.profiler.start_trace(path)
+        time.sleep(seconds)
+        jax.profiler.stop_trace()
+        return True
+    except Exception:
+        return False
+
+
+def dump_bundle(reason, run_logs=(), config=None, extra=None,
+                out_dir=None, tail=200, profile_s=None):
+    """Materialize one flight bundle; returns its path, or None when
+    the dump failed (fault-injected or real — the failure is counted on
+    ``flight.dumps{status=error}`` and never propagates: this runs from
+    anomaly handlers that must not take the engine down with them).
+
+    ``run_logs`` is an iterable of RunLog paths (or objects with a
+    ``path``) whose tails join the bundle; ``config`` is the active
+    ServeConfig/MeshPlan/fleet summary; ``extra`` merges into the
+    manifest (the anomaly event, fleet state, ...)."""
+    global _LAST_BUNDLE
+    try:
+        fault_point("flight.dump")
+        base = out_dir or str(get_flag("flight_dir"))
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(
+            base, f"flight-{stamp}-p{os.getpid()}-{next(_SEQ)}")
+        os.makedirs(path)
+        sections = []
+
+        _write_json(os.path.join(path, "metrics.json"),
+                    _metrics.snapshot())
+        sections.append("metrics.json")
+
+        rec = recorder()
+        ring = rec.snapshot() if rec is not None else []
+        if rec is not None:
+            rec.note_event("flight_dump", reason=reason)
+        _write_jsonl(os.path.join(path, "ring.jsonl"), ring)
+        sections.append("ring.jsonl")
+
+        from paddle_tpu.observability.runlog import tail_records
+        tails = []
+        for rl in run_logs:
+            p = getattr(rl, "path", rl)
+            if not p:
+                continue
+            try:
+                tails.extend(dict(r, _runlog=str(p))
+                             for r in tail_records(p, limit=tail))
+            except Exception as e:
+                tails.append(dict(_runlog=str(p), _error=repr(e)))
+        _write_jsonl(os.path.join(path, "runlog_tail.jsonl"), tails)
+        sections.append("runlog_tail.jsonl")
+
+        _write_json(os.path.join(path, "config.json"), config or {})
+        sections.append("config.json")
+
+        secs = (float(get_flag("flight_profile_s"))
+                if profile_s is None else float(profile_s))
+        if secs > 0 and _capture_profile(
+                os.path.join(path, "profile"), secs):
+            sections.append("profile")
+
+        manifest = dict(reason=reason, wall=time.time(),
+                        pid=os.getpid(), ring_events=len(ring),
+                        sections=sections)
+        if extra:
+            manifest.update(_jsonable(extra))
+        _write_json(os.path.join(path, "MANIFEST.json"), manifest)
+        _metrics.counter("flight.dumps").inc(status="ok")
+        _LAST_BUNDLE = path
+        return path
+    except Exception:
+        _metrics.counter("flight.dumps").inc(status="error")
+        return None
+
+
+def read_manifest(bundle_dir):
+    """The bundle's manifest dict, or None when the bundle is
+    incomplete (the manifest is written last)."""
+    p = os.path.join(bundle_dir, "MANIFEST.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as fh:
+        return json.load(fh)
+
+
+def list_bundles(base=None):
+    """Complete bundles (manifest present) under the flight dir,
+    oldest first."""
+    base = base or str(get_flag("flight_dir"))
+    if not os.path.isdir(base):
+        return []
+    out = [os.path.join(base, d) for d in sorted(os.listdir(base))
+           if d.startswith("flight-")]
+    return [d for d in out if read_manifest(d) is not None]

@@ -88,14 +88,25 @@ class RunLog:
         return False
 
 
+def _rotated_siblings(path):
+    """``path.N`` rotation siblings, oldest (highest N) first. Only
+    fully numeric suffixes qualify: the ``.[0-9]*`` glob alone also
+    matches e.g. ``run.jsonl.2bak``, whose suffix would crash the sort
+    key and take every report down with it."""
+    sibs = []
+    for p in glob.glob(glob.escape(str(path)) + ".[0-9]*"):
+        suffix = p.rsplit(".", 1)[1]
+        if suffix.isdigit():
+            sibs.append((int(suffix), p))
+    return [p for _, p in sorted(sibs, reverse=True)]
+
+
 def read_records(path):
     """Every record of a (possibly rotated) RunLog, oldest first.
 
     Tolerates a torn final line (a run killed mid-write leaves at most
     one truncated record; it is skipped, everything durable is kept)."""
-    files = sorted(
-        glob.glob(glob.escape(str(path)) + ".[0-9]*"),
-        key=lambda p: -int(p.rsplit(".", 1)[1]))
+    files = _rotated_siblings(path)
     if os.path.exists(path):
         files.append(str(path))
     out = []
@@ -110,3 +121,11 @@ def read_records(path):
                 except ValueError:
                     continue    # torn tail of a killed writer
     return out
+
+
+def tail_records(path, limit=200):
+    """The last ``limit`` records of a (possibly rotated) RunLog, in
+    order — the flight recorder's RunLog-tail bundle section. Reads the
+    full stream (RunLogs are size-bounded by rotation) and slices."""
+    recs = read_records(path)
+    return recs[-int(limit):] if limit else recs

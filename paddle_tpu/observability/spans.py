@@ -32,6 +32,7 @@ import time
 import jax
 
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace as _trace
 from paddle_tpu.profiler import EventRecorder
 
 _TLS = threading.local()
@@ -62,6 +63,8 @@ def span(name):
         stack.pop()
         _RECORDER.add(full, dt)
         _metrics.histogram("span." + full).observe(dt)
+        _trace.note_span(full, dt)   # links into the active trace
+        #                              context via the flight ring
 
 
 def annotate_span(name):

@@ -53,7 +53,7 @@ def _gather_retryable(exc):
 
 
 def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0,
-                   generation=None, policy=None):
+                   generation=None, policy=None, ragged=False):
     """All-gather host numpy arrays across local processes via the shared
     filesystem — no XLA collectives, so it works on backends where
     multi-process computations are unimplemented (jax 0.4.x CPU, where
@@ -62,7 +62,10 @@ def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0,
     waits for the others under a core/retry.py RetryPolicy (jittered
     backoff, overall deadline = `timeout`; pass `policy` to override).
     `tag` must be unique per collective call site. Returns
-    [world, *arr.shape].
+    [world, *arr.shape], or a list of `world` per-rank arrays when
+    `ragged=True` (for message-style exchanges — e.g. the fleet
+    router's JSON command/response wire — where ranks legitimately
+    publish different-length payloads that np.stack would reject).
 
     `generation` isolates incarnations of the SAME tag (the fleet
     router's respawned subprocess replicas restart their command
@@ -109,7 +112,7 @@ def host_allgather(arr, rank, world, exchange_dir, tag, timeout=60.0,
             raise TimeoutError(
                 f"host_allgather({tag}): rank {r} did not publish "
                 f"within {timeout}s") from e
-    return np.stack(out)
+    return out if ragged else np.stack(out)
 
 
 def launch_local(nproc, script, script_args=(), base_port=12355,

@@ -90,6 +90,7 @@ import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.observability import flight as _flight
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.testing.chaos import fault_point
 
@@ -204,7 +205,11 @@ class Request:
     first_token_t: float = None
     done_t: float = None
     device_prompt: typing.Any = None   # staged [1, Lp] chunks (async put)
-    trace_id: str = None          # engine-run-scoped lifecycle trace id
+    trace_id: str = None          # lifecycle trace id: engine-run-scoped
+    #                               when minted here, fleet-durable when
+    #                               adopt() received a router context
+    span_id: str = None           # this hop's span in the fleet trace
+    parent_span_id: str = None    # the causal parent hop (None = root)
     trace: list = dataclasses.field(default_factory=list)  # (event, t)
     preemptions: int = 0
     retire_reason: str = None     # "eos"|"length" or the terminal cause
@@ -284,6 +289,8 @@ class ServingEngine:
         self._stager = DataLoader(None, prefetch=cfg.prefetch)
 
         self.anomaly_sink = None      # fleet router watchdog uplink
+        self.replica = None           # fleet replica index; stamps every
+        #                               trace event once the router sets it
         self._run_log = None
         self._own_run_log = False
         if cfg.run_log:
@@ -293,6 +300,12 @@ class ServingEngine:
                 self._own_run_log = True
             else:                      # an already-open RunLog (bench.py)
                 self._run_log = cfg.run_log
+        if self._run_log is not None:
+            # wall/monotonic anchor: the fleet-trace merge rebases this
+            # log's perf_counter event times onto the wall clock with it
+            from paddle_tpu.observability import trace as _trace
+            _trace.write_anchor(self._run_log,
+                                model_version=cfg.model_version)
 
         # live observability plane: preregister the serve metric family
         # (so /metrics advertises HELP/TYPE before any traffic), SLO
@@ -498,7 +511,7 @@ class ServingEngine:
     def adopt(self, prompt, tokens=(), max_new=None, eos_id=None,
               priority=0, deadline_t=None, submit_t=None,
               first_token_t=None, origin="fleet", temperature=None,
-              top_k=None, top_p=None, seed=None):
+              top_k=None, top_p=None, seed=None, trace=None):
         """Failover/dispatch entry for the fleet router: queue a request
         whose generation may already be `tokens` deep, preserving the
         caller's accounting clock — submit_t, first_token_t and the
@@ -508,7 +521,13 @@ class ServingEngine:
         staged exactly like a crash-recovery requeue: greedy adoption
         finishes token-exact. Bypasses the queue_limit bound — the
         router does its own dispatch bounding, and a failover re-route
-        must never be rejected. Returns the request id."""
+        must never be rejected. Returns the request id.
+
+        ``trace`` is the router-minted durable trace context (a
+        TraceContext wire dict); when present the adopted request KEEPS
+        the fleet trace id across the hop instead of re-minting an
+        engine-run-scoped one, so one id covers the request's whole
+        life across replicas."""
         cfg = self.cfg
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         tokens = [int(t) for t in tokens]
@@ -531,7 +550,14 @@ class ServingEngine:
             req.tokens = tokens
             req.deadline_t = deadline_t
             req.first_token_t = first_token_t
-            req.trace_id = f"{self._trace_run}/{req.id}"
+            from paddle_tpu.observability.trace import TraceContext
+            ctx = TraceContext.from_wire(trace) if trace else None
+            if ctx is not None:
+                req.trace_id = ctx.trace_id
+                req.span_id = ctx.span_id
+                req.parent_span_id = ctx.parent_span_id
+            else:          # legacy: no router context, engine-run scope
+                req.trace_id = f"{self._trace_run}/{req.id}"
             self.requests[req.id] = req
             t = self._trace_event(req, "adopted", origin=origin,
                                   prompt_len=int(prompt.size),
@@ -816,19 +842,28 @@ class ServingEngine:
 
     def _trace_event(self, req, event, **extra):
         """One lifecycle trace point: a host clock read, a list append,
-        and (when a RunLog is configured) a JSONL write — never a device
-        sync (the flush-spy test's contract). Returns the timestamp."""
+        a bounded-ring append, and (when a RunLog is configured) a JSONL
+        write — never a device sync (the flush-spy test's contract).
+        Returns the timestamp."""
         t = self._clock()
         req.trace.append((event, t))
+        rec = {"event": event, "req": req.id, "trace": req.trace_id,
+               "t": t, "at_step": self._step_no}
+        if req.slot is not None:
+            rec["slot"] = req.slot
+        if self.version is not None:
+            rec["version"] = self.version
+        if self.replica is not None:
+            rec["replica"] = self.replica
+        if req.span_id is not None:
+            rec["span"] = req.span_id
+            rec["parent_span"] = req.parent_span_id
+        rec.update(extra)
         if self._run_log is not None:
-            rec = {"event": event, "req": req.id, "trace": req.trace_id,
-                   "t": t, "at_step": self._step_no}
-            if req.slot is not None:
-                rec["slot"] = req.slot
-            if self.version is not None:
-                rec["version"] = self.version
-            rec.update(extra)
             self._run_log.write(rec)
+        fl = _flight.recorder()
+        if fl is not None:           # deque append — no I/O, no sync
+            fl.note(rec)
         return t
 
     def _stage_chunks(self, seq):
@@ -1350,11 +1385,30 @@ class ServingEngine:
         sheds queued load instead of only latching a counter. When a
         fleet router owns this engine it installs `anomaly_sink` so the
         same signal also sheds expired/lowest-priority work fleet-wide
-        (a supervisor decision no single replica can make)."""
+        (a supervisor decision no single replica can make) — and owns
+        the flight-recorder dump, fanned out across every replica; a
+        STANDALONE engine dumps its own evidence bundle here."""
+        fl = _flight.recorder()
+        if fl is not None:
+            fl.note_event("anomaly", **{k: v for k, v in event.items()
+                                        if k not in ("event", "t")})
         if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
             self.shed_queued(cause=event["anomaly"])
         if self.anomaly_sink is not None:
             self.anomaly_sink(event)
+        elif fl is not None:
+            _flight.dump_bundle(
+                reason=str(event.get("anomaly", "anomaly")),
+                run_logs=(self._run_log,) if self._run_log else (),
+                config=dict(serve_config=self.config_summary(),
+                            model_version=self.version),
+                extra=dict(anomaly=event))
+
+    def config_summary(self):
+        """Shallow JSON-friendly view of the active ServeConfig (the
+        flight bundle's config section; non-scalar fields repr)."""
+        return {f.name: getattr(self.cfg, f.name)
+                for f in dataclasses.fields(self.cfg)}
 
     def _done_reason(self, req, tok):
         """Retirement reason for the token just emitted, or None."""

@@ -93,7 +93,9 @@ import numpy as np
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.flags import get_flag
 from paddle_tpu.core.retry import RetryBudget, RetryPolicy
+from paddle_tpu.observability import flight as _flight
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace as _trace
 from paddle_tpu.parallel.heartbeat import STALLED, HeartBeatMonitor
 from paddle_tpu.serving.engine import ServeConfig, ServingEngine
 from paddle_tpu.testing.chaos import fault_point
@@ -197,6 +199,11 @@ class FleetRequest:
     retire_reason: str = None
     slo_ok: bool = None
     retriable: bool = False
+    trace_id: str = None          # durable fleet trace id, minted ONCE
+    #                               at submit and carried across every
+    #                               dispatch/failover hop
+    next_span: int = 0            # hop counter: each dispatch becomes
+    #                               span "hop<N>" under the root span
 
     @property
     def output(self):
@@ -267,7 +274,8 @@ class InProcessReplica:
             origin=spec.get("origin", "fleet"),
             temperature=spec.get("temperature"),
             top_k=spec.get("top_k"), top_p=spec.get("top_p"),
-            seed=spec.get("seed")) for spec in specs]
+            seed=spec.get("seed"),
+            trace=spec.get("trace")) for spec in specs]
 
     def cancel(self, rid):
         self._check()
@@ -405,7 +413,9 @@ class SubprocessReplica:
                 submit_age_s=(0.0 if spec["submit_t"] is None
                               else now - spec["submit_t"]),
                 first_token_age_s=(None if spec["first_token_t"] is None
-                                   else now - spec["first_token_t"]))
+                                   else now - spec["first_token_t"]),
+                trace=spec.get("trace"))   # durable context: the wire
+            #                                dict is already JSON-safe
             self._outbox.append(wire)
             lids.append(lid)
         return lids
@@ -418,7 +428,7 @@ class SubprocessReplica:
         gathered = launch.host_allgather(
             payload, 0, 2, self.exchange_dir,
             f"p{self.replica}.{tag}", timeout=self.timeout_s,
-            generation=self.generation)
+            generation=self.generation, ragged=True)
         return gathered[1]
 
     def step(self):
@@ -500,12 +510,13 @@ def replica_worker_loop(engine, exchange_dir=None, replica=None,
               if replica is None else replica)
     gen = int(os.environ.get("PT_FLEET_GENERATION", 0)
               if generation is None else generation)
+    engine.replica = rep          # stamps every trace event
     seq = 0
     reported = set()
     while True:
         gathered = launch.host_allgather(
             _pack({}), 1, 2, xdir, f"p{rep}.q{seq}", timeout=timeout_s,
-            generation=gen)
+            generation=gen, ragged=True)
         cmd = _unpack(gathered[0])
         now = clock()
         submitted = []
@@ -522,10 +533,14 @@ def replica_worker_loop(engine, exchange_dir=None, replica=None,
                 origin=spec.get("origin", "fleet"),
                 temperature=spec.get("temperature"),
                 top_k=spec.get("top_k"), top_p=spec.get("top_p"),
-                seed=spec.get("seed"))
+                seed=spec.get("seed"), trace=spec.get("trace"))
             submitted.append({"key": spec["key"], "rid": rid})
         if engine._queue or engine._running:
             engine.step()
+        if cmd.get("op") == "stop":
+            return                # close() never gathers a response —
+            #                       publishing one would block on a
+            #                       rank-0 file that never appears
         fin = _newly_terminal(engine, reported)
         now = clock()
 
@@ -548,10 +563,8 @@ def replica_worker_loop(engine, exchange_dir=None, replica=None,
         }
         launch.host_allgather(_pack(resp), 1, 2, xdir,
                               f"p{rep}.r{seq}", timeout=timeout_s,
-                              generation=gen)
+                              generation=gen, ragged=True)
         seq += 1
-        if cmd.get("op") == "stop":
-            return
 
 
 # --------------------------------------------------------------------------
@@ -608,6 +621,16 @@ class FleetRouter:
         if replicas is not None:
             self._replicas = list(replicas)
             self._versions = [cfg.baseline_version] * len(self._replicas)
+            for i, h in enumerate(list(self._replicas)):
+                # user-built engines miss the _engine_factory stamps;
+                # the router owns replica index + version identity
+                eng = getattr(h, "engine", None)
+                if eng is not None:
+                    if eng.replica is None:
+                        eng.replica = i
+                    if eng.version is None:
+                        eng.version = (f"{cfg.model_id}"
+                                       f"@{cfg.baseline_version}")
         else:
             enforce(model is not None and variables is not None,
                     "FleetRouter needs (model, variables) or explicit "
@@ -649,6 +672,11 @@ class FleetRouter:
         self._step_no = 0
         self._draining = False        # graft-guard: self._lock
         self.failovers = 0
+        # durable trace plane: one run prefix for every trace id this
+        # router mints; ids survive dispatch/failover hops (trace_fleet)
+        self._trace_run = _trace.mint_run()
+        self._flight_dumped = set()   # anomaly kinds already bundled;
+        #                               graft-guard: self._lock
         from paddle_tpu.observability.exporter import start_metrics_server
         self._metrics_server = start_metrics_server(cfg.metrics_port)
         self._publish()
@@ -661,11 +689,21 @@ class FleetRouter:
         def build():
             sc = dataclasses.replace(self._serve_template)
             sc.metrics_port = 0      # ONE exporter, owned by the router
+            if isinstance(sc.run_log, str) and sc.run_log:
+                # per-replica RunLogs: N engines in one process must not
+                # interleave one JSONL — the fleet-trace merge wants one
+                # anchored log per replica ("{replica}" templates, else
+                # an .r<i> suffix; non-digit, so rotation reads skip it)
+                sc.run_log = (sc.run_log.format(replica=i)
+                              if "{replica}" in sc.run_log
+                              else f"{sc.run_log}.r{i}")
             with self._lock:
                 version = self._versions[i]
                 variables = self._weights[version]
             sc.model_version = f"{self.cfg.model_id}@{version}"
-            return ServingEngine(self._model, variables, sc)
+            eng = ServingEngine(self._model, variables, sc)
+            eng.replica = i          # stamps every trace event
+            return eng
         return build
 
     def _sink_for(self, i):
@@ -696,6 +734,10 @@ class FleetRouter:
             rec.top_p = top_p
             rec.seed = ((1_000_003 * rec.id + 12_345) & 0xFFFFFFFF
                         if seed is None else int(seed) & 0xFFFFFFFF)
+            if get_flag("trace_fleet"):
+                # the durable context: minted HERE, once; every
+                # dispatch/failover hop derives a child span of it
+                rec.trace_id = f"{self._trace_run}/{rec.id}"
             rec.submit_t = self._clock()
             self.requests[rec.id] = rec
             _metrics.counter("serve.requests").inc(status="submitted")
@@ -1079,13 +1121,24 @@ class FleetRouter:
             self._by_replica[(i, rid)] = rec.id
 
     def _spec_of(self, rec, origin="fleet"):
+        trace = None
+        if rec.trace_id is not None:
+            # each hop is a child span of the router's root: hop0 =
+            # first dispatch, hop1 = the failover re-route, ... — the
+            # trace id itself NEVER changes across hops
+            ctx = _trace.TraceContext(
+                rec.trace_id, span_id=f"hop{rec.next_span}",
+                parent_span_id="root" if rec.next_span == 0
+                else f"hop{rec.next_span - 1}")
+            rec.next_span += 1
+            trace = ctx.to_wire()
         return dict(prompt=rec.prompt, tokens=list(rec.tokens),
                     max_new=rec.max_new, eos_id=rec.eos_id,
                     priority=rec.priority, deadline_t=rec.deadline_t,
                     submit_t=rec.submit_t,
                     first_token_t=rec.first_token_t,
                     temperature=rec.temperature, top_k=rec.top_k,
-                    top_p=rec.top_p, seed=rec.seed,
+                    top_p=rec.top_p, seed=rec.seed, trace=trace,
                     origin=origin if not rec.reroutes else "failover")
 
     # -- live ops: deploy / canary / autoscale ----------------------------
@@ -1568,6 +1621,16 @@ class FleetRouter:
                 rec.first_token_t = inf["first_token_t"]
 
     def _on_replica_anomaly(self, replica, event):
+        # fleet-level flight dump FIRST — evidence before mitigation
+        # mutates the state it should document. One bundle per anomaly
+        # kind per router (the engine watchdog latches per kind too):
+        # every replica's RunLog tail + the fleet state land in ONE dir.
+        kind = str(event.get("anomaly", "anomaly"))
+        with self._lock:
+            fresh = kind not in self._flight_dumped
+            self._flight_dumped.add(kind)
+        if fresh and _flight.recorder() is not None:
+            self._flight_fanout(replica, kind, event)
         if event.get("anomaly") in ("goodput_collapse", "ingest_stall"):
             # same signal plane drives both relief valves: spare
             # capacity spawns first (the autoscaler's cooldown and
@@ -1575,6 +1638,33 @@ class FleetRouter:
             with self._lock:
                 self._autoscale()
             self.shed_pending(cause=event["anomaly"])
+
+    def _flight_fanout(self, replica, kind, event):
+        """One fleet-level evidence bundle: every replica's RunLog tail,
+        the fleet topology/state summary, and the local event ring —
+        the drill artifact is complete even though only one replica's
+        watchdog fired."""
+        run_logs = []
+        with self._lock:
+            for h in list(self._replicas):
+                eng = getattr(h, "engine", None)
+                rl = getattr(eng, "_run_log", None) if eng else None
+                if rl is not None:
+                    run_logs.append(rl)
+            summary = dict(
+                states=list(self._states),
+                versions=list(self._versions),
+                baseline_version=self._baseline_version,
+                canary_version=self._canary_version,
+                pending=len(self._pending),
+                outstanding=self._outstanding(),
+                failovers=self.failovers,
+                num_replicas=len(self._replicas))
+        _flight.dump_bundle(
+            reason=kind, run_logs=run_logs,
+            config=dict(fleet=summary,
+                        fleet_config=dataclasses.asdict(self.cfg)),
+            extra=dict(anomaly=event, source_replica=replica))
 
     def _retire(self, rec, status, why, finished=None, account=True,
                 count=True):

@@ -27,7 +27,9 @@ import time
 import jax
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.observability import flight as _flight
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import trace as _trace
 from paddle_tpu.observability.spans import span
 from paddle_tpu.testing.chaos import fault_point
 
@@ -357,13 +359,33 @@ class Trainer:
         steady-state retraces."""
         from paddle_tpu.observability.watchdog import maybe_watchdog
         wd = maybe_watchdog(self.cfg.watchdog,
-                            run_log=getattr(tele, "_log", None))
+                            run_log=getattr(tele, "_log", None),
+                            action=lambda event: self._on_anomaly(
+                                event, getattr(tele, "_log", None)))
         if wd is not None:
             wd.watch_jit("trainer.step",
                          step_callable if step_callable is not None
                          else self.step_fn)
         self.watchdog = wd
         return wd
+
+    def _on_anomaly(self, event, run_log=None):
+        """Watchdog mitigation hook: every trainer anomaly becomes a
+        self-documenting flight bundle — metrics snapshot, the event
+        ring (step-phase spans linked into the active trace context),
+        and the telemetry RunLog tail. Recording off (flight_ring=0)
+        makes this a no-op; the watchdog's dispatcher already swallows
+        handler failures."""
+        fl = _flight.recorder()
+        if fl is None:
+            return
+        fl.note_event("anomaly", **{k: v for k, v in event.items()
+                                    if k not in ("event", "t")})
+        _flight.dump_bundle(
+            reason=str(event.get("anomaly", "anomaly")),
+            run_logs=(run_log,) if run_log is not None else (),
+            config=dict(trainer_config=repr(self.cfg)),
+            extra=dict(anomaly=event))
 
     def train(self, state, dataset, batch_size=None, num_workers=None,
               worker_id=None):
@@ -424,6 +446,10 @@ class Trainer:
         start_step = step
         preempt, restore_signals = self._install_preemption_handler()
         tele = self._start_telemetry()
+        if tele is not None and getattr(tele, "_log", None) is not None:
+            # clock anchor: lets the fleet-trace merge interleave this
+            # run's RunLog with serving-replica logs skew-corrected
+            _trace.write_anchor(tele._log, role="trainer")
         wd = self._start_watchdog(tele, step_call)
         if guard is not None:
             guard.attach(run_log=getattr(tele, "_log", None), watchdog=wd)
@@ -558,6 +584,11 @@ class Trainer:
         mesh_scope = contextlib.ExitStack()
         if plan_mesh is not None:
             mesh_scope.enter_context(plan_mesh)
+        # one trace context covers the whole train loop: the step-phase
+        # spans (ingest/stage/step) below link into it via the flight
+        # ring, so an anomaly bundle shows WHERE in the step the run was
+        mesh_scope.enter_context(_trace.activate(_trace.TraceContext(
+            f"{_trace.mint_run()}/train", span_id="train")))
         try:
             with span("ingest"):
                 nxt = next_batch()

@@ -256,6 +256,9 @@ FAULT_POINTS = {
     "fleet.respawn": "fleet router respawning a dead replica",
     "fleet.scale": "fleet autoscaler acting on a load signal (spawn "
                    "or graceful drain-then-retire)",
+    "flight.dump": "anomaly-triggered flight-recorder bundle dump (a "
+                   "fault aborts the dump; the anomaly handler must "
+                   "survive — no bundle, engine keeps serving)",
     "quant.kv_write": "quantized paged-KV admission write (a fault "
                       "degrades that admission to private pages — no "
                       "prefix-cache mapping or publish)",

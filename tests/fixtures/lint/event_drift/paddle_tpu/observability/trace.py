@@ -1,0 +1,6 @@
+"""Planted catalog: one live kind, one with no writer call site."""
+
+EVENTS = {
+    "used.event": "has a writer call site",
+    "unused.event": "PLANTED: registered but never emitted",
+}

@@ -1,0 +1,13 @@
+"""Planted unregistered trace-event writer call site."""
+
+
+def _trace_event(req, event):
+    pass
+
+
+def note_event(kind, **fields):
+    pass
+
+
+_trace_event(None, "used.event")   # clean
+note_event("rogue.event")          # PLANTED: not in trace.EVENTS

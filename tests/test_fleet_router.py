@@ -127,6 +127,34 @@ class TestHostAllgather:
         t.join()
         assert np.array_equal(out, np.stack([mine, theirs]))
 
+    def test_ragged_gather_returns_per_rank_payloads(self, tmp_path):
+        """ragged=True carries different-length payloads per rank — the
+        fleet JSON wire's shape (rank 0 a command, rank 1 a 2-byte ack)
+        that np.stack would reject. Without the flag the same exchange
+        raises, proving the stacked path still guards shape bugs."""
+        from paddle_tpu.parallel import launch
+        from paddle_tpu.serving.fleet import _pack, _unpack
+        xdir = str(tmp_path)
+        cmd = _pack({"op": "round", "submit": [{"key": 0}]})
+        ack = _pack({})
+        assert cmd.shape != ack.shape
+
+        def peer():
+            launch.host_allgather(ack, 1, 2, xdir, "rg", timeout=5.0,
+                                  ragged=True)
+
+        t = threading.Thread(target=peer)
+        t.start()
+        out = launch.host_allgather(cmd, 0, 2, xdir, "rg", timeout=5.0,
+                                    ragged=True)
+        t.join()
+        assert isinstance(out, list) and len(out) == 2
+        assert _unpack(out[0]) == {"op": "round", "submit": [{"key": 0}]}
+        assert _unpack(out[1]) == {}
+        _publish_raw(xdir, "rg2_1", ack)
+        with pytest.raises(ValueError, match="same shape"):
+            launch.host_allgather(cmd, 0, 2, xdir, "rg2", timeout=5.0)
+
     def test_timeout_still_raises_timeout_error(self, tmp_path):
         from paddle_tpu.parallel import launch
         with pytest.raises(TimeoutError, match="rank 1 did not publish"):
@@ -208,6 +236,58 @@ class TestFailoverReplay:
                                   undisturbed.requests[rid].output), fid
         undisturbed.close()
         router.close()
+
+    def test_failover_keeps_one_trace_id_across_replicas(
+            self, fast_retry, tmp_path):
+        """ISSUE-19 acceptance: kill a replica mid-decode, then merge
+        the per-replica RunLogs — the re-routed request keeps its
+        router-minted trace id on the completing replica, so ONE trace
+        spans both logs, hop spans chained (hop0 -> hop1) and the
+        failover adoption annotated."""
+        from paddle_tpu.observability import trace
+        from paddle_tpu.observability.runlog import read_records
+        tpl = str(tmp_path / "serve.{replica}.jsonl")
+        router, model, variables, cfg = _router(
+            num_replicas=2, serve_kw=dict(run_log=tpl))
+        prompts = _mixed_prompts(cfg, 6, seed=7)
+        fids = [router.submit(p, max_new=8) for p in prompts]
+        for _ in range(2):
+            router.step()
+        victim = next(i for i in range(2)
+                      if router._replicas[i].load() > 0)
+        router.kill_replica(victim)
+        router.drain()
+        router.close()
+
+        rerouted = [fid for fid in fids
+                    if router.requests[fid].reroutes]
+        assert rerouted, "kill landed on an idle replica"
+        fid = rerouted[0]
+        tid = router.requests[fid].trace_id
+        assert tid and tid.startswith(router._trace_run + "/")
+
+        lists = {f"r{i}": read_records(tpl.format(replica=i))
+                 for i in range(2)}
+        merged = trace.merge_fleet_trace(lists)
+        assert all(s["anchored"] for s in merged["skew"].values()), (
+            merged["skew"])
+        evs = trace.group_by_trace(merged["events"])[tid]
+        # the ONE trace id spans both replicas' logs, causally ordered
+        assert {e["source"] for e in evs} == {"r0", "r1"}
+        assert [e["wall_t"] for e in evs] == sorted(
+            e["wall_t"] for e in evs)
+        assert evs[0]["event"] == "adopted"
+        assert evs[0]["span"] == "hop0"
+        assert evs[-1]["event"] == "retired"
+        fo = next(e for e in evs if e["event"] == "adopted"
+                  and e.get("origin") == "failover")
+        # the failover hop is a CHILD span of the original dispatch,
+        # served by the other replica under the same trace id
+        assert fo["parent_span"] == "hop0" and fo["span"] == "hop1"
+        assert fo["source"] != evs[0]["source"]
+        assert fo["trace"] == evs[0]["trace"] == tid
+        # every event names who served it
+        assert all("replica" in e and "version" in e for e in evs), evs
 
     def test_deadline_priority_survive_reroute(self, fast_retry):
         """The re-routed request reaches the new replica with its
@@ -438,7 +518,7 @@ model = GPTDecoder(cfg)
 variables = model.init(jax.random.key(0))
 engine = ServingEngine(model, variables, ServeConfig(
     num_slots=2, page_size=8, max_len=64, prefill_len=16,
-    metrics_port=0))
+    metrics_port=0, run_log={run_log!r}))
 replica_worker_loop(engine)
 """
 
@@ -844,22 +924,30 @@ def test_subprocess_replica_failover_end_to_end(tmp_path, fast_retry):
     """A replica engine in a child process over the host_allgather
     transport: dispatch + decode round-trips work, a kill -9 mid-stream
     is detected, the worker respawns at generation+1 (stale exchange
-    files isolated), and re-routed requests finish token-exact."""
+    files isolated), and re-routed requests finish token-exact. The
+    router-minted trace context rides the JSON wire: merging the child's
+    and the spare's RunLogs yields ONE timeline where every re-routed
+    request keeps its trace id across both processes."""
     import sys as _sys
 
+    from paddle_tpu.observability import trace
+    from paddle_tpu.observability.runlog import read_records
     from paddle_tpu.serving import (FleetConfig, FleetRouter,
                                     ServingEngine)
     from paddle_tpu.serving.fleet import (InProcessReplica,
                                           SubprocessReplica)
     model, variables, cfg = _shared_decoder()
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sub_log = str(tmp_path / "serve.r0.jsonl")
+    spare_log = str(tmp_path / "serve.r1.jsonl")
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER.format(repo=repo))
+    script.write_text(_WORKER.format(repo=repo, run_log=sub_log))
     sub = SubprocessReplica(
         [_sys.executable, str(script)], str(tmp_path / "xdir"),
         replica=0, timeout_s=120.0)
     spare = InProcessReplica(
-        lambda: ServingEngine(model, variables, _serve_cfg()))
+        lambda: ServingEngine(model, variables,
+                              _serve_cfg(run_log=spare_log)))
     router = FleetRouter(
         config=FleetConfig(num_replicas=2, heartbeat_s=200.0,
                            metrics_port=0),
@@ -885,6 +973,23 @@ def test_subprocess_replica_failover_end_to_end(tmp_path, fast_retry):
         assert any(router.requests[f].reroutes for f in on_sub)
         assert sub.generation >= 1     # respawned incarnation
         undisturbed.close()
+
+        # ISSUE-19 acceptance: ONE merged timeline across the kill -9 —
+        # the re-routed request's trace id appears in BOTH processes'
+        # logs (the child's, written pre-kill, and the spare's)
+        merged = trace.merge_fleet_trace(
+            {"r0": read_records(sub_log), "r1": read_records(spare_log)})
+        assert all(s["anchored"] for s in merged["skew"].values()), (
+            merged["skew"])
+        groups = trace.group_by_trace(merged["events"])
+        crossed = [f for f in on_sub if router.requests[f].reroutes]
+        assert crossed
+        for fid in crossed:
+            tid = router.requests[fid].trace_id
+            evs = groups.get(tid) or []
+            assert {e["source"] for e in evs} == {"r0", "r1"}, (
+                tid, [(e["source"], e["event"]) for e in evs])
+            assert evs[-1]["event"] == "retired"
     finally:
         router.close()
 

@@ -14,6 +14,7 @@ import pytest
 
 from paddle_tpu.analysis import contracts, lint
 from paddle_tpu.analysis.rules.catalog_drift import CatalogDrift
+from paddle_tpu.analysis.rules.event_drift import EventDrift
 from paddle_tpu.analysis.rules.fault_point_drift import FaultPointDrift
 from paddle_tpu.analysis.rules.flag_drift import FlagDrift
 from paddle_tpu.analysis.rules.hot_path_sync import HotPathSync
@@ -122,6 +123,17 @@ def test_fault_point_drift_fixture_fires_both_directions():
     assert len(fs) == 2, [f.format() for f in fs]
     assert any("'rogue.point'" in m for m in msgs)
     assert any("'unused.point'" in m for m in msgs)
+
+
+def test_event_drift_fixture_fires_both_directions():
+    rule = EventDrift(scope=_ALL, min_sites=1)
+    fs = list(rule.check(_fixture_ctx("event_drift")))
+    msgs = [f.message for f in fs]
+    assert len(fs) == 2, [f.format() for f in fs]
+    assert any("'rogue.event'" in m and "not registered" in m
+               for m in msgs)
+    assert any("'unused.event'" in m and "never happens" in m
+               for m in msgs)
 
 
 def test_raw_pallas_call_fixture_fires():

@@ -255,6 +255,87 @@ class TestFleetReport:
         assert "no fleet ops events" in rep
 
 
+def _fleet_trace_lists():
+    """Two replica logs with skewed monotonic epochs and one request
+    failed over from r0 to r1 under a single trace id."""
+    tid = "abcd1234/0"
+    r0 = [
+        {"anchor": {"wall": 1000.0, "mono": 10.0}, "pid": 1},
+        {"event": "adopted", "req": 0, "trace": tid, "t": 11.0,
+         "at_step": 0, "replica": 0, "version": "m@v0",
+         "span": "hop0", "parent_span": "root", "origin": "dispatch"},
+        {"event": "admitted", "req": 0, "trace": tid, "t": 11.2,
+         "at_step": 1, "replica": 0, "span": "hop0"},
+        {"event": "prefill_done", "req": 0, "trace": tid, "t": 11.4,
+         "at_step": 1, "replica": 0, "span": "hop0"},
+        {"event": "first_token", "req": 0, "trace": tid, "t": 11.4,
+         "at_step": 1, "replica": 0, "span": "hop0"},
+    ]
+    r1 = [
+        {"anchor": {"wall": 1000.0, "mono": 900.0}, "pid": 2},
+        {"event": "adopted", "req": 0, "trace": tid, "t": 912.0,
+         "at_step": 0, "replica": 1, "version": "m@v0",
+         "span": "hop1", "parent_span": "hop0", "origin": "failover"},
+        {"event": "resumed", "req": 0, "trace": tid, "t": 912.1,
+         "at_step": 1, "replica": 1, "span": "hop1"},
+        {"event": "prefill_done", "req": 0, "trace": tid, "t": 912.3,
+         "at_step": 1, "replica": 1, "span": "hop1"},
+        {"event": "first_token", "req": 0, "trace": tid, "t": 912.3,
+         "at_step": 1, "replica": 1, "span": "hop1"},
+        {"event": "retired", "req": 0, "trace": tid, "t": 913.0,
+         "at_step": 2, "replica": 1, "span": "hop1", "reason": "eos",
+         "tokens": 6, "slo_ok": True},
+    ]
+    return {"r0": r0, "r1": r1}
+
+
+class TestFleetTraceReport:
+    def test_skew_gantt_and_critical_path(self):
+        rep = _import_run_report().render_fleet_trace(
+            _fleet_trace_lists())
+        assert "FLEET TRACE" in rep
+        assert "clock-skew report" in rep
+        assert "abcd1234/0" in rep
+        # one trace, two replica rows, the failover adoption marked
+        assert "hop0" in rep and "hop1" in rep
+        assert "F" in rep and "[m@v0]" in rep
+        assert "critical-path breakdown" in rep
+        for phase in ("queue", "prefill", "first_token", "decode",
+                      "total"):
+            assert phase in rep
+
+    def test_cli_fleet_trace_flag(self, tmp_path):
+        paths = []
+        for src, recs in _fleet_trace_lists().items():
+            p = tmp_path / f"serve.{src}.jsonl"
+            with open(p, "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+            paths.append(str(p))
+        proc = subprocess.run(
+            [sys.executable, RUN_REPORT, *paths, "--fleet-trace"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        assert "FLEET TRACE" in proc.stdout
+        assert "skew" in proc.stdout
+
+    def test_extra_runlogs_require_fleet_trace(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        p.write_text("{}\n")
+        proc = subprocess.run(
+            [sys.executable, RUN_REPORT, str(p), str(p)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=120, cwd=REPO)
+        assert proc.returncode != 0
+        assert "--fleet-trace" in proc.stderr
+
+    def test_no_trace_events_degrades_gracefully(self):
+        rep = _import_run_report().render_fleet_trace(
+            {"r0": _records()})
+        assert "no request trace events" in rep
+
+
 @pytest.mark.perf
 def test_run_report_selftest_smoke():
     """Tier-1: tiny GPT through the Trainer with telemetry on (CPU),

@@ -1,0 +1,275 @@
+"""Distributed tracing + flight recorder (observability/trace.py,
+observability/flight.py).
+
+Acceptance surface (ISSUE 19): durable TraceContexts survive the wire
+round-trip; `merge_fleet_trace` reconstructs causal order across
+replica logs whose monotonic clocks share no epoch (injected skew);
+the flight ring is bounded; `dump_bundle()` lands every section with
+the manifest written last; an injected `flight.dump` fault is
+swallowed bundle-less (`flight.dumps{status=error}`); a flush-spy run
+proves tracing + flight recording add ZERO blocking device syncs; and
+`read_records` survives a non-numeric rotation-lookalike sibling
+(`run.jsonl.2bak`) instead of crashing every report."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.core.flags import all_flags, set_flags
+from paddle_tpu.observability import flight, trace
+from paddle_tpu.observability import metrics as M
+from paddle_tpu.observability.runlog import (RunLog, read_records,
+                                             tail_records)
+from paddle_tpu.testing import chaos
+
+
+@pytest.fixture
+def flags_guard():
+    saved = all_flags()
+    yield
+    set_flags(saved)
+
+
+@pytest.fixture
+def fresh_ring(flags_guard):
+    """A clean process-global flight ring for the test (the singleton
+    survives across tests otherwise)."""
+    flight._RECORDER = None
+    yield
+    flight._RECORDER = None
+
+
+class TestTraceContext:
+    def test_wire_round_trip(self):
+        ctx = trace.TraceContext("ab12cd34/7", span_id="hop1",
+                                 parent_span_id="hop0")
+        back = trace.TraceContext.from_wire(ctx.to_wire())
+        assert (back.trace_id, back.span_id, back.parent_span_id) == \
+            ("ab12cd34/7", "hop1", "hop0")
+
+    def test_from_wire_rejects_empty(self):
+        assert trace.TraceContext.from_wire(None) is None
+        assert trace.TraceContext.from_wire({}) is None
+        assert trace.TraceContext.from_wire({"trace_id": ""}) is None
+
+    def test_child_links_parent(self):
+        root = trace.TraceContext("t1")
+        hop = root.child("hop0")
+        assert hop.trace_id == "t1"
+        assert hop.parent_span_id == root.span_id
+
+    def test_activate_nests(self):
+        assert trace.current() is None
+        with trace.activate(trace.TraceContext("outer")) as a:
+            assert trace.current() is a
+            with trace.activate(trace.TraceContext("inner")) as b:
+                assert trace.current() is b
+            assert trace.current() is a
+        assert trace.current() is None
+
+    def test_mint_run_unique(self):
+        assert trace.mint_run() != trace.mint_run()
+
+
+class TestSkewMerge:
+    def test_merge_corrects_injected_skew(self):
+        # two replicas whose perf_counter epochs are wildly apart:
+        # r0's monotonic clock reads ~50, r1's ~950, same wall epoch.
+        # Raw `t` interleaving would put ALL of r0 before r1; the
+        # anchor rebase must recover true wall order (alternating).
+        r0 = [dict(anchor=dict(wall=1000.0, mono=50.0), pid=1),
+              dict(event="submitted", req=0, t=51.0),
+              dict(event="retired", req=0, t=53.0)]
+        r1 = [dict(anchor=dict(wall=1000.0, mono=950.0), pid=2),
+              dict(event="adopted", req=0, t=952.0),
+              dict(event="first_token", req=0, t=952.5)]
+        merged = trace.merge_fleet_trace({"r0": r0, "r1": r1})
+        names = [(e["source"], e["event"]) for e in merged["events"]]
+        assert names == [("r0", "submitted"), ("r1", "adopted"),
+                         ("r1", "first_token"), ("r0", "retired")]
+        walls = [e["wall_t"] for e in merged["events"]]
+        assert walls == sorted(walls)
+        assert walls[0] == pytest.approx(1001.0)
+        sk = merged["skew"]
+        assert sk["r0"]["anchored"] and sk["r1"]["anchored"]
+        # offsets differ by the epoch gap; skew is relative to the
+        # earliest-anchored source
+        assert sk["r0"]["offset"] - sk["r1"]["offset"] == \
+            pytest.approx(900.0)
+        assert min(s["skew_s"] for s in sk.values()) == 0.0
+
+    def test_unanchored_source_called_out(self):
+        r0 = [dict(anchor=dict(wall=10.0, mono=0.0), pid=1),
+              dict(event="submitted", req=0, t=1.0)]
+        r1 = [dict(event="retired", req=0, t=2.0)]   # no anchor
+        merged = trace.merge_fleet_trace({"r0": r0, "r1": r1})
+        assert merged["skew"]["r1"]["anchored"] is False
+        assert merged["skew"]["r1"]["skew_s"] is None
+        # the unanchored log still merges (raw times), never dropped
+        assert {e["source"] for e in merged["events"]} == {"r0", "r1"}
+
+    def test_group_by_trace(self):
+        evs = [dict(event="submitted", trace="a", wall_t=1.0),
+               dict(event="anchor", wall_t=0.0),
+               dict(event="retired", trace="a", wall_t=2.0)]
+        groups = trace.group_by_trace(evs)
+        assert [e["event"] for e in groups["a"]] == ["submitted",
+                                                     "retired"]
+        assert None in groups
+
+    def test_write_anchor_round_trips_runlog(self, tmp_path,
+                                             fresh_ring):
+        rl = RunLog(str(tmp_path / "a.jsonl"))
+        rec = trace.write_anchor(rl, role="test")
+        rl.close()
+        got = read_records(str(tmp_path / "a.jsonl"))
+        assert got[0]["anchor"]["wall"] == rec["anchor"]["wall"]
+        assert got[0]["role"] == "test"
+
+
+class TestFlightRing:
+    def test_ring_is_bounded(self):
+        ring = flight.FlightRecorder(4)
+        for i in range(10):
+            ring.note_event("span", name=f"s{i}", dt=0.0)
+        snap = ring.snapshot()
+        assert len(snap) == 4
+        assert snap[0]["name"] == "s6" and snap[-1]["name"] == "s9"
+
+    def test_recorder_flag_gating(self, fresh_ring):
+        set_flags({"flight_ring": 0})
+        assert flight.recorder() is None
+        set_flags({"flight_ring": 8})
+        rec = flight.recorder()
+        assert rec is not None and rec.size == 8
+        assert flight.recorder() is rec          # stable singleton
+        set_flags({"flight_ring": 16})
+        assert flight.recorder().size == 16      # resize rebuilds
+
+    def test_note_span_links_active_context(self, fresh_ring):
+        set_flags({"flight_ring": 8})
+        with trace.activate(trace.TraceContext("t9", span_id="train")):
+            trace.note_span("step", 0.01)
+        ev = flight.recorder().snapshot()[-1]
+        assert ev["event"] == "span" and ev["trace"] == "t9"
+        assert ev["span"] == "train"
+
+
+class TestDumpBundle:
+    def test_bundle_sections_and_manifest(self, tmp_path, fresh_ring):
+        set_flags({"flight_ring": 32})
+        rl = RunLog(str(tmp_path / "serve.jsonl"))
+        trace.write_anchor(rl)
+        rl.write(dict(event="submitted", req=0, t=1.0))
+        rl.close()
+        flight.recorder().note_event("anomaly", anomaly="slow_step")
+        path = flight.dump_bundle(
+            "slow_step", run_logs=(str(tmp_path / "serve.jsonl"),),
+            config=dict(num_slots=2), extra=dict(anomaly="slow_step"),
+            out_dir=str(tmp_path / "bundles"))
+        assert path is not None
+        man = flight.read_manifest(path)
+        assert man["reason"] == "slow_step"
+        assert man["sections"] == ["metrics.json", "ring.jsonl",
+                                   "runlog_tail.jsonl", "config.json"]
+        ring = [json.loads(ln) for ln in
+                open(os.path.join(path, "ring.jsonl"))]
+        assert any(e.get("anomaly") == "slow_step" for e in ring)
+        tails = [json.loads(ln) for ln in
+                 open(os.path.join(path, "runlog_tail.jsonl"))]
+        assert any(r.get("event") == "submitted" for r in tails)
+        assert all("_runlog" in r for r in tails)
+        cfgd = json.load(open(os.path.join(path, "config.json")))
+        assert cfgd == {"num_slots": 2}
+        assert flight.last_bundle() == path
+        assert flight.list_bundles(str(tmp_path / "bundles")) == [path]
+
+    def test_faulted_dump_swallowed_bundle_less(self, tmp_path,
+                                                fresh_ring):
+        err0 = M.counter("flight.dumps").snapshot().get(
+            "status=error", 0)
+        plan = chaos.FaultPlan(seed=0)
+        plan.fail("fault_point", path=r"^flight\.dump$", times=1,
+                  exc=chaos.InjectedFault("dump aborted"))
+        with chaos.active(plan):
+            path = flight.dump_bundle(
+                "anomaly", out_dir=str(tmp_path / "bundles"))
+        assert path is None
+        assert plan.fired("fault_point") == 1
+        assert flight.list_bundles(str(tmp_path / "bundles")) == []
+        assert M.counter("flight.dumps").snapshot().get(
+            "status=error", 0) - err0 == 1
+
+    def test_unserializable_config_reprs_not_raises(self, tmp_path,
+                                                    fresh_ring):
+        path = flight.dump_bundle(
+            "anomaly", config=dict(lock=object()),
+            out_dir=str(tmp_path / "bundles"))
+        assert path is not None
+        cfgd = json.load(open(os.path.join(path, "config.json")))
+        assert cfgd["lock"].startswith("<object object")
+
+
+class TestNoHotPathSync:
+    def test_tracing_and_flight_add_no_device_sync(
+            self, rng, tmp_path, monkeypatch, fresh_ring):
+        """Flush-spy: with the trace plane AND the flight ring live, a
+        full submit/step/drain cycle performs zero block_until_ready-
+        style syncs — events are host clocks + deque/JSONL appends."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
+        from paddle_tpu.serving import ServeConfig, ServingEngine
+        set_flags({"flight_ring": 64})
+        cfg = GPTConfig.tiny()
+        cfg.dropout = 0.0
+        model = GPTDecoder(cfg)
+        v = model.init(jax.random.key(0))
+        rl = str(tmp_path / "nosync.jsonl")
+        eng = ServingEngine(model, v, ServeConfig(
+            num_slots=2, page_size=8, max_len=32, prefill_len=16,
+            num_pages=10, run_log=rl, metrics_port=0))
+
+        def no_sync(*a, **k):
+            raise AssertionError(
+                "block_until_ready during traced serving")
+
+        monkeypatch.setattr(jax, "block_until_ready", no_sync)
+        for L in (3, 9, 5):
+            eng.submit(rng.randint(0, cfg.vocab_size, (L,))
+                       .astype(np.int32), max_new=4)
+        eng.drain()
+        eng.close()
+        # the trace plane was actually live on both sinks
+        recs = read_records(rl)
+        assert recs[0].get("anchor"), "RunLog did not open with anchor"
+        assert sum(1 for r in recs
+                   if r.get("event") == "retired") == 3
+        ring = flight.recorder().snapshot()
+        assert any(e.get("event") == "retired" for e in ring)
+
+
+class TestRunLogRotationSiblings:
+    def test_non_numeric_suffix_ignored_not_crashed(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with open(p, "w") as fh:
+            fh.write(json.dumps(dict(step=2)) + "\n")
+        with open(p + ".1", "w") as fh:
+            fh.write(json.dumps(dict(step=1)) + "\n")
+        with open(p + ".2bak", "w") as fh:          # operator copy
+            fh.write(json.dumps(dict(step=99)) + "\n")
+        recs = read_records(p)                      # must not raise
+        assert [r["step"] for r in recs] == [1, 2]
+
+    def test_tail_records_slices_across_rotation(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        log = RunLog(p, rotate_records=4, keep_rotated=3)
+        for i in range(10):
+            log.write(dict(step=i))
+        log.close()
+        assert [r["step"] for r in tail_records(p, limit=3)] == \
+            [7, 8, 9]
+        assert [r["step"] for r in tail_records(p, limit=0)] == \
+            list(range(10))

@@ -37,7 +37,11 @@ FleetRouter — mixed traffic, one replica killed mid-decode, one
 injected `fleet.heartbeat` stall. Verifies 100% terminal requests,
 token-exact greedy completions through the failover replay,
 `fleet.failovers` == injected kills (the stall recovers, it does not
-fail over), and every replica inside its respawn RetryBudget.
+fail over), and every replica inside its respawn RetryBudget. A
+closing flight-recorder leg injects a `flight.dump` fault (the dump is
+swallowed, no half-bundle lands) then raises a real anomaly and
+verifies exactly ONE complete evidence bundle (manifest listing every
+section) fans out across the fleet.
 
 Guardian drill (--train): training-side numerical resilience, two
 phases. Containment (in-process): a 16-step run eats a NaN batch
@@ -691,7 +695,10 @@ def run_fleet_drill(seed=0):
     token-exact vs per-request generate() references,
     `fleet.failovers` == injected kills (the transient stall must NOT
     count), no replica exceeds its respawn RetryBudget, and
-    `jit.retraces{fn=serve.decode}` stays flat across the failover."""
+    `jit.retraces{fn=serve.decode}` stays flat across the failover.
+    A closing flight-recorder leg asserts a fault-injected
+    `flight.dump` is swallowed bundle-less, then a real anomaly lands
+    exactly one complete bundle (manifest lists every section)."""
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time as _time
@@ -808,6 +815,50 @@ def run_fleet_drill(seed=0):
         for h in router._replicas:
             if h.alive() and h.engine.decode_traces:
                 assert h.engine.decode_traces == 1, h.engine.decode_traces
+
+        # -- flight recorder --------------------------------------------
+        # an anomaly must land exactly ONE complete evidence bundle
+        # (the manifest is written last, so its presence certifies the
+        # bundle); a dump that faults mid-write is swallowed — the
+        # anomaly handler keeps the fleet serving — and leaves NO
+        # half-bundle behind.
+        from paddle_tpu.observability import flight as _flight
+        flight_dir = tempfile.mkdtemp(prefix="pt_flight_")
+        F.set_flags({"flight_dir": flight_dir})
+        err0 = _metrics.counter("flight.dumps").snapshot().get(
+            "status=error", 0)
+        fplan = chaos.FaultPlan(seed=seed)
+        fplan.fail("fault_point", path=r"^flight\.dump$", times=1,
+                   exc=chaos.InjectedFault("dump aborted mid-write"))
+        with chaos.active(fplan):
+            router._on_replica_anomaly(
+                0, {"anomaly": "drill_faulted_dump", "step": 0})
+        dump_faults = fplan.fired("fault_point")
+        assert dump_faults == 1, (
+            f"expected 1 injected flight.dump fault, {dump_faults}")
+        assert _flight.list_bundles(flight_dir) == [], (
+            "a fault-injected dump left a bundle behind")
+        dump_errors = _metrics.counter("flight.dumps").snapshot().get(
+            "status=error", 0) - err0
+        assert dump_errors == 1, (
+            "the swallowed dump failure was not counted on "
+            f"flight.dumps{{status=error}} (delta {dump_errors})")
+
+        # real anomaly, different kind (the router latches one bundle
+        # per kind): the sink path fans ONE fleet-level dump carrying
+        # every replica's RunLog tail + the fleet state summary
+        router._on_replica_anomaly(
+            0, {"anomaly": "drill_flight_check", "step": 0})
+        bundles = _flight.list_bundles(flight_dir)
+        assert len(bundles) == 1, (
+            f"expected exactly 1 complete bundle, got {bundles}")
+        manifest = _flight.read_manifest(bundles[0])
+        missing = [s for s in ("metrics.json", "ring.jsonl",
+                               "runlog_tail.jsonl", "config.json")
+                   if s not in manifest["sections"]]
+        assert not missing, f"bundle is missing sections: {missing}"
+        assert manifest["reason"] == "drill_flight_check", manifest
+
         return dict(
             submitted=len(statuses),
             statuses={s: sum(1 for v in statuses.values() if v == s)
@@ -815,7 +866,10 @@ def run_fleet_drill(seed=0):
             injected_kills=kills, failovers=router.failovers,
             heartbeat_stalls=missed, rerouted=rerouted,
             respawn_failures=[b.failures for b in router._budgets],
-            token_exact=len(accepted))
+            token_exact=len(accepted),
+            flight_faulted_dumps=dump_faults,
+            flight_bundle=bundles[0],
+            flight_sections=manifest["sections"])
     finally:
         if router is not None:
             router.close()

@@ -27,6 +27,14 @@ timeline from FleetRouter ops events (raw records, a dumped telemetry
 snapshot's `ops_log`, or a PT_BENCH_FLEET_RAMP=1 bench row), the
 per-version goodput table, and the goodput-vs-offered-load curve.
 
+`--fleet-trace` takes SEVERAL RunLogs (one per replica) and renders the
+distributed-tracing view: the logs merge into one causally ordered
+timeline via their wall/monotonic anchor records (clock-skew
+corrected), shown as a cross-replica per-request Gantt — a failover
+re-route appears as the SAME trace id continuing on another replica —
+plus the critical-path breakdown (queue -> prefill -> first token ->
+decode) and a skew report.
+
 `--train-health` renders the resilience view: guardian non-finite
 skips, loss-spike episodes and mitigation-ladder actions, rollbacks
 with their restore targets, watchdog anomalies, checkpoint-integrity
@@ -38,6 +46,7 @@ Usage:
   python tools/run_report.py run.jsonl --trace /tmp/prof --top 20
   python tools/run_report.py serve.jsonl --serve
   python tools/run_report.py fleet.jsonl --fleet
+  python tools/run_report.py serve.jsonl.r0 serve.jsonl.r1 --fleet-trace
   python tools/run_report.py run.jsonl --train-health
   python tools/run_report.py --selftest      # tier-1 smoke: tiny GPT
                                              # through the Trainer with
@@ -575,6 +584,123 @@ def render_fleet_report(records, width=64):
     return "\n".join(lines)
 
 
+def render_fleet_trace(record_lists, top=20, width=64):
+    """The fleet-wide distributed-tracing story: per-replica RunLogs
+    merged into ONE causally ordered timeline (per-process wall/mono
+    anchor records correct clock skew), then rendered as a clock-skew
+    report, a cross-replica per-request Gantt (failover / deploy-drain
+    re-admission / preemption annotated), and the critical-path phase
+    breakdown (queue -> dispatch -> prefill -> first token -> decode ->
+    retire) over retired requests. ``record_lists`` maps a source name
+    (one per replica RunLog) to its records."""
+    from paddle_tpu.observability.trace import (group_by_trace,
+                                                merge_fleet_trace)
+    merged = merge_fleet_trace(record_lists)
+    events = merged["events"]
+    lines = ["=" * 72, "FLEET TRACE", "=" * 72]
+
+    lines.append("\nclock-skew report (anchor offsets, relative to the "
+                 "earliest source):")
+    for src in sorted(merged["skew"]):
+        sk = merged["skew"][src]
+        if not sk["anchored"]:
+            lines.append(f"  {src:<24} NO ANCHOR — raw times, causal "
+                         "order not guaranteed")
+        else:
+            lines.append(f"  {src:<24} offset {sk['offset']:+.3f}s  "
+                         f"skew {sk['skew_s']:+.6f}s")
+
+    req_events = [e for e in events if "req" in e and e.get("trace")]
+    if not req_events:
+        lines.append("\n(no request trace events across these RunLogs)")
+        return "\n".join(lines + ["=" * 72])
+    traces = group_by_trace(req_events)
+    traces.pop(None, None)
+    t0 = min(e["wall_t"] for e in req_events)
+    t1 = max(e["wall_t"] for e in req_events)
+    span_t = max(t1 - t0, 1e-9)
+
+    def col(t):
+        return min(width - 1, int((t - t0) / span_t * width))
+
+    def trace_span(evs):
+        return evs[-1]["wall_t"] - evs[0]["wall_t"]
+
+    shown = sorted(traces.items(), key=lambda kv: -trace_span(kv[1]))[:top]
+    lines.append(
+        f"\ncross-replica request Gantt ({len(traces)} traces over "
+        f"{span_t:.3f}s; top {len(shown)} by span — one row per "
+        "replica a trace touched; A=adopted F=failover-adopt "
+        "!=preempted .=event R=retired):")
+    mark = {"adopted": "A", "preempted": "!", "retired": "R"}
+    for tid, evs in shown:
+        lines.append(f"  {tid}:")
+        sources = sorted({e["source"] for e in evs})
+        for src in sources:
+            mine = [e for e in evs if e["source"] == src]
+            row = [" "] * width
+            lo, hi = col(mine[0]["wall_t"]), col(mine[-1]["wall_t"])
+            for c in range(lo, hi + 1):
+                row[c] = "-"
+            # letters outrank "." when events share a column
+            rank = {" ": 0, "-": 0, ".": 1}
+            for e in mine:
+                m = mark.get(e["event"], ".")
+                if e["event"] == "adopted" and \
+                        e.get("origin") == "failover":
+                    m = "F"
+                c = col(e["wall_t"])
+                if rank.get(m, 2) >= rank.get(row[c], 2):
+                    row[c] = m
+            note = ""
+            hops = {e.get("span") for e in mine if e.get("span")}
+            if hops:
+                note = " " + ",".join(sorted(hops))
+            ver = next((e.get("version") for e in mine
+                        if e.get("version")), None)
+            if ver:
+                note += f" [{ver}]"
+            lines.append(f"    {src:<20} |{''.join(row)}|{note}")
+
+    # critical-path breakdown over retired traces: each phase edge is
+    # the time between consecutive lifecycle events (failover restarts
+    # a phase; the LAST occurrence wins, matching what the user waited)
+    phases = {"queue": [], "prefill": [], "first_token": [],
+              "decode": [], "total": []}
+    retired_n = 0
+    for tid, evs in traces.items():
+        def last_t(name, evs=evs):
+            hit = [e for e in evs if e["event"] == name]
+            return hit[-1]["wall_t"] if hit else None
+        start = min(e["wall_t"] for e in evs)
+        adopt = last_t("adopted") or last_t("submitted") or start
+        admit = max(filter(None, (last_t("admitted"),
+                                  last_t("resumed"))), default=None)
+        pf, ft, ret = (last_t("prefill_done"), last_t("first_token"),
+                       last_t("retired"))
+        if ret is None:
+            continue
+        retired_n += 1
+        if admit is not None:
+            phases["queue"].append(admit - adopt)
+        if pf is not None and admit is not None:
+            phases["prefill"].append(pf - admit)
+        if ft is not None and pf is not None:
+            phases["first_token"].append(ft - pf)
+        if ft is not None:
+            phases["decode"].append(ret - ft)
+        phases["total"].append(ret - start)
+    if retired_n:
+        lines.append(f"\ncritical-path breakdown ({retired_n} retired "
+                     "traces; last occurrence per phase wins across "
+                     "failover hops):")
+        for name in ("queue", "prefill", "first_token", "decode",
+                     "total"):
+            lines.append(_pctl_line(f"{name:<15}", phases[name]))
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
 def _selftest():
     """Tier-1 smoke (CPU-only): a tiny GPT trained through the Trainer
     with telemetry on must produce a RunLog whose records carry wall
@@ -653,6 +779,9 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("runlog", nargs="?", help="RunLog JSONL path "
                     "(rotated siblings are folded in automatically)")
+    ap.add_argument("extra_runlogs", nargs="*",
+                    help="additional per-replica RunLog paths "
+                         "(--fleet-trace merges them into one timeline)")
     ap.add_argument("--trace", default=None,
                     help="jax.profiler trace dir to join (top-K op table "
                          "via profiler.trace_op_table)")
@@ -671,6 +800,11 @@ def main():
                          "canary timeline, per-version goodput table, "
                          "and (from a ramp bench row) the goodput-vs-"
                          "offered-load curve")
+    ap.add_argument("--fleet-trace", action="store_true",
+                    help="merge the given per-replica RunLogs into one "
+                         "skew-corrected timeline: cross-replica "
+                         "per-request Gantt, critical-path breakdown, "
+                         "clock-skew report")
     ap.add_argument("--train-health", action="store_true",
                     help="render the training-resilience view: guardian "
                          "skips/spikes/rollbacks, watchdog anomalies, "
@@ -686,6 +820,16 @@ def main():
     if not args.runlog:
         ap.error("a RunLog path is required (or --selftest)")
     from paddle_tpu.observability.runlog import read_records
+    if args.fleet_trace:
+        paths = [args.runlog] + list(args.extra_runlogs)
+        lists = {}
+        for p in paths:
+            name = os.path.basename(p)
+            lists[p if name in lists else name] = read_records(p)
+        print(render_fleet_trace(lists, top=args.top))
+        return
+    if args.extra_runlogs:
+        ap.error("multiple RunLogs only make sense with --fleet-trace")
     records = read_records(args.runlog)
     if not records:
         raise SystemExit(f"no records in {args.runlog}")

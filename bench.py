@@ -570,7 +570,12 @@ def bench_gpt_serve(steps, batch, seq):
     identical request stream. PT_BENCH_KV_DTYPE=int8 stores the paged
     KV quantized (per-token scales ride the pool); the row reports
     kv_dtype / kv_pool_bytes / quant_overflow_clamps either way, so
-    the quantized-vs-f32 A/B is one env flip on the same stream."""
+    the quantized-vs-f32 A/B is one env flip on the same stream.
+    PT_BENCH_DRAFT=1 turns on speculative decoding (self-draft;
+    PT_BENCH_SPEC_K overrides the serve_spec_k window) — the row then
+    reports acceptance_rate / tokens_per_target_step from the engine's
+    speculation counters plus the cost-model draft_overhead, and the
+    speculation-off A/B is the same env flip on the same stream."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
@@ -597,17 +602,23 @@ def bench_gpt_serve(steps, batch, seq):
     # tighten on silicon): BENCH_*.json tracks the serving SLO trajectory
     slo_ttft = float(os.environ.get("PT_BENCH_SLO_TTFT", "2.0"))
     slo_tok = float(os.environ.get("PT_BENCH_SLO_TOKEN", "0.5"))
+    draft = os.environ.get("PT_BENCH_DRAFT", "0") == "1"
+    spec_k_env = os.environ.get("PT_BENCH_SPEC_K", "").strip()
     sc = ServeConfig(num_slots=batch, page_size=page,
                      max_len=shared_len + prefill_len + max_new,
                      prefill_len=prefill_len, cache_dtype=cache_dtype,
                      kv_dtype=_kv_dtype_env(),
                      run_log=RUN_LOG, slo_ttft_s=slo_ttft,
-                     slo_token_latency_s=slo_tok)
+                     slo_token_latency_s=slo_tok,
+                     draft=draft or None,
+                     spec_k=int(spec_k_env) if spec_k_env else None)
     engine = ServingEngine(model, variables, sc)
 
     if COMPILE_ONLY:
         t0 = time.perf_counter()
         engine.compiled_decode()
+        if draft:
+            engine.compiled_verify()
         return {"metric": "gpt_serve_compile_only", "value": 1.0,
                 "unit": "compiled", "vs_baseline": 0.0,
                 "compile_s": round(time.perf_counter() - t0, 1)}
@@ -643,6 +654,26 @@ def bench_gpt_serve(steps, batch, seq):
     total_tokens = sum(len(r.tokens) for r in done)
     stats = engine.latency_stats()
     slo = engine.slo_stats()
+    spec_row = {}
+    if draft:
+        # speculation accounting (measured) + the cost-model overhead
+        # figure the autoplan --serve-spec report prices from — the
+        # same predict_decode call, zero bench-local constants
+        from paddle_tpu.parallel.autoplan import (
+            ModelSpec, costmodel, get_topology)
+        spec = engine.spec_stats()
+        pred = costmodel.predict_decode(
+            ModelSpec.from_config(cfg, batch=batch, seq=sc.max_len,
+                                  name="gpt"),
+            get_topology(), slots=batch, context=sc.max_len,
+            spec_k=spec["spec_k"])
+        spec_row = {
+            "spec_k": spec["spec_k"],
+            "spec_rounds": spec["rounds"],
+            "acceptance_rate": spec["acceptance_rate"],
+            "tokens_per_target_step": spec["tokens_per_target_step"],
+            "draft_overhead": round(pred["draft_overhead"], 4),
+        }
     return {
         "metric": "gpt_serve_tokens_per_sec_per_chip",
         "value": round(total_tokens / dt, 1),
@@ -677,6 +708,7 @@ def bench_gpt_serve(steps, batch, seq):
         "shed": sum(1 for r in engine.requests.values()
                     if r.status == "shed"),
         "recovered": engine.recoveries,
+        **spec_row,
         "note": "continuous batching over the paged KV cache; mixed "
                 "prompt lengths, admissions between decode steps",
     }
@@ -704,7 +736,13 @@ def bench_gpt_serve_fleet(steps, batch, seq):
     (trace_fleet=0, flight_ring=0) at the max replica count and reports
     `trace_overhead` (untraced/traced tokens/s — ~1.0 proves the trace
     plane never syncs the device) plus the path of the most recent
-    flight-recorder bundle, if an anomaly dumped one."""
+    flight-recorder bundle, if an anomaly dumped one.
+    PT_BENCH_DISAGG=1 appends a prefill/decode disaggregation A/B at
+    the max replica count (floor 2): the SAME mixed prompt-length
+    stream (half the prompts longer than prefill_len — multi-chunk
+    admissions) runs through a mixed fleet and through one with the
+    first replica carved out as a prefill role, reporting goodput and
+    tokens/s for both plus the token-exact handoff count."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.core import flags as _F
@@ -1007,6 +1045,82 @@ def bench_gpt_serve_fleet(steps, batch, seq):
     finally:
         _F.set_flags(saved_flags)
 
+    disagg_row = None
+    if os.environ.get("PT_BENCH_DISAGG", "0") == "1":
+        # prefill/decode disaggregation A/B: identical mixed-length
+        # stream (same seed), mixed fleet vs first-replica-prefill
+        # fleet. Half the prompts exceed prefill_len so their admission
+        # is a multi-chunk prefill — the work disaggregation moves off
+        # the decode replicas.
+        nd = max(max(counts), 2)
+
+        def disagg_cfg():
+            return ServeConfig(num_slots=batch, page_size=page,
+                               max_len=2 * prefill_len + max_new,
+                               prefill_len=prefill_len,
+                               cache_dtype=cache_dtype,
+                               kv_dtype=_kv_dtype_env(),
+                               chunked_prefill=True,
+                               slo_ttft_s=slo_ttft,
+                               slo_token_latency_s=slo_tok,
+                               metrics_port=0)
+
+        def disagg_run(prefill_replicas):
+            router = FleetRouter(
+                model, variables,
+                FleetConfig(num_replicas=nd, heartbeat_s=60.0,
+                            metrics_port=0,
+                            prefill_replicas=prefill_replicas),
+                serve_config=disagg_cfg())
+            rng = np.random.RandomState(0)
+
+            def submit(k):
+                for j in range(k):
+                    if j % 2:      # prefill-heavy half
+                        plen = int(rng.randint(
+                            prefill_len + 1, 2 * prefill_len))
+                    else:
+                        plen = int(rng.randint(max(1, seq // 8),
+                                               prefill_len + 1))
+                    ids = rng.randint(0, cfg.vocab_size, (plen,),
+                                      dtype=np.int32)
+                    router.submit(ids, max_new=max_new)
+
+            submit(nd * batch)     # warmup (fresh jits per replica)
+            settle(router)
+            warm = len(router.requests)
+            n_req = max(4 * batch * nd, steps)
+            t0 = time.perf_counter()
+            submit(n_req)
+            settle(router)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            recs = [r for r in router.requests.values() if r.id >= warm]
+            done = [r for r in recs if r.status == "done"]
+            acct = [r for r in recs if r.status != "cancelled"]
+            tel = router.telemetry()
+            out = {
+                "prefill_replicas": prefill_replicas,
+                "completed": len(done),
+                "tokens_per_sec": round(
+                    sum(len(r.tokens) for r in done) / dt, 1),
+                "goodput": round(sum(1 for r in acct if r.slo_ok)
+                                 / max(len(acct), 1), 4),
+                "handoffs": tel["handoffs"],
+                "roles": tel["roles"],
+            }
+            router.close()
+            return out
+
+        mixed_ab = disagg_run(0)
+        split_ab = disagg_run(1)
+        disagg_row = {
+            "replicas": nd,
+            "mixed": mixed_ab,
+            "disaggregated": split_ab,
+            "goodput_delta": round(
+                split_ab["goodput"] - mixed_ab["goodput"], 4),
+        }
+
     top = by_replicas[str(max(counts))]
     return {
         "metric": "gpt_serve_fleet_tokens_per_sec",
@@ -1027,6 +1141,7 @@ def bench_gpt_serve_fleet(steps, batch, seq):
             untraced_tps / max(top["tokens_per_sec"], 1e-9), 3),
         "flight_bundle": _flight.last_bundle(),
         "by_replicas": by_replicas,
+        **({"disagg": disagg_row} if disagg_row else {}),
         "note": "FleetRouter over in-process engine replicas; "
                 "least-loaded dispatch, heartbeat liveness, token-exact "
                 "failover replay (PT_BENCH_FLEET_KILL=1 kills a busy "

@@ -593,6 +593,69 @@ def serve_prefill_contracts():
     return [TracedOnce(("serve.prefill",))]
 
 
+# speculative-verify probe dims (tools/compile_smoke._verify_engine):
+# slots=16 and spec_k=7 give a slots x window = 128-row verify batch, so
+# MIN_ROWS=96 sits ABOVE the model width (the tiny gpt's [vocab=512,
+# hidden=64] tied embedding carries 64 rows per vocab column — a
+# legitimate resident) and BELOW the 128-row dense lattice a verify step
+# that materialized [slots, window, vocab] logits would compile. The
+# detector works because the engine applies the vocab head + sampling
+# PER WINDOW POSITION: no legitimate [slots*window, vocab] tensor exists
+# in the module.
+SERVE_VERIFY_SLOTS = 16
+SERVE_VERIFY_SPEC_K = 7
+SERVE_VERIFY_MIN_ROWS = 96
+# probe pool: enough pages for the smoke's admission waves plus window
+# growth; the byte budget prices the donated pool pass-through from this
+# (pool_rows = pages * page_size), so the probe and the budget derive
+# from the one constant
+SERVE_VERIFY_PAGES = 31
+
+
+def serve_verify_contracts():
+    """The speculative verify-step contract: one trace each for the
+    decode / draft / verify entry points, donated pools really aliased,
+    no host callback, no f64, and NO dense [slots, window, vocab]
+    logits lattice — the head is applied per window position, so
+    sampling temporaries stay [slots, vocab]. (The [rows, Tmax] score
+    detector of the decode row deliberately does NOT apply: the verify
+    window legitimately re-attends the gathered prefix, amortized over
+    up to window emitted tokens.)"""
+    c = SHARDED_TRAIN_CASES["gpt"]
+    return [
+        NoTemporary({c.vocab}, SERVE_VERIFY_MIN_ROWS,
+                    what="[slots*window, vocab]-dense verify logits "
+                         "lattice"),
+        TracedOnce(("serve.decode", "serve.draft", "serve.verify")),
+        DonationRespected(min_aliases=1),
+        NoHostCallback(),
+        MaxDtypeWidth(32),
+    ]
+
+
+def serve_verify_budget_contracts(slots=SERVE_VERIFY_SLOTS,
+                                  context=SERVE_TMAX,
+                                  spec_k=SERVE_VERIFY_SPEC_K):
+    """Budget row for the speculative verify step, priced by
+    ``costmodel.predict_decode(spec_k=...)`` — zero hand-written
+    constants: raising spec_k or slots re-derives the budget from the
+    same cost model tools/autoplan.py reports break-even acceptance
+    with."""
+    cm, topo, rate = _pricing()
+    pred = cm.predict_decode(
+        _train_spec("gpt"), topo, slots=slots, context=context,
+        rate=rate, spec_k=spec_k,
+        pool_rows=SERVE_VERIFY_PAGES * SERVE_PAGE_SIZE)
+    src = (f"costmodel.predict_decode(gpt, slots={slots}, "
+           f"Tmax={context}, spec_k={spec_k})")
+    return [
+        MaxHloFlops(pred["verify_flops_per_chip"],
+                    SERVE_VERIFY_BUDGET_TOLERANCE["flops"], source=src),
+        MaxHloBytes(pred["verify_hlo_bytes"],
+                    SERVE_VERIFY_BUDGET_TOLERANCE["bytes"], source=src),
+    ]
+
+
 # --- cost-model-priced budgets ---------------------------------------
 #
 # Tolerances are calibrated against the measured tiny-config compiles
@@ -605,6 +668,13 @@ def serve_prefill_contracts():
 # Tmax attention) blows through it.
 TRAIN_BUDGET_TOLERANCE = {"flops": 1.25, "bytes": 6.0}
 SERVE_BUDGET_TOLERANCE = {"flops": 1.5, "bytes": 3.0}
+# verify: measured/predicted sits at ~1.4 (flops) and ~9.2 (bytes — the
+# per-position head + sampling unroll re-reads the tied embedding and
+# its [slots, vocab] rows window times; that re-read traffic is exactly
+# the price of never materializing the [slots, window, vocab] lattice,
+# and the analytic model prices each row once). Same ~1.4x headroom
+# convention as above.
+SERVE_VERIFY_BUDGET_TOLERANCE = {"flops": 2.0, "bytes": 13.0}
 SERVE_SLOTS = 2
 
 _AUTOPLAN_DIR = os.path.join(
@@ -709,6 +779,8 @@ CONTRACTS = {
     "serve.decode": serve_decode_contracts() + serve_budget_contracts(),
     "serve.decode@int8": serve_decode_int8_contracts(),
     "serve.prefill": serve_prefill_contracts(),
+    "serve.verify": (serve_verify_contracts()
+                     + serve_verify_budget_contracts()),
     "mlp.fused": fused_mlp_contracts(),
 }
 
@@ -721,4 +793,5 @@ CONTRACT_SNAPSHOTS = {
     "train.gpt@dp2,tp2": HloSnapshot("train.gpt@dp2,tp2"),
     "serve.decode": HloSnapshot("serve.decode"),
     "serve.decode@int8": HloSnapshot("serve.decode@int8"),
+    "serve.verify": HloSnapshot("serve.verify"),
 }

@@ -178,6 +178,24 @@ define_flag("serve_kv_dtype", "",
             "doubled servable context), dequantized inside the fused "
             "decode kernel and the XLA fallback alike. '' or 'f32' "
             "keeps the unquantized pool (ServeConfig.cache_dtype).")
+# speculative decoding (serving/engine.py): a draft model proposes
+# serve_spec_k tokens per active slot each round and ONE batched verify
+# step scores every position against the paged KV cache — more than one
+# emitted token per target-model step at high acceptance, token-exact
+# with the plain path by construction (the emitted tokens are always the
+# target's own per-position samples)
+define_flag("serve_draft", False,
+            "Enable speculative decoding in the serving engine: the "
+            "draft model (ServeConfig.draft_spec, or the target model "
+            "itself when none is configured — self-draft) proposes "
+            "serve_spec_k tokens per slot per round and one jitted "
+            "verify step scores all of them; accepted prefixes emit "
+            "multiple tokens per target step, rejection rolls back via "
+            "a host-side length edit.")
+define_flag("serve_spec_k", 3,
+            "Draft tokens proposed per active slot per speculative "
+            "round (the verify window is spec_k + 1 positions); only "
+            "read when serve_draft is on.")
 # fleet serving (serving/fleet.py): a router in front of N ServingEngine
 # replicas — least-loaded dispatch, heartbeat liveness, failover replay
 # of in-flight requests, bounded respawn, graceful drain
@@ -207,6 +225,14 @@ define_flag("fleet_autoscale_min", 1,
 define_flag("fleet_autoscale_max", 0,
             "Ceiling on live replicas the fleet autoscaler may spawn up "
             "to; 0 disables autoscaling entirely.")
+define_flag("fleet_prefill_replicas", 0,
+            "Prefill/decode disaggregation: carve the first N fleet "
+            "replicas out as dedicated prefill replicas (role "
+            "'prefill'); the rest serve decode. Prefill-heavy requests "
+            "(prompt longer than the engine's prefill_len) run their "
+            "chunked prefill plus first token on a prefill replica, "
+            "then hand off token-exactly to a decode replica via the "
+            "adopt() replay path. 0 = every replica mixed-mode.")
 define_flag("fleet_scale_cooldown_s", 5.0,
             "Minimum seconds between fleet autoscaling actions (spawn "
             "or drain-then-retire), so one load spike produces one "

@@ -333,6 +333,18 @@ class GPTDecoder(GPT):
         their content must not be rewritten (it is bit-identical anyway;
         dropping the write is what keeps the pages shareable). Returns
         (logits of each request's LAST chunk token [B, V], new_caches)."""
+        x, new_caches = self._paged_chunk_hidden(
+            prompt, starts, chunk_lengths, caches, page_rows, write_floor)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)
+        return nn.tied_vocab_head(self.tok_emb, last)[:, 0], new_caches
+
+    def _paged_chunk_hidden(self, prompt, starts, chunk_lengths, caches,
+                            page_rows, write_floor=None):
+        """Shared body of paged_prefill_chunk / paged_verify_chunk: run
+        the fixed [B, Lp] window through every block's gathered-prefix
+        chunk attention and return the FULL post-ln_f hidden states
+        [B, Lp, H] plus the updated pools."""
         b, lp = prompt.shape
         num_pages, _, page_size, _ = caches[0]["k"].shape
         p_max = page_rows.shape[1]
@@ -354,10 +366,30 @@ class GPTDecoder(GPT):
             x, pool = blk.paged_prefill_chunk(x, pool, page_ids, offsets,
                                               page_rows, pos, chunked)
             new_caches.append(pool)
-        x = self.ln_f(x)
-        last = jnp.take_along_axis(
-            x, jnp.maximum(chunk_lengths - 1, 0)[:, None, None], axis=1)
-        return nn.tied_vocab_head(self.tok_emb, last)[:, 0], new_caches
+        return self.ln_f(x), new_caches
+
+    def paged_verify_chunk(self, window, starts, win_lengths, caches,
+                           page_rows):
+        """Speculative-decoding verify: score EVERY position of a
+        [B, W] token window sitting at absolute positions starts[b] ..
+        starts[b] + win_lengths[b] - 1 against the paged cache, through
+        the same gathered-prefix chunk-attention path chunked prefill
+        uses (starts >= 1 for any live slot, so every window re-attends
+        the slot's whole cached prefix plus itself causally). K/V for
+        the window tokens is written into the slot's pages as a side
+        effect — rejection rollback is the caller's length edit; stale
+        rows past the accepted prefix are simply overwritten later.
+        Returns (hidden [B, W, H], new_caches); the caller applies
+        verify_head per position, keeping sampling temporaries at
+        [B, V] — never a dense [B, W, V] lattice."""
+        return self._paged_chunk_hidden(window, starts, win_lengths,
+                                        caches, page_rows)
+
+    def verify_head(self, hidden_row):
+        """Vocab logits for ONE window position's hidden states
+        [B, H] -> [B, V] (the weight-tied head, applied per position by
+        the speculative verify step)."""
+        return nn.tied_vocab_head(self.tok_emb, hidden_row[:, None])[:, 0]
 
     def generate(self, prompt, max_new, temperature=0.0, key=None,
                  cache_dtype=jnp.float32):

@@ -108,6 +108,12 @@ CATALOG = {
         "Requests dispatched to a replica and not yet terminal, by "
         "replica index — the single /metrics endpoint's per-replica "
         "aggregation label."),
+    "fleet.handoffs": MetricSpec(
+        "counter", (),
+        "Prefill->decode disaggregation handoffs: a prefill-role "
+        "replica finished a request's chunked prefill plus first "
+        "token and the router re-dispatched the remainder to a "
+        "decode replica via the token-exact adopt() replay path."),
     "fleet.failovers": MetricSpec(
         "counter", (),
         "Replica deaths handled by the fleet router (step crash past "
@@ -231,6 +237,22 @@ CATALOG = {
         "Queued requests shed by deadline expiry or watchdog-driven "
         "load shedding (cause: deadline | goodput_collapse | "
         "ingest_stall)."),
+    "serve.spec_accepted": MetricSpec(
+        "counter", (),
+        "Draft proposals the speculative verify step accepted (the "
+        "leading run where the draft token equals the target's own "
+        "per-position sample); acceptance_rate = spec_accepted / "
+        "spec_proposed."),
+    "serve.spec_proposed": MetricSpec(
+        "counter", (),
+        "Draft tokens proposed to the speculative verify step (up to "
+        "serve_spec_k per active slot per round, clamped by each "
+        "slot's page/window budget)."),
+    "serve.spec_rollbacks": MetricSpec(
+        "counter", (),
+        "Draft proposals rejected by the verify step and rolled back "
+        "(a host-side length edit — stale KV beyond the accepted "
+        "prefix is overwritten by later writes)."),
     "serve.slo_violations": MetricSpec(
         "counter", ("kind",),
         "Retired requests that missed an SLO (kind: ttft | "

@@ -103,6 +103,10 @@ class StepTelemetry:
         self._pending = None       # (step, wall_s, tokens, loss, gnorm)
         self._hist = _metrics.histogram(
             "trainer.step_s", "Per-step wall time seen by the Trainer.")
+        # the final snapshot's `step_time` must cover THIS run only; the
+        # registry histogram above accumulates process-wide (exporter
+        # continuity), so the per-run figures come from a private copy
+        self._run_hist = _metrics.Histogram("trainer.step_s")
         self._finished = False
         self._metrics_server = None
         if self.enabled and self.cfg.metrics_port:
@@ -134,6 +138,7 @@ class StepTelemetry:
             return
         if wall_s is not None:
             self._hist.observe(wall_s)
+            self._run_hist.observe(wall_s)
         if step % self.cfg.every_n_steps != 0:
             return
         self._flush_pending(at_step=step)
@@ -188,7 +193,7 @@ class StepTelemetry:
                "counters": snap.get("counters", {}),
                "gauges": snap.get("gauges", {}),
                "histograms": snap.get("histograms", {}),
-               "step_time": self._hist.stats(),
+               "step_time": self._run_hist.stats(),
                "spans": span_summary()}
         if extra:
             rec.update(extra)

@@ -227,28 +227,136 @@ def decode_hlo_bytes(spec, slots, context, kv_dtype=None):
     return params + kv
 
 
+def verify_flops(spec, slots, window, context):
+    """Matmul flops for ONE speculative verify step: each live slot
+    pushes a ``window``-token window (pending token + spec_k proposals)
+    through every layer, every window position attends the slot's whole
+    cached prefix, and the head scores each position — a decode step
+    amortized over up to ``window`` emitted tokens."""
+    H, I = spec.hidden, spec.intermediate
+    proj = 2 * slots * window * (4 * H * H + 2 * H * I)
+    attn = 4 * slots * window * context * H
+    logits = 2 * slots * window * H * spec.vocab
+    return spec.layers * (proj + attn) + logits
+
+
+def verify_hlo_bytes(spec, slots, window, context, kv_dtype=None,
+                     pool_rows=None):
+    """Traffic estimate for one verify step: one parameter sweep (the
+    window batch is still far too small to amortize below it), the
+    slot's cached prefix + window rows gathered for attention, and the
+    window's fresh K/V rows written back. ``pool_rows`` (total page-pool
+    token rows = num_pages * page_size) additionally prices the donated
+    pool pass-through: the verify module's page-gather/scatter touches
+    every pool row once in and once out, which dominates when the pool
+    dwarfs the live context."""
+    counts = param_counts(spec)
+    params = (counts["embedding"] + spec.layers * counts["per_layer"]
+              + counts["head"]) * spec.param_bytes
+    if str(kv_dtype or "") == "int8":
+        row_bytes = spec.hidden * 1 + 4          # int8 values + f32 scale
+    else:
+        row_bytes = spec.hidden * spec.param_bytes
+    kv = 2 * spec.layers * slots * (context + 2 * window) * row_bytes
+    if pool_rows:
+        kv += 2 * spec.layers * pool_rows * row_bytes * 2
+    return params + kv
+
+
 def predict_decode(spec, topology, slots, context, rate=None,
-                   kv_dtype=None):
+                   kv_dtype=None, draft_spec=None, spec_k=None,
+                   accept_rate=None, pool_rows=None):
     """Score one serving decode step the way :func:`predict` scores a
     train step: flops + traffic estimates and a step-seconds figure.
     ``rate=None`` prices compute at the autotune-measured achieved rate
     (falling back to analytic); passing an explicit rate keeps the call
     stdlib-pure — what the budget contracts do. ``kv_dtype`` prices the
-    KV pool per :func:`decode_hlo_bytes`."""
+    KV pool per :func:`decode_hlo_bytes`.
+
+    ``spec_k`` switches on speculative-decoding pricing: one round =
+    spec_k draft steps (``draft_spec``; None = self-draft at the target
+    spec) plus ONE target verify over a spec_k+1 window, emitting
+    1 + accept_rate * spec_k tokens per slot. The verify_* keys are
+    what the serve.verify budget contracts consume.
+
+    Two break-even figures come out, and they tell different stories:
+    ``break_even_accept_rate`` is the FLOPS break-even — verifying a
+    W-token window costs ~W tokens of compute, so on pure flops
+    speculation never pays (the figure sits at or above 1.0; that is a
+    statement about energy, not latency). ``break_even_accept_rate_s``
+    is the ROOFLINE (wall-clock) break-even: each step is priced at
+    max(flops/rate, bytes/hbm_bw), and because batch-1 decode is
+    memory-bound (one weight+KV stream per step), the verify window
+    amortizes the stream over W tokens — this is the figure
+    tools/autoplan.py reports per topology, and it needs the
+    topology's ``hbm_bw`` (absent -> the time keys are omitted)."""
     flops = float(decode_flops(spec, slots, context))
+    dec_bytes = float(decode_hlo_bytes(spec, slots, context,
+                                       kv_dtype=kv_dtype))
     if rate is None:
         rate, rate_source = achieved_rate(topology)
     else:
         rate_source = "fixed"
-    return {
+    hbm_bw = float(getattr(topology, "hbm_bw", 0.0) or 0.0)
+
+    def roofline(f, b):
+        return max(f / rate, b / hbm_bw) if hbm_bw > 0 else None
+
+    out = {
         "step_s": flops / rate,
         "flops_per_chip": flops,
-        "hlo_bytes": float(decode_hlo_bytes(spec, slots, context,
-                                            kv_dtype=kv_dtype)),
+        "hlo_bytes": dec_bytes,
         "kv_dtype": str(kv_dtype or "f32"),
         "rate_source": rate_source,
         "rate_flops_s": rate,
     }
+    step_rl = roofline(flops, dec_bytes)
+    if step_rl is not None:
+        out["step_roofline_s"] = step_rl
+    if spec_k:
+        window = spec_k + 1
+        dspec = draft_spec if draft_spec is not None else spec
+        vf = float(verify_flops(spec, slots, window, context))
+        vb = float(verify_hlo_bytes(spec, slots, window, context,
+                                    kv_dtype=kv_dtype,
+                                    pool_rows=pool_rows))
+        df = float(spec_k * decode_flops(dspec, slots, context))
+        db = float(spec_k * decode_hlo_bytes(dspec, slots, context,
+                                             kv_dtype=kv_dtype))
+        out.update({
+            "spec_k": int(spec_k),
+            "draft": "self" if draft_spec is None else
+                     (dspec.name or "draft"),
+            "verify_flops_per_chip": vf,
+            "verify_hlo_bytes": vb,
+            "draft_flops_per_chip": df,
+            "round_flops_per_chip": df + vf,
+            "round_s": (df + vf) / rate,
+            "draft_overhead": df / flops,
+            "break_even_accept_rate":
+                max(0.0, ((df + vf) / flops - 1.0) / spec_k),
+        })
+        round_rl = None
+        if step_rl is not None:
+            # one draft step prices at 1/spec_k of the k-step totals
+            round_rl = (roofline(vf, vb)
+                        + spec_k * roofline(df / spec_k, db / spec_k))
+            out.update({
+                "round_roofline_s": round_rl,
+                "break_even_accept_rate_s":
+                    max(0.0, (round_rl / step_rl - 1.0) / spec_k),
+            })
+        if accept_rate is not None:
+            tps = 1.0 + float(accept_rate) * spec_k
+            out.update({
+                "accept_rate": float(accept_rate),
+                "tokens_per_target_step": tps,
+                "flops_per_token": (df + vf) / (slots * tps),
+                "speedup_vs_plain": flops * tps / (df + vf),
+            })
+            if round_rl is not None:
+                out["speedup_vs_plain_s"] = step_rl * tps / round_rl
+    return out
 
 
 # ----------------------------------------------------------- collectives

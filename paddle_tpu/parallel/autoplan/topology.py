@@ -33,6 +33,9 @@ class Topology:
     inter_bw: float           # bytes/s per chip across slices/hosts (DCN)
     cores_per_chip: int = 1
     num_slices: int = 1
+    hbm_bw: float = 0.0       # bytes/s per chip HBM (0 = unknown; the
+    #                           roofline term serving decode is bound by
+    #                           — speculation break-even depends on it)
 
     @property
     def chips_per_slice(self):
@@ -51,15 +54,17 @@ class Topology:
 
 
 # per-chip characteristics by device kind: (hbm, peak bf16 flops, ici
-# bytes/s per chip, dcn bytes/s per chip). Peaks mirror
-# observability/perf.py peak_flops(); link numbers are spec-sheet order
-# of magnitude, enough to rank dp-over-DCN vs tp-over-ICI correctly.
+# bytes/s per chip, dcn bytes/s per chip, hbm bytes/s per chip). Peaks
+# mirror observability/perf.py peak_flops(); link numbers are spec-sheet
+# order of magnitude, enough to rank dp-over-DCN vs tp-over-ICI
+# correctly; HBM bandwidth is the roofline term batch-1 decode (and so
+# the speculation break-even) is bound by.
 _CHIPS = {
-    "cpu": (4 * GIB, 5.0e10, 2.0e10, 2.0e10),
-    "v4": (32 * GIB, 275e12, 2.4e11, 2.5e10),
-    "v5e": (16 * GIB, 197e12, 1.0e11, 2.5e10),
-    "v5p": (95 * GIB, 459e12, 4.8e11, 2.5e10),
-    "v6e": (32 * GIB, 918e12, 1.8e11, 2.5e10),
+    "cpu": (4 * GIB, 5.0e10, 2.0e10, 2.0e10, 3.0e10),
+    "v4": (32 * GIB, 275e12, 2.4e11, 2.5e10, 1.2e12),
+    "v5e": (16 * GIB, 197e12, 1.0e11, 2.5e10, 8.2e11),
+    "v5p": (95 * GIB, 459e12, 4.8e11, 2.5e10, 2.77e12),
+    "v6e": (32 * GIB, 918e12, 1.8e11, 2.5e10, 1.64e12),
 }
 
 # "kind-N" (one slice of N chips) or "MxKIND-N" (M slices). cpuN means N
@@ -87,10 +92,10 @@ def get_topology(name=None, devices=None):
             "or 'auto' to detect from jax.devices())")
     slices = int(m.group(1)) if m.group(1) else 1
     kind, per_slice = m.group(2), int(m.group(3))
-    hbm, peak, ici, dcn = _CHIPS[kind]
+    hbm, peak, ici, dcn, mem_bw = _CHIPS[kind]
     return Topology(name=name, num_chips=slices * per_slice,
                     hbm_bytes=hbm, peak_flops=peak, intra_bw=ici,
-                    inter_bw=dcn, num_slices=slices)
+                    inter_bw=dcn, num_slices=slices, hbm_bw=mem_bw)
 
 
 def detect(devices=None):
@@ -103,7 +108,7 @@ def detect(devices=None):
         if k in kind:
             key = k
             break
-    hbm, peak, ici, dcn = _CHIPS[key]
+    hbm, peak, ici, dcn, mem_bw = _CHIPS[key]
     stats = getattr(devices[0], "memory_stats", None)
     if callable(stats):
         try:
@@ -116,4 +121,4 @@ def detect(devices=None):
     return Topology(name=f"detected:{key}{len(devices)}",
                     num_chips=len(devices), hbm_bytes=hbm, peak_flops=peak,
                     intra_bw=ici, inter_bw=dcn,
-                    num_slices=max(1, len(slices)))
+                    num_slices=max(1, len(slices)), hbm_bw=mem_bw)

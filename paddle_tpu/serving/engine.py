@@ -129,6 +129,21 @@ class ServeConfig:
     #                              fleet router stamps on a replica's
     #                              engine; surfaces in slo_stats() and
     #                              trace records (per-version SLO plane)
+    # speculative decoding: a draft model proposes spec_k tokens per
+    # active slot per round; ONE jitted verify step scores every window
+    # position against the paged cache and the engine emits the
+    # accepted prefix + one target token — token-identical to the plain
+    # path by construction (emitted tokens are always the target's own
+    # per-position samples under the fold(seed, count) keys)
+    draft: bool = None           # None -> serve_draft flag
+    spec_k: int = None           # None -> serve_spec_k flag
+    draft_spec: typing.Any = None   # GPTConfig of the draft model;
+    #                                 None + draft=True = self-draft
+    #                                 (draft == target: the plumbing
+    #                                 probe with ~100% acceptance)
+    draft_variables: typing.Any = None  # draft weights ({"params": ...})
+    draft_checkpoint: str = None  # else: restore newest step from this
+    #                               CheckpointManager path
 
     def resolve(self):
         if self.num_slots is None:
@@ -167,6 +182,16 @@ class ServeConfig:
             self.kv_dtype = None       # explicit f32 = the plain pool
         elif isinstance(self.kv_dtype, str):
             self.kv_dtype = jnp.dtype(self.kv_dtype).type
+        if self.draft is None:
+            self.draft = bool(get_flag("serve_draft"))
+        if self.draft_spec is not None or self.draft_checkpoint:
+            self.draft = True    # an explicit draft model implies draft
+        if self.spec_k is None:
+            self.spec_k = int(get_flag("serve_spec_k"))
+        if self.draft:
+            enforce(self.spec_k >= 1,
+                    f"serve_spec_k={self.spec_k}: speculative decoding "
+                    "needs at least one draft proposal per round")
         pages_per_slot = -(-self.max_len // self.page_size)
         if self.num_pages is None:
             self.num_pages = self.num_slots * pages_per_slot
@@ -218,6 +243,10 @@ class Request:
     deadline_t: float = None      # absolute clock() deadline, or None
     retriable: bool = False       # rejected-but-worth-resubmitting hint
     recoveries: int = 0           # times re-admitted after a step crash
+    spec_tokens: int = 0          # tokens this request gained beyond
+    #                               one-per-target-step (accepted draft
+    #                               proposals) — the per-request
+    #                               speculative-vs-plain accounting
 
     @property
     def output(self):
@@ -240,6 +269,19 @@ class ServingEngine:
         self._caches = model.init_paged_caches(
             cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
             kv_dtype=cfg.kv_dtype)
+        # speculative decoding: the draft model keeps its OWN page
+        # pools, built with the SAME page count/size so one page table
+        # indexes both (draft pages and target pages for a slot live at
+        # identical pool indices)
+        self._spec_on = bool(cfg.draft)
+        self._draft_model = None
+        self._draft_params = None
+        self._draft_caches = None
+        if self._spec_on:
+            self._draft_model, self._draft_params = self._resolve_draft()
+            self._draft_caches = self._draft_model.init_paged_caches(
+                cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
+                kv_dtype=cfg.kv_dtype)
 
         s = cfg.num_slots
         self._page_table = np.zeros((s, self._pages_per_slot), np.int32)
@@ -275,6 +317,19 @@ class ServingEngine:
         self._base_key = jax.random.key(cfg.seed)
         self.decode_traces = 0
         self.prefill_traces = 0
+        self.draft_traces = 0
+        self.draft_prefill_traces = 0
+        self.verify_traces = 0
+        self.spec_proposed = 0        # draft tokens offered to verify
+        self.spec_accepted = 0        # proposals the target confirmed
+        self.spec_rollbacks = 0       # proposals rejected (length edit)
+        self.spec_rounds = 0          # speculative rounds run
+        self.spec_slot_rounds = 0     # per-slot round participations
+        #                               (denominator of the per-slot
+        #                               tokens-per-target-step win)
+        self.target_steps = 0         # target-model steps (decode OR
+        #                               verify) — tokens/target_steps is
+        #                               the speculation win
         self.recoveries = 0           # step crashes recovered (engine-wide)
         self._trace_credit = 0        # legitimate re-traces (jit rebuild
         #                               after a latched Pallas fallback)
@@ -318,7 +373,9 @@ class ServingEngine:
             "serve.slo_violations", "serve.recoveries", "serve.shed",
             "serve.prefix_hits", "serve.prefix_misses",
             "serve.cow_copies", "serve.pages_shared",
-            "serve.kv_quant_pages", "jit.retraces"])
+            "serve.kv_quant_pages", "serve.spec_proposed",
+            "serve.spec_accepted", "serve.spec_rollbacks",
+            "jit.retraces"])
         self._retired = 0
         self._retired_ok = 0
         self._viol_base = dict(
@@ -375,6 +432,32 @@ class ServingEngine:
 
         self._sample = _sample
         self._build_jits()
+
+    def _resolve_draft(self):
+        """(draft model, draft params). No draft_spec = self-draft (the
+        target model drafts for itself — ~100% acceptance, the plumbing
+        and determinism probe). With a draft_spec, weights come from
+        cfg.draft_variables, else the newest step under
+        cfg.draft_checkpoint (the checkpoint manager's verified-restore
+        path), else a deterministic seeded init."""
+        cfg = self.cfg
+        if cfg.draft_spec is None:
+            return self._model, self._params
+        from paddle_tpu.models.gpt import GPTDecoder
+        draft = GPTDecoder(cfg.draft_spec)
+        variables = cfg.draft_variables
+        if variables is None and cfg.draft_checkpoint:
+            from paddle_tpu.io.checkpoint import CheckpointManager
+            template = draft.init(jax.random.key(cfg.seed))
+            state, step = CheckpointManager(
+                cfg.draft_checkpoint).restore(template)
+            enforce(state is not None,
+                    f"draft_checkpoint={cfg.draft_checkpoint!r} holds "
+                    "no restorable step")
+            variables = state
+        if variables is None:
+            variables = draft.init(jax.random.key(cfg.seed))
+        return draft, variables["params"]
 
     def _build_jits(self):
         """(Re)create the two jitted closures. Called once at
@@ -434,6 +517,71 @@ class ServingEngine:
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
         self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
         self._copy_jit = jax.jit(copy_pages, donate_argnums=(0,))
+
+        if not self._spec_on:
+            return
+        draft_model = self._draft_model
+        spec_w = self.cfg.spec_k + 1
+
+        def draft_decode(params, caches, tokens, page_table, lengths,
+                         active, temps, top_ks, top_ps, seeds, counts):
+            # one draft proposal step: decode-shaped, called spec_k
+            # times per round with lengths+i / counts+i — same shapes
+            # every call, ONE trace
+            _count_trace("draft_traces", "serve.draft")
+
+            def run(tok):
+                logits, new_caches = draft_model.paged_decode_step(
+                    tok, caches, page_table, lengths, active)
+                return _sample(logits, temps, top_ks, top_ps, seeds,
+                               counts), new_caches
+
+            return draft_model.apply({"params": params, "state": {}},
+                                     tokens, method=run)
+
+        def draft_prefill(params, caches, prompt, starts, lengths,
+                          page_rows, floors):
+            # admission-time draft cache fill (no sampling — only the
+            # written K/V matters; the target's prefill emits the token)
+            _count_trace("draft_prefill_traces", "serve.draft_prefill")
+
+            def run(pr):
+                _, new_caches = draft_model.paged_prefill_chunk(
+                    pr, starts, lengths, caches, page_rows,
+                    write_floor=floors)
+                return new_caches
+
+            return draft_model.apply({"params": params, "state": {}},
+                                     prompt, method=run)
+
+        def verify(params, caches, window, starts, win_lens, page_rows,
+                   temps, top_ks, top_ps, seeds, counts):
+            # ONE batched verify step: score every window position
+            # against the paged cache (gathered-prefix chunk attention),
+            # then sample position i with the SAME fold(seed, count+i)
+            # key the plain path would use — emitted tokens are the
+            # target's own draws, so speculation is token-exact by
+            # construction. The head + sampling run per position:
+            # temporaries stay [slots, V], never a dense
+            # [slots, window, V] lattice.
+            _count_trace("verify_traces", "serve.verify")
+
+            def run(wt):
+                hidden, new_caches = model.paged_verify_chunk(
+                    wt, starts, win_lens, caches, page_rows)
+                cols = [_sample(model.verify_head(hidden[:, i]), temps,
+                                top_ks, top_ps, seeds, counts + i)
+                        for i in range(spec_w)]
+                return jnp.stack(cols, 1), new_caches
+
+            return model.apply({"params": params, "state": {}}, window,
+                               method=run)
+
+        self._draft_jit = jax.jit(draft_decode, donate_argnums=(1,))
+        self._draft_prefill_jit = jax.jit(draft_prefill,
+                                          donate_argnums=(1,))
+        self._draft_copy_jit = jax.jit(copy_pages, donate_argnums=(0,))
+        self._verify_jit = jax.jit(verify, donate_argnums=(1,))
 
     # --- public API ---
 
@@ -642,19 +790,82 @@ class ServingEngine:
                 stalled = self._grow_pages()
             new_tokens = 0
             toks = None
+            spec = None
+            spec_proposed = spec_accepted = None
             if self._active.any():
+                use_spec = self._spec_on
+                if use_spec:
+                    try:
+                        fault_point("spec.verify")
+                    except Exception:
+                        # chaos degrade: this round runs as ONE plain
+                        # decode step — token-exact either way (the
+                        # emitted token follows the same sample law)
+                        use_spec = False
                 try:
                     fault_point("serve.step")
-                    toks_dev, self._caches = self._decode_jit(
-                        self._params, self._caches, self._last_tokens,
-                        self._page_table, self._lengths, self._active,
-                        self._temps, self._top_ks, self._top_ps,
-                        self._seeds, self._gen_counts)
-                    toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
+                    if use_spec:
+                        spec = self._spec_round()
+                    else:
+                        toks_dev, self._caches = self._decode_jit(
+                            self._params, self._caches, self._last_tokens,
+                            self._page_table, self._lengths, self._active,
+                            self._temps, self._top_ks, self._top_ps,
+                            self._seeds, self._gen_counts)
+                        toks = np.asarray(toks_dev)  # graft-lint: disable=hot-path-sync (the one deliberate sync per decode round: the python scheduler needs this step's tokens to advance/free slots)
                 except Exception as e:
                     self._recover("serve.step", e)
-            if toks is not None:
+            if spec is not None:
+                # speculative round: per slot, accept the leading run of
+                # draft proposals that match the target's own samples
+                # and emit accepted + 1 tokens; rejection rollback is
+                # the length simply advancing fewer positions than the
+                # verify window wrote (stale KV/scale rows beyond the
+                # accepted prefix are overwritten by later writes)
+                self._retry_budget.success()
+                self.spec_rounds += 1
+                self.target_steps += 1
+                dt = self._clock() - t0
+                lat = _metrics.histogram("serve.token_latency_s")
+                sampled, props, win = spec
+                spec_proposed = spec_accepted = 0
+                for slot, req in list(self._running.items()):
+                    if not self._active[slot]:
+                        continue               # page-stalled this round
+                    w = int(win[slot])
+                    self.spec_slot_rounds += 1
+                    a = 0
+                    while (a < w - 1
+                           and int(props[slot, a]) == int(sampled[slot, a])):
+                        a += 1
+                    m = a + 1                  # tokens safe to emit
+                    spec_proposed += w - 1
+                    spec_accepted += a
+                    emitted = 0
+                    for j in range(m):
+                        tok = int(sampled[slot, j])
+                        self._lengths[slot] += 1   # its KV is cached
+                        req.tokens.append(tok)
+                        self._gen_counts[slot] += 1
+                        self._last_tokens[slot] = tok
+                        lat.observe(dt / m)
+                        new_tokens += 1
+                        emitted += 1
+                        reason = self._done_reason(req, tok)
+                        if reason:
+                            self._release(req, finished, reason)
+                            break
+                    req.spec_tokens += max(0, emitted - 1)
+                self.spec_proposed += spec_proposed
+                self.spec_accepted += spec_accepted
+                self.spec_rollbacks += spec_proposed - spec_accepted
+                _metrics.counter("serve.spec_proposed").inc(spec_proposed)
+                _metrics.counter("serve.spec_accepted").inc(spec_accepted)
+                _metrics.counter("serve.spec_rollbacks").inc(
+                    spec_proposed - spec_accepted)
+            elif toks is not None:
                 self._retry_budget.success()   # consecutive-failure reset
+                self.target_steps += 1
                 dt = self._clock() - t0
                 lat = _metrics.histogram("serve.token_latency_s")
                 for slot, req in list(self._running.items()):
@@ -678,12 +889,19 @@ class ServingEngine:
                     self.cfg.num_pages - len(self._free_pages))
             wall_s = self._clock() - t0
             if self._run_log is not None:
-                self._run_log.write({
+                rec = {
                     "phase": "serve", "step": self._step_no,
                     "wall_s": wall_s, "new_tokens": new_tokens,
                     "active": len(self._running),
                     "queue_depth": len(self._queue),
-                    "goodput": round(self.goodput(), 4)})
+                    "goodput": round(self.goodput(), 4)}
+                if spec_proposed is not None:
+                    # speculative round: per-round acceptance so
+                    # tools/run_report.py --serve can plot the
+                    # acceptance-rate trajectory
+                    rec["spec_proposed"] = spec_proposed
+                    rec["spec_accepted"] = spec_accepted
+                self._run_log.write(rec)
             if self._watchdog is not None:
                 self._watchdog.tick(self._step_no, wall_s=wall_s,
                                     goodput=self.goodput(),
@@ -744,6 +962,46 @@ class ServingEngine:
                 np.zeros(s, np.int32)).compile()
         finally:
             self._aot_trace = False
+
+    def compiled_verify(self):
+        """AOT-compile the speculative verify step (one extra trace,
+        absorbed like compiled_decode's) and return the compiled
+        executable — compile-smoke greps its HLO for the no-dense-
+        lattice and budget contracts."""
+        enforce(self._spec_on, "compiled_verify() needs draft=True")
+        cfg = self.cfg
+        s, w = cfg.num_slots, cfg.spec_k + 1
+        self._aot_trace = True    # a deliberate extra trace, not a retrace
+        try:
+            return self._verify_jit.lower(
+                self._params, self._caches,
+                np.zeros((s, w), np.int32), np.zeros(s, np.int32),
+                np.zeros(s, np.int32), self._page_table,
+                np.zeros(s, np.float32), np.zeros(s, np.int32),
+                np.zeros(s, np.float32), np.zeros(s, np.uint32),
+                np.zeros(s, np.int32)).compile()
+        finally:
+            self._aot_trace = False
+
+    def spec_stats(self):
+        """Speculation accounting for bench rows / reports. Per-slot
+        semantics: in every round each active slot costs ONE target-model
+        evaluation (decode or verify); a slot's speculative round emits
+        1 + accepted tokens. tokens_per_target_step > 1.0 is the whole
+        point of the feature."""
+        prop, acc = self.spec_proposed, self.spec_accepted
+        sr = self.spec_slot_rounds
+        return {
+            "enabled": self._spec_on,
+            "spec_k": self.cfg.spec_k if self._spec_on else 0,
+            "rounds": self.spec_rounds,
+            "target_steps": self.target_steps,
+            "proposed": prop,
+            "accepted": acc,
+            "rollbacks": self.spec_rollbacks,
+            "acceptance_rate": round(acc / prop, 4) if prop else None,
+            "tokens_per_target_step":
+                round((sr + acc) / sr, 4) if sr else None}
 
     def export_decode(self, path):
         """Export ONE greedy serve step as a StableHLO / jax.export
@@ -812,13 +1070,17 @@ class ServingEngine:
                                    delta.get("token_latency", 0)}}
 
     def reset_stats(self):
-        """Zero the serve latency histograms and this engine's SLO
-        tallies (bench warmup isolation: compile-time TTFTs must not
-        poison the timed window's goodput)."""
+        """Zero the serve latency histograms, this engine's SLO tallies
+        and its speculation counters (bench warmup isolation:
+        compile-time TTFTs and warmup acceptance must not poison the
+        timed window's row)."""
         for name in ("serve.ttft_s", "serve.token_latency_s"):
             h = _metrics.registry().get(name)
             if h is not None:
                 h.reset()
+        self.spec_proposed = self.spec_accepted = 0
+        self.spec_rollbacks = self.spec_rounds = 0
+        self.spec_slot_rounds = self.target_steps = 0
         self._retired = self._retired_ok = 0
         self._viol_base = dict(
             _metrics.counter("serve.slo_violations").snapshot())
@@ -970,6 +1232,13 @@ class ServingEngine:
         self._caches = self._copy_jit(
             self._caches, np.asarray([src], np.int32),
             np.asarray([dst], np.int32))
+        if self._spec_on:
+            # the draft pools index by the same page ids — divergence
+            # must carry the draft K/V too or the draft's view of the
+            # shared prefix goes stale
+            self._draft_caches = self._draft_copy_jit(
+                self._draft_caches, np.asarray([src], np.int32),
+                np.asarray([dst], np.int32))
         self._free_pages.extend(self._prefix_cache.release([src]))
         req.pages.append(dst)
         self._page_table[req.slot, len(req.shared_pages)] = dst
@@ -1117,6 +1386,15 @@ class ServingEngine:
                     self._params, self._caches, req.device_prompt[ci],
                     starts, lens, self._page_table[slot][None, :],
                     floors, *self._sampling_rows(req))
+                if self._spec_on:
+                    # mirror the chunk into the draft pools (same pages,
+                    # same write floor — shared prefix pages keep their
+                    # published draft K/V) so the first speculative
+                    # round sees a fully warm draft cache
+                    self._draft_caches = self._draft_prefill_jit(
+                        self._draft_params, self._draft_caches,
+                        req.device_prompt[ci], starts, lens,
+                        self._page_table[slot][None, :], floors)
                 tok = int(np.asarray(tok_dev)[0])  # graft-lint: disable=hot-path-sync (admission-time sync, once per prefill chunk: the slot table needs the first token before decode rounds start)
             except Exception as e:
                 self._recover("serve.prefill", e, pending=req)
@@ -1184,6 +1462,69 @@ class ServingEngine:
                 self._active[slot] = False
                 stalled.append(slot)
         return stalled
+
+    def _spec_round(self):
+        """One speculative round: the draft model proposes up to spec_k
+        tokens per active slot (spec_k decode-shaped calls of the ONE
+        draft trace, lengths+i / counts+i), then the target scores the
+        whole [slots, spec_k+1] window — pending token + proposals — in
+        ONE batched verify step against the paged cache and re-draws
+        every position with the exact fold(seed, count+i) key the plain
+        path would use. Returns (sampled [S, W], proposals [S, K],
+        win [S]) as host arrays; step() accepts the leading run of
+        matching proposals and emits accepted + 1 target draws.
+
+        Window sizing: win[slot] = min(spec_k+1, remaining token
+        budget), then shrunk to what the slot's pages can hold when the
+        pool is drained (never below 1 — _grow_pages already made the
+        pending position writable, so a famine degrades the slot to
+        plain-decode behavior instead of stalling it)."""
+        cfg = self.cfg
+        ps = cfg.page_size
+        k = cfg.spec_k
+        win = np.zeros(cfg.num_slots, np.int32)
+        for slot, req in self._running.items():
+            if not self._active[slot]:
+                continue               # page-stalled this round
+            w = min(k + 1, req.max_new - len(req.tokens))
+            ln = int(self._lengths[slot])
+            while w > 1:
+                owned = len(req.shared_pages) + len(req.pages)
+                if (ln + w - 1) // ps < owned:
+                    break              # window fully covered
+                page = self._alloc_page()
+                if page is None:
+                    # pool famine: shrink the window to the pages the
+                    # slot already owns (>= 1 position past _grow_pages)
+                    w = owned * ps - ln
+                    break
+                req.pages.append(page)
+                self._page_table[slot, owned] = page
+            win[slot] = w
+        # draft phase: proposal i+1 is drawn with count+i — the same
+        # key verify re-draws position i+1 with, so a well-matched
+        # draft's proposals survive acceptance token-for-token. Tokens
+        # feed back as device arrays; nothing syncs until the window is
+        # scored.
+        props_dev = []
+        tok = self._last_tokens
+        for i in range(k):
+            step_act = self._active & (win > i + 1)
+            tok, self._draft_caches = self._draft_jit(
+                self._draft_params, self._draft_caches, tok,
+                self._page_table, self._lengths + i, step_act,
+                self._temps, self._top_ks, self._top_ps,
+                self._seeds, self._gen_counts + i)
+            props_dev.append(tok)
+        window = jnp.stack([jnp.asarray(self._last_tokens)] + props_dev,
+                           axis=1)
+        sampled_dev, self._caches = self._verify_jit(
+            self._params, self._caches, window, self._lengths, win,
+            self._page_table, self._temps, self._top_ks, self._top_ps,
+            self._seeds, self._gen_counts)
+        props = np.stack([np.asarray(p) for p in props_dev], axis=1)
+        sampled = np.asarray(sampled_dev)  # graft-lint: disable=hot-path-sync (the speculative round's one deliberate sync point, fetching proposals + verify draws together: acceptance is a host-side compare, and the scheduler needs this round's tokens to advance/free slots)
+        return sampled, props, win
 
     def _free_slot_state(self, req):
         """Return a request's slot and pages to the free lists (shared
@@ -1253,12 +1594,19 @@ class ServingEngine:
             log_fallback("decode_attention",
                          f"runtime decode failure ({type(exc).__name__})"
                          " — latched permanent per-process XLA fallback")
-            self._trace_credit += 2
+            # decode + prefill, plus draft/draft-prefill/verify when
+            # speculation is on — all read the flag at trace time
+            self._trace_credit += 2 + (3 if self._spec_on else 0)
             self._build_jits()
         # quarantine: drop the (donated, possibly poisoned) pools
         self._caches = self._model.init_paged_caches(
             cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
             kv_dtype=cfg.kv_dtype)
+        if self._spec_on:
+            # the draft pools were donated to the same failed round
+            self._draft_caches = self._draft_model.init_paged_caches(
+                cfg.num_pages, cfg.page_size, dtype=cfg.cache_dtype,
+                kv_dtype=cfg.kv_dtype)
         self._page_table[:] = 0
         self._lengths[:] = 0
         self._active[:] = False
@@ -1449,6 +1797,7 @@ class ServingEngine:
         self._account_slo(req)
         self._trace_event(req, "retired", reason=reason,
                           tokens=len(req.tokens), slo_ok=req.slo_ok,
-                          preemptions=req.preemptions)
+                          preemptions=req.preemptions,
+                          spec_tokens=req.spec_tokens)
         finished.append(req)
         _metrics.counter("serve.requests").inc(status="completed")

@@ -66,6 +66,23 @@ What the router does:
              cooldown; scale-downs always go through graceful drain —
              in-flight work finishes or is re-routed, never dropped
              (`fleet.scale_events{direction}`).
+  disagg     `fleet_prefill_replicas=N` carves the first N replicas
+             out as dedicated PREFILL replicas; the rest serve decode.
+             A prefill-heavy request (prompt longer than the engine's
+             prefill_len — its admission is multiple chunked-prefill
+             calls) runs its prefill plus exactly the first token on a
+             prefill replica (max_new capped to 1), then hands the
+             remainder off to a decode replica through the SAME
+             token-exact adopt/replay path failover uses (prompt +
+             tokens=[t0], real max_new, pinned seed/version) —
+             `fleet.handoffs` counts the hop and the request's durable
+             trace grows a `handoff`-origin span. A `fleet.handoff`
+             fault degrades that one request to mixed routing (it
+             finishes wherever capacity exists); a role with no alive
+             replica degrades new admissions to mixed routing entirely
+             — disaggregation trades goodput, never availability. The
+             autoscaler never retires the last alive replica of a
+             role.
 
 Replicas are in-process by default (N engines, one process — the test
 and bench shape). `SubprocessReplica` + `replica_worker_loop` run an
@@ -138,6 +155,10 @@ class FleetConfig:
     #                               0 = autoscaling off
     scale_cooldown_s: float = None   # None -> fleet_scale_cooldown_s
     deploy_verify: bool = None    # None -> fleet_deploy_verify flag
+    prefill_replicas: int = None  # None -> fleet_prefill_replicas flag;
+    #                               first N replicas = dedicated prefill
+    #                               role, rest = decode; 0 = every
+    #                               replica mixed-mode (no disagg)
 
     def resolve(self):
         if self.num_replicas is None:
@@ -161,7 +182,12 @@ class FleetConfig:
                 get_flag("fleet_scale_cooldown_s"))
         if self.deploy_verify is None:
             self.deploy_verify = bool(get_flag("fleet_deploy_verify"))
+        if self.prefill_replicas is None:
+            self.prefill_replicas = int(
+                get_flag("fleet_prefill_replicas"))
         enforce(self.num_replicas >= 1, "fleet needs at least 1 replica")
+        enforce(self.prefill_replicas >= 0,
+                "fleet_prefill_replicas must be >= 0")
         enforce(self.heartbeat_s > 0, "fleet_heartbeat_s must be > 0")
         enforce(0.0 <= self.canary_weight <= 1.0,
                 "fleet_canary_weight must be in [0, 1]")
@@ -196,6 +222,12 @@ class FleetRequest:
     #                               a failover re-route never switches
     #                               versions once tokens were generated
     reroutes: int = 0             # failover re-dispatches survived
+    phase: str = None             # disaggregation phase: None = mixed
+    #                               routing, "prefill" = running its
+    #                               prefill+first-token leg on a prefill
+    #                               replica, "decode" = handed off (the
+    #                               role filter keeps failover re-routes
+    #                               on decode replicas too)
     retire_reason: str = None
     slo_ok: bool = None
     retriable: bool = False
@@ -587,7 +619,7 @@ class FleetRouter:
             "fleet.dispatch_depth", "fleet.respawns",
             "fleet.affinity_hits", "fleet.version_retirements",
             "fleet.deploys", "fleet.scale_events",
-            "fleet.canary_aborts"])
+            "fleet.canary_aborts", "fleet.handoffs"])
         # One reentrant lock guards the router mirror: submit()/cancel()
         # arrive on client threads while step()/drain() run the round
         # thread, and the engine watchdog's anomaly callback re-enters
@@ -657,6 +689,28 @@ class FleetRouter:
                          if isinstance(h, InProcessReplica)), default=4)
             cfg.replica_queue_limit = max(2, 2 * slots)
         self._states = ["live"] * n   # graft-guard: self._lock
+        # prefill/decode disaggregation: the first prefill_replicas
+        # indices are the prefill role, the rest decode. An empty list
+        # means every replica is mixed-mode (disagg off) — the roles
+        # list stays parallel to self._replicas when non-empty.
+        # graft-guard: self._lock
+        if cfg.prefill_replicas > 0:
+            enforce(cfg.prefill_replicas < n,
+                    "fleet_prefill_replicas must leave at least one "
+                    "decode replica")
+            self._roles = ["prefill" if i < cfg.prefill_replicas
+                           else "decode" for i in range(n)]
+        else:
+            self._roles = []
+        # prefill-heavy threshold: a prompt longer than this needs
+        # multiple chunked-prefill calls, so its admission cost is what
+        # disaggregation moves off the decode replicas
+        self._prefill_cut = int(next(
+            (h.engine.cfg.prefill_len for h in list(self._replicas)
+             if isinstance(h, InProcessReplica)),
+            serve_config.prefill_len if serve_config is not None
+            else ServeConfig().prefill_len))
+        self.handoffs = 0
         self._monitor = HeartBeatMonitor(
             n, timeout_s=cfg.heartbeat_s, interval_s=cfg.heartbeat_s,
             clock=clock)
@@ -934,6 +988,8 @@ class FleetRouter:
                                      for b in list(self._budgets)],
                 "goodput": round(self.goodput(), 4),
                 "versions": list(self._versions),
+                "roles": list(self._roles),
+                "handoffs": self.handoffs,
                 "baseline_version": self._baseline_version,
                 "canary_version": self._canary_version,
                 "version_stats": {
@@ -1049,7 +1105,12 @@ class FleetRouter:
                     (live if s == "live" else draining).append(
                         (self._replicas[i].load(), i,
                          self._replicas[i]))
-            candidates = live or draining
+            # role-matching capacity wins within each liveness tier;
+            # a role with none degrades to mixed rather than wedge the
+            # hard-pinned record
+            candidates = (self._role_filter(live, rec)
+                          or self._role_filter(draining, rec)
+                          or live or draining)
             return min(candidates)[1:] if candidates else None
         candidates = []
         for i in self._eligible_replicas():
@@ -1057,6 +1118,7 @@ class FleetRouter:
             if handle.queued() >= self.cfg.replica_queue_limit:
                 continue
             candidates.append((handle.load(), i, handle))
+        candidates = self._role_filter(candidates, rec) or candidates
         if not candidates:
             return None
         if rec is not None:
@@ -1077,6 +1139,43 @@ class FleetRouter:
                     return i, handle
         return least[1:]
 
+    def _role_filter(self, candidates, rec):
+        """Keep the `(load, i, handle)` candidates whose replica role
+        matches the request's disaggregation phase. An empty result
+        means the wanted role has no capacity — callers fall back to
+        the unfiltered list (mixed routing): disaggregation degrades,
+        it never starves a routable request."""
+        if not self._roles or rec is None:
+            return candidates
+        want = rec.phase
+        if want not in ("prefill", "decode"):
+            return candidates
+        return [c for c in candidates
+                if c[1] < len(self._roles)
+                and self._roles[c[1]] == want]
+
+    def _role_alive(self, role):
+        """Does any non-retired, alive replica carry `role`?"""
+        return any(r == role
+                   and self._states[i] not in ("dead", "retired")
+                   and self._replicas[i].alive()
+                   for i, r in enumerate(list(self._roles)))
+
+    def _classify_phase(self, rec):
+        """Route-time disaggregation classification, once per fresh
+        request: prefill-heavy work (prompt past prefill_len — a
+        multi-chunk admission) starts on a prefill replica when BOTH
+        roles have alive capacity. A dead role leaves new requests in
+        mixed routing — availability beats the split. Requests that
+        already hold tokens (failover re-routes, handed-off work) are
+        never reclassified."""
+        if (not self._roles or rec.phase is not None or rec.tokens
+                or rec.max_new <= 1
+                or rec.prompt.size <= self._prefill_cut):
+            return
+        if self._role_alive("prefill") and self._role_alive("decode"):
+            rec.phase = "prefill"
+
     def _dispatch(self, finished):
         now = self._clock()
         for rec in [r for r in self._pending
@@ -1086,6 +1185,7 @@ class FleetRouter:
             self._retire(rec, "shed", "deadline_expired", finished)
         while self._pending:
             rec = min(self._pending, key=self._admission_key)
+            self._classify_phase(rec)
             target = self._pick_replica(rec)
             if target is None:
                 if rec.version is not None and rec.tokens:
@@ -1132,14 +1232,26 @@ class FleetRouter:
                 else f"hop{rec.next_span - 1}")
             rec.next_span += 1
             trace = ctx.to_wire()
+        max_new = rec.max_new
+        if rec.reroutes:
+            origin = "failover"
+        elif rec.phase == "decode":
+            origin = "handoff"    # the disaggregation hop's trace tag
+        if rec.phase == "prefill":
+            # the prefill leg: chunked prefill + exactly the first
+            # token; the remainder re-stages on a decode replica at
+            # handoff with the request's real budget
+            max_new = 1
+            if not rec.reroutes:
+                origin = "prefill"
         return dict(prompt=rec.prompt, tokens=list(rec.tokens),
-                    max_new=rec.max_new, eos_id=rec.eos_id,
+                    max_new=max_new, eos_id=rec.eos_id,
                     priority=rec.priority, deadline_t=rec.deadline_t,
                     submit_t=rec.submit_t,
                     first_token_t=rec.first_token_t,
                     temperature=rec.temperature, top_k=rec.top_k,
                     top_p=rec.top_p, seed=rec.seed, trace=trace,
-                    origin=origin if not rec.reroutes else "failover")
+                    origin=origin)
 
     # -- live ops: deploy / canary / autoscale ----------------------------
 
@@ -1469,6 +1581,13 @@ class FleetRouter:
         victims = [i for i in live
                    if self._canary_version is None
                    or self._versions[i] != self._canary_version]
+        if self._roles:
+            # role minimums: a scale-down must never retire the last
+            # live replica of a role — that would collapse the
+            # disaggregated topology instead of shedding slack
+            victims = [i for i in victims
+                       if sum(1 for j in live
+                              if self._roles[j] == self._roles[i]) > 1]
         if not victims:
             return
         try:
@@ -1493,12 +1612,18 @@ class FleetRouter:
         i = len(self._replicas)
         self._versions.append(version)
         self._states.append("live")
+        if self._roles:
+            # load-driven growth adds decode capacity; the prefill
+            # carve-out is the static front of the fleet
+            self._roles.append("decode")
         try:
             handle = InProcessReplica(self._engine_factory(i),
                                       anomaly_sink=self._sink_for(i))
         except Exception:
             self._versions.pop()
             self._states.pop()
+            if self._roles:
+                self._roles.pop()
             raise
         self._replicas.append(handle)
         self._budgets.append(RetryBudget(
@@ -1602,6 +1727,16 @@ class FleetRouter:
             if fid is None:
                 continue
             rec = self.requests[fid]
+            if (rec.phase == "prefill" and fin["status"] == "done"
+                    and fin["reason"] == "length"
+                    and len(fin["tokens"]) < rec.max_new):
+                # the prefill leg hit its max_new=1 cap, not the
+                # request's own budget: this is the disaggregation
+                # handoff, not a retirement. (eos / shed / failed legs
+                # fall through and retire normally — the request was
+                # genuinely done or dead.)
+                self._handoff(rec, fin)
+                continue
             rec.tokens = list(fin["tokens"])
             rec.status = fin["status"]
             rec.retire_reason = fin["reason"]
@@ -1619,6 +1754,34 @@ class FleetRouter:
             rec.tokens = list(inf["tokens"])       # the failover mirror
             if inf["first_token_t"] is not None:
                 rec.first_token_t = inf["first_token_t"]
+
+    def _handoff(self, rec, fin):
+        """The prefill->decode hop: the prefill replica produced the
+        prompt's KV plus exactly the first token; the remainder
+        re-stages on a decode replica through the SAME token-exact
+        adopt/replay path failover uses (prompt + tokens=[t0], the
+        request's real max_new, pinned seed and version — the decode
+        replica's sample stream continues at fold-in count 1, so the
+        completion is bit-identical to a mixed-mode run). An injected
+        `fleet.handoff` fault degrades THIS request to mixed routing:
+        it goes back to pending with no role preference and finishes
+        wherever capacity exists."""
+        rec.tokens = list(fin["tokens"])           # [t0]
+        if fin["first_token_t"] is not None:
+            rec.first_token_t = fin["first_token_t"]
+        rec.status = "pending"
+        rec.replica = None
+        rec.replica_rid = None
+        try:
+            fault_point("fleet.handoff")
+            rec.phase = "decode"
+            self.handoffs += 1
+            _metrics.counter("fleet.handoffs").inc()
+        except Exception:
+            # handoff machinery faulted: finish mixed — correctness
+            # (token-exact completion) is never hostage to the split
+            rec.phase = None
+        self._pending.append(rec)
 
     def _on_replica_anomaly(self, replica, event):
         # fleet-level flight dump FIRST — evidence before mitigation

@@ -252,6 +252,10 @@ FAULT_POINTS = {
                     "each per-replica engine rebuild on the new "
                     "version (a fault rolls the touched replica back)",
     "fleet.dispatch": "fleet router handing a request to a replica",
+    "fleet.handoff": "prefill->decode disaggregation handoff of a "
+                     "prefilled request to a decode replica (a fault "
+                     "keeps the request on the prefill replica — "
+                     "mixed-mode degrade, never a wedge)",
     "fleet.heartbeat": "fleet router per-replica liveness ping",
     "fleet.respawn": "fleet router respawning a dead replica",
     "fleet.scale": "fleet autoscaler acting on a load signal (spawn "
@@ -267,6 +271,9 @@ FAULT_POINTS = {
                           "collision or evict-under-use injection "
                           "degrades the match to private pages)",
     "serve.step": "the jitted continuous-batching decode step",
+    "spec.verify": "speculative draft-propose + verify round (a fault "
+                   "degrades that round to one plain decode step — "
+                   "token-exact either way)",
     "trainer.ingest": "ingest-channel dequeue feeding the train step",
     "trainer.rollback": "guardian rollback restoring the last good "
                         "checkpoint after mitigation-ladder escalation",

@@ -15,6 +15,8 @@ Usage:
   python tools/autoplan.py --model gpt --topology 2xv5e-16 --json
   python tools/autoplan.py --selftest        # host-math sanity (tier-1)
   python tools/autoplan.py --model gpt --calibrate   # vs XLA cost_analysis
+  python tools/autoplan.py --model gpt --serve-spec  # speculative-decoding
+                                         # break-even acceptance/topology
 """
 
 import argparse
@@ -152,6 +154,75 @@ def calibrate(model, batch, seq):
     return costmodel.calibration_report(spec, jitted, v["params"])
 
 
+def serve_spec_report(model, tiny, topology, spec_k, slots, context,
+                      draft_tiny):
+    """Price speculative decoding per topology: what acceptance rate a
+    draft must clear before spec_k-token rounds beat plain decode, and
+    the projected speedup at a few representative acceptance rates.
+    Pure host math over costmodel.predict_decode — no jax import."""
+    from paddle_tpu.parallel.autoplan import (
+        ModelSpec, costmodel, get_topology)
+    from paddle_tpu.parallel.autoplan.topology import PRESETS
+
+    cfg = _config(model, tiny)
+    spec = ModelSpec.from_config(cfg, batch=slots, seq=context,
+                                 name=model)
+    draft_spec = None
+    if draft_tiny and not tiny:
+        # a separate (smaller) draft model instead of self-draft:
+        # price the tiny config of the same architecture
+        draft_spec = ModelSpec.from_config(
+            _config(model, tiny=True), batch=slots, seq=context,
+            name=f"{model}-tiny")
+    names = ([topology] if topology
+             else [n for n in PRESETS if not n.startswith("cpu")
+                   or n == "cpu4"])
+    probes = (0.3, 0.5, 0.7, 0.9)
+    rows = []
+    for name in names:
+        topo = get_topology(name)
+        pred = costmodel.predict_decode(
+            spec, topo, slots=slots, context=context, spec_k=spec_k,
+            draft_spec=draft_spec)
+        row = {
+            "topology": name,
+            "draft": pred["draft"],
+            "spec_k": spec_k,
+            "rate_source": pred["rate_source"],
+            "draft_overhead": round(pred["draft_overhead"], 4),
+            # flops break-even: >= 1.0 by construction (verify work is
+            # real) — the energy story, kept for the record
+            "break_even_accept_rate":
+                round(pred["break_even_accept_rate"], 4),
+            # roofline (wall-clock) break-even: the decision figure —
+            # memory-bound decode amortizes the weight/KV stream over
+            # the verify window
+            "break_even_accept_rate_s":
+                round(pred["break_even_accept_rate_s"], 4),
+        }
+        for r in probes:
+            p = costmodel.predict_decode(
+                spec, topo, slots=slots, context=context,
+                spec_k=spec_k, draft_spec=draft_spec, accept_rate=r)
+            row[f"speedup@{r}"] = round(p["speedup_vs_plain_s"], 3)
+        rows.append(row)
+    head = (f"{'topology':<12} {'draft':<10} {'break-even(t)':>13} "
+            f"{'(flops)':>8} {'overhead':>9} "
+            + " ".join(f"x@{r:<5}" for r in probes))
+    print(head)
+    print("-" * len(head))
+    for row in rows:
+        print(f"{row['topology']:<12} {row['draft']:<10} "
+              f"{row['break_even_accept_rate_s']:>13.4f} "
+              f"{row['break_even_accept_rate']:>8.4f} "
+              f"{row['draft_overhead']:>9.4f} "
+              + " ".join(f"{row[f'speedup@{r}']:<7.3f}"
+                         for r in probes))
+    return {"tool": "autoplan", "mode": "serve_spec", "model": model,
+            "slots": slots, "context": context, "spec_k": spec_k,
+            "rows": rows}
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="rank dp x tp x pp meshes for a model on a topology")
@@ -179,6 +250,19 @@ def main():
     ap.add_argument("--calibrate", action="store_true",
                     help="compare analytic flops vs XLA cost_analysis for "
                          "a tiny train step on CPU")
+    ap.add_argument("--serve-spec", action="store_true",
+                    help="speculative-decoding break-even acceptance "
+                         "rate per topology (host math, no jax)")
+    ap.add_argument("--spec-k", type=int, default=7,
+                    help="draft tokens per speculation round")
+    ap.add_argument("--slots", type=int, default=16,
+                    help="decode slots priced (--serve-spec)")
+    ap.add_argument("--context", type=int, default=None,
+                    help="KV context length priced (--serve-spec; "
+                         "default --seq)")
+    ap.add_argument("--draft-tiny", action="store_true",
+                    help="price a tiny-config draft model instead of "
+                         "self-draft (--serve-spec)")
     args = ap.parse_args()
 
     if args.selftest:
@@ -186,6 +270,12 @@ def main():
         return
     batch = args.batch or (8 if args.tiny else 16)
     seq = args.seq or (64 if args.tiny else 512)
+    if args.serve_spec:
+        out = serve_spec_report(
+            args.model, args.tiny, args.topology, args.spec_k,
+            args.slots, args.context or seq, args.draft_tiny)
+        print(json.dumps(out))
+        return
     if args.calibrate:
         out = calibrate(args.model, batch, seq)
         print(json.dumps(out))

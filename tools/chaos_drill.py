@@ -31,6 +31,10 @@ injected fault produced exactly one engine recovery. A closing
 quantized-KV wave re-runs shared-prefix traffic through an int8 page
 pool with an injected `quant.kv_write` fault: the faulted admission
 degrades to private pages, everything stays terminal and traced-once.
+A final speculation wave re-runs greedy traffic through a self-draft
+engine (serve_draft) with an injected `spec.verify` fault: the faulted
+round degrades to ONE plain decode step, completions stay token-exact
+vs generate(), and the draft/verify jits stay traced-once.
 
 Fleet drill (--fleet): 3 in-process engine replicas behind a
 FleetRouter — mixed traffic, one replica killed mid-decode, one
@@ -495,7 +499,9 @@ def run_serve_drill(seed=0):
     while the rest must still hit the cache, then a quantized-KV wave
     through an int8 pool whose first admission takes an injected
     quant.kv_write fault (degrade to private pages, terminal, one
-    trace)."""
+    trace), then a speculation wave through a self-draft engine whose
+    second round takes an injected spec.verify fault (degrade to one
+    plain decode step, token-exact, traced-once)."""
     sys.path.insert(0, REPO)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time as _time
@@ -667,6 +673,47 @@ def run_serve_drill(seed=0):
         assert (qengine.decode_traces == 1
                 and qengine.prefill_traces == 1), "int8 engine retraced"
         qengine.close()
+
+        # -- speculation wave: greedy mixed traffic through a self-draft
+        # engine (draft == target). One round's verify takes an injected
+        # spec.verify fault and must degrade to ONE plain decode step —
+        # every completion stays token-exact vs generate() either way,
+        # and the degraded round shows up as target_steps > rounds.
+        # Draft/verify/decode jits each trace exactly once.
+        sengine = ServingEngine(model, variables, ServeConfig(
+            num_slots=2, page_size=8, max_len=64, prefill_len=16,
+            draft=True, spec_k=4))
+        splan = chaos.FaultPlan(seed=seed)
+        splan.fail("fault_point", path=r"^spec\.verify$", nth=2, times=1)
+        sprompts = [rng.randint(0, cfg.vocab_size, (L,), dtype=np.int32)
+                    for L in (6, 30, 11)]
+        with chaos.active(splan):
+            s_ids = [sengine.submit(p, max_new=8) for p in sprompts]
+            sengine.drain()
+        spec_faults = splan.fired("fault_point")
+        assert spec_faults == 1, (
+            f"expected 1 injected spec.verify fault, {spec_faults}")
+        sstats = sengine.spec_stats()
+        assert sstats["enabled"] and sstats["rounds"] >= 1, sstats
+        assert sstats["target_steps"] > sstats["rounds"], (
+            "the faulted round did not run as a plain decode step",
+            sstats)
+        assert sstats["tokens_per_target_step"] > 1.0, sstats
+        for rid, p in zip(s_ids, sprompts):
+            assert sengine.requests[rid].status == "done", (
+                rid, sengine.requests[rid].status)
+            ref = model.apply(variables, jnp.asarray(p[None, :]),
+                              method=lambda pr: model.generate(pr, 8))
+            assert np.array_equal(sengine.requests[rid].output,
+                                  np.asarray(ref)[0]), (
+                f"speculative request {rid} not token-exact under the "
+                "degraded verify")
+        assert (sengine.draft_traces == 1
+                and sengine.verify_traces == 1
+                and sengine.decode_traces == 1), (
+            "speculative engine retraced", sengine.draft_traces,
+            sengine.verify_traces, sengine.decode_traces)
+        sengine.close()
         engine.close()
         return dict(
             submitted=len(statuses),
@@ -681,7 +728,10 @@ def run_serve_drill(seed=0):
             prefix_faults=prefix_faults,
             wave_token_exact=len(wave_ids),
             quant_wave=len(q_ids), quant_faults=quant_faults,
-            quant_degraded=quant_degraded, quant_hits=quant_hits)
+            quant_degraded=quant_degraded, quant_hits=quant_hits,
+            spec_wave=len(s_ids), spec_faults=spec_faults,
+            spec_rounds=sstats["rounds"],
+            spec_tokens_per_target_step=sstats["tokens_per_target_step"])
     finally:
         F.set_flags(saved)
 

@@ -244,7 +244,7 @@ def _serve_dims():
     return c.SERVE_TMAX, c.SERVE_MIN_ROWS
 
 
-def _serve_engine(num_pages=13, **cfg_kw):
+def _serve_engine(num_pages=13, num_slots=2, **cfg_kw):
     import jax
     from paddle_tpu.models.gpt import GPTConfig, GPTDecoder
     from paddle_tpu.serving import ServeConfig, ServingEngine
@@ -254,7 +254,7 @@ def _serve_engine(num_pages=13, **cfg_kw):
     model = GPTDecoder(cfg)
     variables = model.init(jax.random.key(0))
     tmax, _ = _serve_dims()
-    sc = ServeConfig(num_slots=2, page_size=8, max_len=tmax,
+    sc = ServeConfig(num_slots=num_slots, page_size=8, max_len=tmax,
                      prefill_len=16, num_pages=num_pages, **cfg_kw)
     return model, variables, ServingEngine(model, variables, sc)
 
@@ -284,6 +284,15 @@ def serve_smoke(positive_control=True, update_snapshots=False):
        from predict_decode(kv_dtype=int8), its own snapshot — while the
        f32 engine's compile TRIPS the KV detector (positive control:
        its pool is exactly the wide-KV tensor the row forbids).
+    5. Speculative leg: the waves through a 16-slot self-draft engine
+       (spec_k=7) with one injected spec.verify degrade must leave all
+       FIVE entry points traced exactly once, emit > 1 token per
+       target step, and compile a verify module clean against the
+       serve.verify row — budgets from predict_decode(spec_k=...), no
+       dense [slots, window, vocab] logits lattice (per-position head),
+       its own snapshot. Positive controls: a literal dense-lattice
+       einsum trips the detector, and the speculation-off engine trips
+       the row's TracedOnce.
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -383,12 +392,88 @@ def serve_smoke(positive_control=True, update_snapshots=False):
                      if isinstance(r, c.NoKvDequantTemporary))
         out["kv_control_trips"] = bool(kvdet.temporaries(hlo))
 
+        # --- speculative leg: the same waves through a self-draft ------
+        # engine wide enough that slots x window = 128 rows clears the
+        # verify row's MIN_ROWS=96 — a dense [slots, window, vocab]
+        # logits lattice cannot hide under the weight allowance. One
+        # injected spec.verify fault degrades one round to plain decode,
+        # so all five entry points (decode, prefill, draft,
+        # draft-prefill, verify) earn their traced-once counts in a
+        # single drive.
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.testing import chaos as _chaos
+        vs, vk = c.SERVE_VERIFY_SLOTS, c.SERVE_VERIFY_SPEC_K
+        _, _, veng = _serve_engine(num_pages=c.SERVE_VERIFY_PAGES,
+                                   num_slots=vs, draft=True, spec_k=vk)
+        plan = _chaos.FaultPlan().fail("fault_point",
+                                       path=r"spec\.verify")
+        with _chaos.active(plan):
+            _drive(veng)
+        out["spec_fault_degrades"] = plan.fired()
+        st = veng.spec_stats()
+        out["spec_stats"] = st
+        out["spec_traced_once"] = (
+            veng.decode_traces == 1 and veng.prefill_traces == 1
+            and veng.draft_traces == 1
+            and veng.draft_prefill_traces == 1
+            and veng.verify_traces == 1)
+        out["spec_wins"] = bool(
+            st["tokens_per_target_step"] is not None
+            and st["tokens_per_target_step"] > 1.0)
+        v_compiled = veng.compiled_verify()
+        v_hlo = v_compiled.as_text()
+        try:
+            v_cost = c.normalize_cost(v_compiled.cost_analysis())
+        except Exception:
+            v_cost = None
+        v_ctx = c.ContractContext(
+            hlo_text=v_hlo, cost=v_cost,
+            trace_counts={"serve.decode": veng.decode_traces,
+                          "serve.draft": veng.draft_traces,
+                          "serve.verify": veng.verify_traces})
+        v_viol = c.evaluate(c.CONTRACTS["serve.verify"], v_ctx)
+        v_snap = c.CONTRACT_SNAPSHOTS["serve.verify"]
+        if update_snapshots:
+            out["verify_snapshot_blessed"] = v_snap.bless(v_hlo)["hash"]
+        else:
+            v_viol += v_snap.violations(v_ctx)
+        out["verify_cost"] = v_cost
+        out["verify_violations"] = [v.format() for v in v_viol]
+        out["verify_clean"] = not v_viol
+        # lattice positive control: compile the dense [slots, window,
+        # vocab] logits stack the per-position head avoids — the
+        # detector must trip on it
+        latdet = next(r for r in c.CONTRACTS["serve.verify"]
+                      if isinstance(r, c.NoTemporary))
+        lat_hlo = jax.jit(
+            lambda h, e: jnp.einsum("swh,vh->swv", h, e)).lower(
+                np.zeros((vs, vk + 1, 64), np.float32),
+                np.zeros((512, 64), np.float32)).compile().as_text()
+        out["lattice_control_trips"] = bool(latdet.temporaries(lat_hlo))
+        # speculation-off positive control: judging the plain engine
+        # against the verify row must trip TracedOnce (no draft/verify
+        # counts exist there — proves the probe is not vacuous)
+        off_trips = c.evaluate(
+            [r for r in c.CONTRACTS["serve.verify"]
+             if isinstance(r, c.TracedOnce)],
+            c.ContractContext(
+                hlo_text=hlo, cost=cost,
+                trace_counts={"serve.decode": engine.decode_traces,
+                              "serve.prefill": engine.prefill_traces}))
+        out["spec_off_control_trips"] = bool(off_trips)
+
         if positive_control:
             budgets = [b for b in c.CONTRACTS["serve.decode"]
                        if isinstance(b, c.MaxHloCost)]
             if budgets and cost is not None:
                 out["budget_control_trips"] = all(
                     b.with_tolerance(0).check(ctx) for b in budgets)
+            v_budgets = [b for b in c.CONTRACTS["serve.verify"]
+                         if isinstance(b, c.MaxHloCost)]
+            if v_budgets and v_cost is not None:
+                out["verify_budget_control_trips"] = all(
+                    b.with_tolerance(0).check(v_ctx) for b in v_budgets)
             set_flags({"use_pallas_decode": False})
             _, _, ref_engine = _serve_engine()
             ref_hlo = ref_engine.compiled_decode().as_text()
@@ -422,6 +507,12 @@ def serve_smoke(positive_control=True, update_snapshots=False):
     out["ok"] = bool(out.get("traced_once") and out.get("clean")
                      and out.get("int8_clean")
                      and out.get("kv_control_trips")
+                     and out.get("spec_traced_once")
+                     and out.get("spec_wins")
+                     and out.get("verify_clean")
+                     and out.get("lattice_control_trips")
+                     and out.get("spec_off_control_trips")
+                     and out.get("spec_fault_degrades") == 1
                      and out.get("positive_control_trips",
                                  not positive_control)
                      and out.get("retrace_control_trips",

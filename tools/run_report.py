@@ -18,9 +18,13 @@ command turns a training run's artifacts into the human-readable story —
 reconstructed from the engine's trace events (submitted/admitted/
 prefill_done/first_token/preempted/resumed/retired), an ASCII per-slot
 Gantt of slot occupancy, TTFT + token-latency percentiles, goodput
-against the configured SLOs, preemption attribution, and the KV pool
+against the configured SLOs, preemption attribution, the KV pool
 footprint (kv_dtype + pool bytes, plus quantized-page / overflow-clamp
-/ degraded-admission counters for serve_kv_dtype=int8 runs).
+/ degraded-admission counters for serve_kv_dtype=int8 runs), and — for
+serve_draft runs — the speculation story: the per-round acceptance-rate
+trajectory (spec_proposed/spec_accepted step fields), tokens per target
+step, and per-request speculative-vs-plain accounting (the spec_tokens
+field each retirement carries).
 
 `--fleet` renders the fleet live-ops view: the deploy/scale/canary
 timeline from FleetRouter ops events (raw records, a dumped telemetry
@@ -31,9 +35,10 @@ per-version goodput table, and the goodput-vs-offered-load curve.
 distributed-tracing view: the logs merge into one causally ordered
 timeline via their wall/monotonic anchor records (clock-skew
 corrected), shown as a cross-replica per-request Gantt — a failover
-re-route appears as the SAME trace id continuing on another replica —
-plus the critical-path breakdown (queue -> prefill -> first token ->
-decode) and a skew report.
+re-route appears as the SAME trace id continuing on another replica,
+and a disaggregated request's prefill -> decode handoff appears as a
+'P' row handing to an 'H' row — plus the critical-path breakdown
+(queue -> prefill -> first token -> decode) and a skew report.
 
 `--train-health` renders the resilience view: guardian non-finite
 skips, loss-spike episodes and mitigation-ladder actions, rollbacks
@@ -432,6 +437,43 @@ def render_serve_report(records, top=20, width=64):
         lines.append(_pctl_line(
             f"serve steps:    {len(steps)} ({toks} tokens)  step ",
             walls))
+
+    # -- speculation: acceptance trajectory + spec-vs-plain accounting ----
+    spec_steps = [r for r in steps
+                  if isinstance(r.get("spec_proposed"), int)]
+    if spec_steps:
+        prop = sum(r["spec_proposed"] for r in spec_steps)
+        acc = sum(r.get("spec_accepted") or 0 for r in spec_steps)
+        toks = sum(r.get("new_tokens") or 0 for r in steps)
+        lines.append(
+            f"\nspeculation:    {len(spec_steps)}/{len(steps)} steps ran "
+            f"a draft round; {prop} proposed, {acc} accepted, "
+            f"{prop - acc} rolled back"
+            + (f"  (acceptance {acc / prop:.4f})" if prop else ""))
+        lines.append(f"tokens/target-step: {toks / len(steps):.4f} over "
+                     f"{len(steps)} target steps (plain decoding is 1.0)")
+        rates = [r["spec_accepted"] / r["spec_proposed"]
+                 for r in spec_steps if r["spec_proposed"]]
+        if rates:
+            lines.append(f"acceptance trajectory (per round, max "
+                         f"{max(rates):.2f}): [{_bars(_bucket(rates))}]")
+        spec_reqs = [(r, last(ev, "retired")) for r, ev in retired.items()]
+        spec_reqs = [(r, ret) for r, ret in spec_reqs
+                     if ret.get("spec_tokens") is not None]
+        if spec_reqs:
+            won = [rr for rr in spec_reqs if rr[1]["spec_tokens"]]
+            saved = sum(ret["spec_tokens"] for _, ret in spec_reqs)
+            lines.append(
+                f"spec-vs-plain:  {len(won)}/{len(spec_reqs)} retired "
+                f"requests beat one token per step; {saved} target "
+                "steps saved in total")
+            for r, ret in sorted(
+                    spec_reqs, key=lambda kv: -kv[1]["spec_tokens"])[:top]:
+                ntok = ret.get("tokens", 0)
+                lines.append(
+                    f"  req {r}: {ntok} tokens in "
+                    f"{ntok - ret['spec_tokens']} target steps "
+                    f"(+{ret['spec_tokens']} speculative)")
     fin = finals[-1] if finals else {}
     if fin.get("kv_dtype") or fin.get("kv_pool_bytes"):
         counters = _flatten_counters(fin.get("counters"))
@@ -589,10 +631,11 @@ def render_fleet_trace(record_lists, top=20, width=64):
     merged into ONE causally ordered timeline (per-process wall/mono
     anchor records correct clock skew), then rendered as a clock-skew
     report, a cross-replica per-request Gantt (failover / deploy-drain
-    re-admission / preemption annotated), and the critical-path phase
-    breakdown (queue -> dispatch -> prefill -> first token -> decode ->
-    retire) over retired requests. ``record_lists`` maps a source name
-    (one per replica RunLog) to its records."""
+    re-admission / preemption / disaggregated prefill->decode handoff
+    annotated), and the critical-path phase breakdown (queue ->
+    dispatch -> prefill -> first token -> decode -> retire) over
+    retired requests. ``record_lists`` maps a source name (one per
+    replica RunLog) to its records."""
     from paddle_tpu.observability.trace import (group_by_trace,
                                                 merge_fleet_trace)
     merged = merge_fleet_trace(record_lists)
@@ -631,8 +674,9 @@ def render_fleet_trace(record_lists, top=20, width=64):
         f"\ncross-replica request Gantt ({len(traces)} traces over "
         f"{span_t:.3f}s; top {len(shown)} by span — one row per "
         "replica a trace touched; A=adopted F=failover-adopt "
-        "!=preempted .=event R=retired):")
+        "P=prefill-leg H=handoff-adopt !=preempted .=event R=retired):")
     mark = {"adopted": "A", "preempted": "!", "retired": "R"}
+    origin_mark = {"failover": "F", "prefill": "P", "handoff": "H"}
     for tid, evs in shown:
         lines.append(f"  {tid}:")
         sources = sorted({e["source"] for e in evs})
@@ -646,12 +690,20 @@ def render_fleet_trace(record_lists, top=20, width=64):
             rank = {" ": 0, "-": 0, ".": 1}
             for e in mine:
                 m = mark.get(e["event"], ".")
-                if e["event"] == "adopted" and \
-                        e.get("origin") == "failover":
-                    m = "F"
+                if e["event"] == "adopted":
+                    m = origin_mark.get(e.get("origin"), m)
                 c = col(e["wall_t"])
-                if rank.get(m, 2) >= rank.get(row[c], 2):
-                    row[c] = m
+                if rank.get(m, 2) < rank.get(row[c], 2):
+                    continue
+                if rank.get(row[c], 2) >= 2 and row[c] != m:
+                    # two letters share a column (e.g. the handoff-adopt
+                    # and the retirement of a short decode leg): nudge
+                    # sideways so both stay visible
+                    for alt in (c + 1, c - 1):
+                        if 0 <= alt < width and rank.get(row[alt], 2) < 2:
+                            c = alt
+                            break
+                row[c] = m
             note = ""
             hops = {e.get("span") for e in mine if e.get("span")}
             if hops:
